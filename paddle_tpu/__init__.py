@@ -1,0 +1,117 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the PaddlePaddle
+API surface (reference: YinLiu-91/Paddle, see SURVEY.md).
+
+Compute path: JAX/XLA (+ Pallas TPU kernels in paddle_tpu.ops); scale-out:
+jax.sharding Mesh + collectives (paddle_tpu.distributed); runtime extras in
+C++ (paddle_tpu/runtime). The public namespace mirrors `import paddle`.
+"""
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Paddle semantics: int64 is the default integer dtype (indices, labels).
+# Compute dtypes stay explicitly float32/bfloat16 throughout the framework,
+# so this does not drag float64 onto the MXU.
+_jax.config.update("jax_enable_x64", True)
+
+from .framework import (Tensor, Parameter, to_tensor, no_grad, enable_grad,
+                        set_grad_enabled, is_grad_enabled, seed,
+                        get_rng_state, set_rng_state,
+                        dtype, float16, bfloat16, float32, float64, int8,
+                        int16, int32, int64, uint8, bool_, complex64,
+                        complex128, set_default_dtype, get_default_dtype,
+                        iinfo, finfo)
+from .framework.io import save, load
+from . import tensor
+from .tensor import *  # noqa: F401,F403 — paddle.* op surface
+from .tensor.creation import (to_tensor, zeros, ones, full, empty,
+                              zeros_like, ones_like, full_like, empty_like,
+                              arange, linspace, logspace, eye, meshgrid,
+                              diag, diagflat, tril, triu, assign, clone,
+                              numel, create_parameter)
+from .tensor.logic import is_tensor
+from .tensor.einsum import einsum
+from . import autograd
+from .autograd import grad
+from . import device
+from .device import (set_device, get_device, is_compiled_with_cuda,
+                     is_compiled_with_rocm, is_compiled_with_xpu,
+                     is_compiled_with_tpu, is_compiled_with_npu,
+                     is_compiled_with_cinn)
+from . import linalg
+from . import version
+from .tensor.search import where, nonzero, argmax, argmin  # noqa
+
+# Subsystem imports are appended as each lands (see SURVEY.md §7 plan);
+# keeping the namespace importable at every commit.
+for _mod in ("nn", "optimizer", "amp", "io", "metric", "static", "jit",
+             "vision", "distribution", "fft", "signal", "regularizer",
+             "utils", "incubate", "distributed", "inference", "hapi",
+             "profiler", "ops", "models", "text"):
+    try:
+        __import__(f"{__name__}.{_mod}")
+    except ImportError:
+        pass
+
+try:
+    from .hapi import Model
+except ImportError:
+    pass
+
+# paddle.disable_static / enable_static (dygraph is the default, like 2.x)
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static(place=None):
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops, print_detail)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.model_summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)
+
+
+def get_flags(flags=None):
+    return {}
+
+
+def set_flags(flags):
+    pass
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+    np.set_printoptions(**{k: v for k, v in kwargs.items()
+                           if k in ("precision", "threshold", "edgeitems",
+                                    "linewidth")})
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch parity (python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

@@ -1,0 +1,171 @@
+"""paddle.autograd namespace.
+
+Parity: python/paddle/autograd/__init__.py — backward/grad (tape), PyLayer
+(py_layer.py), and the functional transforms (functional.py) which here are
+direct jax transforms over Tensor-level functions.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op, no_grad, enable_grad, \
+    is_grad_enabled, set_grad_enabled
+from .backward_engine import grad, run_backward
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "vjp", "jvp",
+           "jacobian", "hessian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op. Parity: python/paddle/autograd/py_layer.py.
+
+    Subclass with @staticmethod forward(ctx, ...) and backward(ctx, *grads)
+    operating on Tensors. Wired into the tape via jax.custom_vjp semantics:
+    the recorded node's vjp calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        if not needs_grad:
+            return out
+
+        from ..framework.core import _Node
+        diff_in = [t for t in tensor_args if not t.stop_gradient]
+
+        def node_fn(*in_arrays):
+            # identity in the forward direction; custom vjp via PyLayerNode
+            raise RuntimeError("PyLayer node should not re-run forward")
+
+        node = _PyLayerNode(cls, ctx, [t._slot for t in diff_in],
+                            [o._slot for o in outs], multi)
+        for o in outs:
+            o._slot.node = node
+            o.stop_gradient = False
+        return out
+
+
+class _PyLayerNode:
+    """Tape node whose vjp is the user's backward()."""
+    __slots__ = ("cls", "ctx", "in_slots", "out_slots", "multi", "fn")
+
+    def __init__(self, cls, ctx, in_slots, out_slots, multi):
+        self.cls = cls
+        self.ctx = ctx
+        self.in_slots = in_slots
+        self.out_slots = out_slots
+        self.multi = multi
+        self.fn = None  # engine checks fn only through run_vjp below
+
+    def run_vjp(self, cots):
+        grads = self.cls.backward(
+            self.ctx, *[Tensor(c) for c in cots]) if self.multi else \
+            self.cls.backward(self.ctx, Tensor(cots[0]))
+        grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+        return tuple(g.value if isinstance(g, Tensor) else g for g in grads)
+
+
+# ---- functional transforms (jax-native) ------------------------------
+def _functionalize(func):
+    """Lift a Tensor->Tensor python function to a jax-array function."""
+    def jf(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o.value for o in out)
+        return out.value
+    return jf
+
+
+def vjp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    jf = _functionalize(func)
+    out, vjp_fn = jax.vjp(jf, *[x.value for x in xs_list])
+    if v is None:
+        seed = jax.tree.map(jnp.ones_like, out)
+    else:
+        vl = v if isinstance(v, (tuple, list)) else [v]
+        seed = tuple(t.value for t in vl) if isinstance(out, tuple) \
+            else vl[0].value
+    grads = vjp_fn(seed)
+    wrap = lambda o: jax.tree.map(Tensor, o) if isinstance(o, tuple) \
+        else Tensor(o)
+    gout = [Tensor(g) for g in grads]
+    return wrap(out), gout if len(gout) > 1 else gout[0]
+
+
+def jvp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    jf = _functionalize(func)
+    prim = [x.value for x in xs_list]
+    if v is None:
+        tang = [jnp.ones_like(p) for p in prim]
+    else:
+        vl = v if isinstance(v, (tuple, list)) else [v]
+        tang = [t.value for t in vl]
+    out, jv = jax.jvp(jf, prim, tang)
+    wrap = lambda o: tuple(Tensor(x) for x in o) if isinstance(o, tuple) \
+        else Tensor(o)
+    return wrap(out), wrap(jv)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    jf = _functionalize(func)
+    jac = jax.jacobian(jf, argnums=tuple(range(len(xs_list))))(
+        *[x.value for x in xs_list])
+    out = jax.tree.map(Tensor, jac)
+    if not isinstance(xs, (tuple, list)):
+        return out[0] if isinstance(out, tuple) else out
+    return out
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    xs_list = xs if isinstance(xs, (tuple, list)) else [xs]
+    jf = _functionalize(func)
+    hes = jax.hessian(jf, argnums=tuple(range(len(xs_list))))(
+        *[x.value for x in xs_list])
+    out = jax.tree.map(Tensor, hes)
+    if not isinstance(xs, (tuple, list)):
+        return out[0][0] if isinstance(out, tuple) else out
+    return out

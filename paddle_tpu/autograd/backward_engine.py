@@ -1,0 +1,143 @@
+"""Reverse-mode engine over the eager tape.
+
+Parity: paddle/fluid/imperative/basic_engine.cc (the dygraph autograd
+engine). Design difference: nodes store the *forward* jax function; the VJP
+is obtained here with jax.vjp, so backward math is always consistent with
+XLA's differentiation rules rather than hand-written grad kernels.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["run_backward", "grad"]
+
+
+def _topo_nodes(root_slots):
+    """Topologically order all nodes reachable from the given slots
+    (producers before consumers)."""
+    order, seen = [], set()
+    stack = [(s.node, False) for s in root_slots if s.node is not None]
+    while stack:
+        node, expanded = stack.pop()
+        if node is None:
+            continue
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for s in node.in_slots:
+            if s.node is not None and id(s.node) not in seen:
+                stack.append((s.node, False))
+    return order
+
+
+def _accumulate(slot, g):
+    slot.grad = g if slot.grad is None else slot.grad + g
+
+
+def _backward_pass(root_slots, seed_grads, retain_graph):
+    """Run VJPs in reverse topological order. Returns every slot touched."""
+    nodes = _topo_nodes(root_slots)
+    all_slots = set(root_slots)
+    for n in nodes:
+        all_slots.update(n.in_slots)
+        all_slots.update(n.out_slots)
+    for s, g in zip(root_slots, seed_grads):
+        _accumulate(s, g)
+
+    with no_grad():
+        for node in reversed(nodes):
+            if any(o.grad is not None for o in node.out_slots):
+                cots = tuple(
+                    o.grad if o.grad is not None else jnp.zeros_like(o.val)
+                    for o in node.out_slots)
+                if hasattr(node, "run_vjp"):  # PyLayer custom backward
+                    in_cots = node.run_vjp(cots)
+                else:
+                    _, vjp_fn = jax.vjp(node.fn,
+                                        *[s.val for s in node.in_slots])
+                    in_cots = vjp_fn(cots if node.multi else cots[0])
+                for s, g in zip(node.in_slots, in_cots):
+                    if g is not None:
+                        _accumulate(s, g)
+            if not retain_graph:
+                for o in node.out_slots:
+                    o.node = None
+                node.fn = None
+                node.in_slots = ()
+    return all_slots
+
+
+def _collect_and_clear(all_slots, into_tensors):
+    for s in all_slots:
+        if s.grad is None:
+            continue
+        if into_tensors:
+            t = s.tensor_ref() if s.tensor_ref else None
+            is_leaf = t is not None and t._slot.node is None
+            if t is not None and not t.stop_gradient and (
+                    is_leaf or t._retain_grad):
+                g = Tensor(s.grad)
+                if t.grad is None:
+                    t.grad = g
+                else:  # Paddle accumulates across backward() calls
+                    t.grad = Tensor(t.grad.value + g.value)
+        s.grad = None
+
+
+def run_backward(tensor, grad_tensor=None, retain_graph=False):
+    if tensor.stop_gradient:
+        raise RuntimeError("backward() on a tensor with stop_gradient=True")
+    if grad_tensor is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "grad_tensor must be provided for non-scalar backward()")
+        seed = jnp.ones_like(tensor.value)
+    else:
+        seed = grad_tensor.value if isinstance(
+            grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    all_slots = _backward_pass([tensor._slot], [seed], retain_graph)
+    _collect_and_clear(all_slots, into_tensors=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (python/paddle/fluid/dygraph/base.py:grad).
+
+    create_graph (double grad) is intentionally unsupported on the eager
+    tape; use paddle_tpu.autograd functional transforms (jax.grad
+    composition) for higher-order derivatives.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use functional autograd (autograd.vjp/jvp)")
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        seeds = [jnp.ones_like(o.value) for o in outputs]
+    else:
+        gos = grad_outputs if isinstance(
+            grad_outputs, (list, tuple)) else [grad_outputs]
+        seeds = [g.value if g is not None else jnp.ones_like(o.value)
+                 for o, g in zip(outputs, gos)]
+
+    retain = bool(retain_graph) if retain_graph is not None else False
+    in_slots = [i._slot for i in inputs]
+    all_slots = _backward_pass([o._slot for o in outputs], seeds, retain)
+    results = []
+    for i, s in zip(inputs, in_slots):
+        if s.grad is None:
+            if not allow_unused:
+                raise ValueError(
+                    f"an input tensor is unused in the graph "
+                    "(pass allow_unused=True)")
+            results.append(None)
+        else:
+            results.append(Tensor(s.grad))
+    _collect_and_clear(all_slots, into_tensors=False)
+    return results

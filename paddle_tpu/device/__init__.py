@@ -1,0 +1,166 @@
+"""Device management. Parity: python/paddle/device/__init__.py.
+
+The reference dispatches over Places (CPUPlace/CUDAPlace/XPUPlace...,
+paddle/fluid/platform/place.h); here the device set is whatever JAX
+exposes (TPU chips, or CPU with --xla_force_host_platform_device_count for
+sharding tests). There is no per-op placement: XLA owns placement, and
+multi-device execution goes through jax.sharding (see distributed/).
+"""
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "is_compiled_with_npu",
+           "is_compiled_with_tpu", "synchronize", "get_device_properties",
+           "cuda", "Stream", "Event"]
+
+_current = None
+
+
+def _default_device():
+    return jax.devices()[0]
+
+
+def set_device(device):
+    global _current
+    if isinstance(device, str):
+        name = device.split(":")[0]
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        if name in ("gpu", "cuda", "tpu", "xpu", "npu"):
+            devs = jax.devices()
+        elif name == "cpu":
+            devs = [d for d in jax.devices() if d.platform == "cpu"] or \
+                jax.devices("cpu")
+        else:
+            devs = jax.devices()
+        _current = devs[idx % len(devs)]
+    else:
+        _current = device
+    return _current
+
+
+def get_device():
+    d = _current or _default_device()
+    plat = "tpu" if d.platform in ("tpu", "axon") else d.platform
+    return f"{plat}:{d.id}"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def synchronize(device=None):
+    """Block until all queued device work is complete."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def get_device_properties(device=None):
+    d = _current or _default_device()
+    class _Props:
+        name = str(d)
+        major, minor = 0, 0
+        total_memory = getattr(d, "memory_stats", lambda: {})() \
+            .get("bytes_limit", 0) if hasattr(d, "memory_stats") else 0
+        multi_processor_count = 1
+    return _Props()
+
+
+class Stream:
+    """XLA orders execution itself; streams are a no-op compatibility shim."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+class cuda:
+    """paddle.device.cuda shim mapping onto the TPU runtime."""
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        d = _default_device()
+        if hasattr(d, "memory_stats"):
+            return d.memory_stats().get("peak_bytes_in_use", 0)
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        d = _default_device()
+        if hasattr(d, "memory_stats"):
+            return d.memory_stats().get("bytes_in_use", 0)
+        return 0
+
+    @staticmethod
+    def empty_cache():
+        pass

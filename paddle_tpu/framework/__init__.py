@@ -1,0 +1,9 @@
+"""Framework core namespace. Parity: python/paddle/framework/__init__.py."""
+from .core import Tensor, Parameter, apply_op, no_grad, enable_grad, \
+    set_grad_enabled, is_grad_enabled, to_tensor
+from .dtype import (dtype, float16, bfloat16, float32, float64, int8, int16,
+                    int32, int64, uint8, bool_, complex64, complex128,
+                    set_default_dtype, get_default_dtype, convert_dtype,
+                    iinfo, finfo)
+from .random import seed, get_rng_state, set_rng_state, rng_scope, split_key
+from . import io

@@ -1,0 +1,395 @@
+"""Core runtime: Tensor, the eager autograd tape, and op dispatch.
+
+TPU-native redesign of the reference's dygraph core
+(paddle/fluid/imperative/tracer.cc + basic_engine.cc and the pten kernel
+dispatch, paddle/pten/core/kernel_registry.h): instead of a C++ tracer
+recording GradOpNodes and a per-place kernel registry, every op is a pure
+JAX function executed eagerly on the device; when gradients are required we
+record a lightweight Python tape node whose VJP is derived *at backward
+time* via jax.vjp — so there is exactly one source of truth for op
+semantics (the forward jax function) and XLA differentiates it.
+
+The performance path does not use this tape at all: `paddle_tpu.jit` traces
+Layer.forward into a single jitted function and uses jax.value_and_grad
+(see jit/api.py), which is the idiomatic XLA formulation. The tape exists
+for Paddle dygraph UX parity (`loss.backward()`; `opt.step()`).
+"""
+import threading
+import weakref
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .dtype import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "Parameter", "apply_op", "no_grad", "enable_grad",
+           "set_grad_enabled", "is_grad_enabled", "to_tensor"]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled():
+    return _grad_state.enabled
+
+
+class set_grad_enabled:
+    """Context manager / function enabling or disabling tape recording."""
+
+    def __init__(self, mode):
+        self.prev = _grad_state.enabled
+        _grad_state.enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self.prev
+        return False
+
+
+class no_grad:
+    """paddle.no_grad parity: context manager and decorator."""
+
+    def __enter__(self):
+        self.prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self.prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+
+class _Slot:
+    """One immutable version of a tensor's value, a node in the grad DAG."""
+    __slots__ = ("val", "node", "tensor_ref", "grad", "__weakref__")
+
+    def __init__(self, val, node=None):
+        self.val = val
+        self.node = node          # _Node that produced it, None for leaves
+        self.tensor_ref = None    # weakref to owning Tensor
+        self.grad = None          # cotangent accumulated during backward
+
+
+class _Node:
+    """A recorded op: fn is a pure jax function over the diff inputs."""
+    __slots__ = ("fn", "in_slots", "out_slots", "multi")
+
+    def __init__(self, fn, in_slots, out_slots, multi=False):
+        self.fn = fn
+        self.in_slots = in_slots
+        self.out_slots = out_slots
+        self.multi = multi
+
+
+def _is_diff_dtype(arr):
+    return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(
+        arr.dtype, jnp.complexfloating)
+
+
+class Tensor:
+    """Eager tensor backed by a jax.Array.
+
+    Semantics follow the reference Tensor
+    (python/paddle/fluid/dygraph/varbase_patch_methods.py): user-created
+    tensors default to stop_gradient=True; Parameters default to False;
+    results of ops require grad iff any input does.
+    """
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data.value
+        if isinstance(data, _Slot):
+            self._slot = data
+        else:
+            dt = convert_dtype(dtype)
+            if isinstance(data, jax.Array) or type(data).__name__ == "ArrayImpl":
+                arr = data if dt is None else data.astype(dt)
+            else:
+                npd = np.asarray(data)
+                if dt is None and npd.dtype == np.float64:
+                    dt = get_default_dtype()
+                if dt is None and npd.dtype == np.int64:
+                    dt = np.dtype(np.int64)
+                arr = jnp.asarray(npd, dtype=dt)
+            self._slot = _Slot(arr)
+        self._slot.tensor_ref = weakref.ref(self)
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self.grad = None
+        self._retain_grad = False
+
+    # -- value plumbing -------------------------------------------------
+    @property
+    def value(self):
+        return self._slot.val
+
+    def _bind(self, slot):
+        """Point this Tensor at a new value version (in-place ops)."""
+        self._slot = slot
+        slot.tensor_ref = weakref.ref(self)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.value.shape)
+
+    @property
+    def ndim(self):
+        return self.value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.value.shape)) if self.value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self.value.dtype)
+
+    @property
+    def place(self):
+        try:
+            return str(next(iter(self.value.devices())))
+        except Exception:
+            return "tpu:0"
+
+    @property
+    def is_leaf(self):
+        return self._slot.node is None
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of a 0-D tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return (f"Tensor(shape={self.shape}, dtype={self.value.dtype}, "
+                f"place={self.place}, stop_gradient={sg},\n{self.numpy()})")
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("bool() of multi-element Tensor is ambiguous")
+        return bool(self.numpy().reshape(()))
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd -------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from ..autograd.backward_engine import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self.value, stop_gradient=True)
+        return t
+
+    def clone(self):
+        out = apply_op(lambda x: x + jnp.zeros((), x.dtype), self)
+        out.stop_gradient = self.stop_gradient
+        return out
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    def register_hook(self, hook):
+        if not hasattr(self, "_grad_hooks"):
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+        return hook
+
+    # -- mutation (functional under the hood) ---------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value.value
+        arr = jnp.asarray(np.asarray(value) if not isinstance(
+            value, jax.Array) else value, dtype=self.value.dtype)
+        if tuple(arr.shape) != tuple(self.value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self.value.shape}")
+        self._bind(_Slot(arr))
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, val):
+        idx = _unwrap_index(idx)
+        if isinstance(val, Tensor):
+            new = apply_op(
+                lambda x, v: x.at[idx].set(v.astype(x.dtype)), self, val)
+        else:
+            new = apply_op(lambda x: x.at[idx].set(val), self)
+        self._bind(new._slot)
+
+    # -- dtype / device -------------------------------------------------
+    def astype(self, dt):
+        dt = convert_dtype(dt)
+        return apply_op(lambda x: x.astype(dt), self)
+
+    cast = astype
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def tpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            try:
+                return self.astype(a)
+            except (TypeError, ValueError):
+                continue
+        if "dtype" in kwargs:
+            return self.astype(kwargs["dtype"])
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor. Parity: python/paddle/fluid/framework.py Parameter."""
+
+    _name_counter = [0]
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        if name is None:
+            Parameter._name_counter[0] += 1
+            name = f"param_{Parameter._name_counter[0]}"
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx.value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def _requires_grad(t):
+    return isinstance(t, Tensor) and not t.stop_gradient
+
+
+def apply_op(fn, *tensors, n_outputs=None):
+    """Execute a pure jax function over Tensor inputs; record tape if needed.
+
+    `fn` takes the unwrapped jax arrays positionally (non-tensor config must
+    be closed over by the caller) and returns one array or a tuple.
+    """
+    arrays = [t.value if isinstance(t, Tensor) else t for t in tensors]
+    out = fn(*arrays)
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+
+    record = _grad_state.enabled and any(
+        _requires_grad(t) and _is_diff_dtype(t.value)
+        for t in tensors if isinstance(t, Tensor))
+    # only differentiable outputs participate in the graph
+    record = record and any(_is_diff_dtype(o) for o in outs)
+
+    out_tensors = [Tensor(_Slot(o)) for o in outs]
+
+    if record:
+        diff_pos = [i for i, t in enumerate(tensors)
+                    if _requires_grad(t) and isinstance(t, Tensor)
+                    and _is_diff_dtype(t.value)]
+        const = {i: a for i, a in enumerate(arrays) if i not in diff_pos}
+
+        def baked_fn(*diff_args, _fn=fn, _dp=tuple(diff_pos), _const=const,
+                     _n=len(arrays)):
+            full = [None] * _n
+            for i, a in zip(_dp, diff_args):
+                full[i] = a
+            for i, a in _const.items():
+                full[i] = a
+            return _fn(*full)
+
+        in_slots = [tensors[i]._slot for i in diff_pos]
+        out_slots = [t._slot for t in out_tensors]
+        node = _Node(baked_fn, in_slots, out_slots, multi=multi)
+        for t in out_tensors:
+            t._slot.node = node
+            t.stop_gradient = False
+    return tuple(out_tensors) if multi else out_tensors[0]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py:to_tensor)."""
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else data.clone()
+        out.stop_gradient = stop_gradient
+        return out
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

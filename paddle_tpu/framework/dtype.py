@@ -1,0 +1,104 @@
+"""Dtype system.
+
+Parity: python/paddle/framework/dtype.py (reference). Paddle exposes dtype
+singletons (paddle.float32, ...) and string aliases; we map them onto numpy
+dtypes, which JAX consumes directly. float64/int64 are available but note
+that on TPU f64 is emulated; the default compute dtype is float32 with
+bfloat16 as the AMP-preferred type (TPU MXU-native).
+"""
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "dtype", "float16", "bfloat16", "float32", "float64", "int8", "int16",
+    "int32", "int64", "uint8", "bool_", "complex64", "complex128",
+    "set_default_dtype", "get_default_dtype", "convert_dtype", "iinfo", "finfo",
+]
+
+
+class dtype:
+    """A paddle-style dtype handle wrapping a numpy dtype."""
+
+    _registry = {}
+
+    def __init__(self, name, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        dtype._registry[name] = self
+        dtype._registry[self.np_dtype] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        try:
+            return self.np_dtype == convert_dtype(other)
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    @property
+    def is_floating_point(self):
+        return jnp.issubdtype(self.np_dtype, np.floating)
+
+
+float16 = dtype("float16", np.float16)
+bfloat16 = dtype("bfloat16", ml_dtypes.bfloat16)
+float32 = dtype("float32", np.float32)
+float64 = dtype("float64", np.float64)
+int8 = dtype("int8", np.int8)
+int16 = dtype("int16", np.int16)
+int32 = dtype("int32", np.int32)
+int64 = dtype("int64", np.int64)
+uint8 = dtype("uint8", np.uint8)
+bool_ = dtype("bool", np.bool_)
+complex64 = dtype("complex64", np.complex64)
+complex128 = dtype("complex128", np.complex128)
+
+_STR_ALIASES = {
+    "float16": np.float16, "fp16": np.float16, "half": np.float16,
+    "bfloat16": ml_dtypes.bfloat16, "bf16": ml_dtypes.bfloat16,
+    "float32": np.float32, "fp32": np.float32, "float": np.float32,
+    "float64": np.float64, "fp64": np.float64, "double": np.float64,
+    "int8": np.int8, "int16": np.int16, "int32": np.int32, "int64": np.int64,
+    "uint8": np.uint8, "bool": np.bool_,
+    "complex64": np.complex64, "complex128": np.complex128,
+}
+
+_default_dtype = np.dtype(np.float32)
+
+
+def convert_dtype(d):
+    """Normalize any dtype spec (paddle dtype, str, numpy, jnp) to np.dtype."""
+    if d is None:
+        return None
+    if isinstance(d, dtype):
+        return d.np_dtype
+    if isinstance(d, str):
+        if d in _STR_ALIASES:
+            return np.dtype(_STR_ALIASES[d])
+        raise ValueError(f"unsupported dtype string: {d!r}")
+    return np.dtype(d)
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if not (jnp.issubdtype(d, np.floating)):
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def iinfo(d):
+    return np.iinfo(convert_dtype(d))
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))

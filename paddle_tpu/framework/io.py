@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load. Parity: python/paddle/framework/io.py.
+
+State dicts (nested dict/list of Tensor) are converted to numpy and
+pickled. Layer state_dicts, optimizer state_dicts and arbitrary nested
+containers round-trip; large-model sharded checkpointing lives in
+paddle_tpu.distributed (orbax-backed).
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy(), isinstance(obj, Parameter),
+                              obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Parameter(obj.array, name=obj.name) if obj.is_param \
+            else Tensor(obj.array)
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    def __init__(self, array, is_param, name, stop_gradient):
+        self.array = np.asarray(array)
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj, configs.get("return_numpy", False))

@@ -1,0 +1,7 @@
+"""paddle.linalg namespace. Parity: python/paddle/linalg.py."""
+from .tensor.linalg import (matmul, dot, bmm, mv, mm, addmm, cross, norm,
+                            dist, cond, cholesky, cholesky_solve, qr, svd,
+                            eig, eigh, eigvals, eigvalsh, inv, pinv, solve,
+                            triangular_solve, lstsq, matrix_power,
+                            matrix_rank, det, slogdet, multi_dot, lu,
+                            lu_unpack, corrcoef, cov)

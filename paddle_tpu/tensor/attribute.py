@@ -1,0 +1,32 @@
+"""Tensor attribute ops. Parity: python/paddle/tensor/attribute.py."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.value.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.value.dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.value.dtype, jnp.floating)
+
+
+def real(x, name=None):
+    return apply_op(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply_op(jnp.imag, x)

@@ -1,0 +1,15 @@
+"""Einstein summation. Parity: python/paddle/tensor/einsum.py.
+
+jnp.einsum lowers directly to XLA dot_general — MXU-friendly by
+construction, so unlike the reference (which plans and decomposes into
+matmul/transpose ops: tensor/einsum.py:~800) we delegate planning to XLA.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import apply_op
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_op(lambda *xs: jnp.einsum(equation, *xs), *operands)

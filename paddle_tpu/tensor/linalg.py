@@ -1,0 +1,244 @@
+"""Linear algebra. Parity: python/paddle/tensor/linalg.py + paddle/linalg.py.
+
+matmul is THE op on TPU: it lowers to MXU systolic-array contractions.
+Decompositions (qr/svd/eig/...) lower to XLA's linalg lib (CPU/TPU).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(fn, x, y)
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+    return apply_op(fn, x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec)
+
+
+def mm(input, mat2, name=None):
+    return apply_op(jnp.matmul, input, mat2)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op(fn, x, y)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord="fro" if isinstance(ax, tuple)
+                                   else None, axis=ax, keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax,
+                           keepdims=keepdim)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.sum(jnp.abs(a) ** p, axis=ax,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply_op(fn, x)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op(fn, x, y)
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply_op(fn, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax.scipy.linalg as jsl
+    def fn(b, L):
+        return jsl.cho_solve((L, not upper), b)
+    return apply_op(fn, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def eig(x, name=None):
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a,
+                    symmetrize_input=True)), x)
+
+
+def eigvals(x, name=None):
+    return Tensor(np.linalg.eigvals(x.numpy()))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda a: jnp.linalg.eigvalsh(a), x)
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax.scipy.linalg as jsl
+    def fn(a, b):
+        return jsl.solve_triangular(a, b, lower=not upper,
+                                    trans=1 if transpose else 0,
+                                    unit_diagonal=unitriangular)
+    return apply_op(fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+    sol, res, rank, sv = apply_op(fn, x, y)
+    return sol, res, rank, sv
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    tv = tol.value if isinstance(tol, Tensor) else tol
+    return apply_op(lambda a: jnp.linalg.matrix_rank(a, rtol=tv), x)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        s, l = jnp.linalg.slogdet(a)
+        return jnp.stack([s, l]) if s.ndim == 0 else jnp.stack([s, l])
+    return apply_op(fn, x)
+
+
+def multi_dot(x, name=None):
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+    def fn(a):
+        lu_, piv = jsl.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    lu_, piv = apply_op(fn, x)
+    if get_infos:
+        from .creation import zeros
+        return lu_, piv, zeros([1], dtype="int32")
+    return lu_, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def fn(lu_, piv):
+        m = lu_.shape[-2]
+        L = jnp.tril(lu_, -1) + jnp.eye(m, lu_.shape[-1], dtype=lu_.dtype)
+        L = L[..., :, :m]
+        U = jnp.triu(lu_)[..., :m, :]
+        piv0 = piv - 1
+        perm = jnp.arange(m)
+        def body(i, p):
+            a, b = p[i], p[piv0[i]]
+            p = p.at[i].set(b)
+            return p.at[piv0[i]].set(a)
+        for i in range(m):
+            perm = body(i, perm)
+        P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+        return P, L, U
+    return apply_op(fn, x, y)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights.value if isinstance(fweights, Tensor) else fweights
+    aw = aweights.value if isinstance(aweights, Tensor) else aweights
+    return apply_op(lambda a: jnp.cov(a, rowvar=rowvar,
+                                      ddof=1 if ddof else 0,
+                                      fweights=fw, aweights=aw), x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = input.numpy()
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    h, _ = np.histogram(a, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(np.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights.numpy() if isinstance(weights, Tensor) else weights
+    return Tensor(np.bincount(x.numpy(), weights=w, minlength=minlength))

@@ -1,0 +1,42 @@
+"""Comparison & logical ops. Parity: python/paddle/tensor/logic.py."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from .math import _wrap_binary, _wrap_unary
+
+equal = _wrap_binary(lambda a, b: a == b)
+not_equal = _wrap_binary(lambda a, b: a != b)
+greater_than = _wrap_binary(lambda a, b: a > b)
+greater_equal = _wrap_binary(lambda a, b: a >= b)
+less_than = _wrap_binary(lambda a, b: a < b)
+less_equal = _wrap_binary(lambda a, b: a <= b)
+logical_and = _wrap_binary(jnp.logical_and)
+logical_or = _wrap_binary(jnp.logical_or)
+logical_xor = _wrap_binary(jnp.logical_xor)
+logical_not = _wrap_unary(jnp.logical_not)
+bitwise_and = _wrap_binary(jnp.bitwise_and)
+bitwise_or = _wrap_binary(jnp.bitwise_or)
+bitwise_xor = _wrap_binary(jnp.bitwise_xor)
+bitwise_not = _wrap_unary(jnp.bitwise_not)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,409 @@
+"""Shape/layout manipulation ops. Parity: python/paddle/tensor/manipulation.py."""
+import builtins
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+
+def _axes(a):
+    if a is None:
+        return None
+    if isinstance(a, Tensor):
+        a = a.tolist()
+    if isinstance(a, (list, tuple)):
+        return tuple(int(v) for v in a)
+    return int(a)
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    shape = _static_shape(shape)
+    return apply_op(lambda a: jnp.reshape(a, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._bind(out._slot)
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s0 = start_axis % nd if nd else 0
+        s1 = stop_axis % nd if nd else 0
+        new = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return a.reshape(new)
+    return apply_op(fn, x)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = _axes(axis)
+    def fn(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        axes = tuple(i % a.ndim for i in axes if a.shape[i % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op(fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axes(axis)
+    def fn(a):
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        out = a
+        for i in sorted(axes):
+            out = jnp.expand_dims(out, i)
+        return out
+    return apply_op(fn, x)
+
+
+unsqueeze_ = unsqueeze
+squeeze_ = squeeze
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply_op(lambda *xs: jnp.stack(xs, axis=axis), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    def fn(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [int(s) for s in num_or_sections]
+        total = a.shape[axis]
+        if any(s == -1 for s in secs):
+            known = sum(s for s in secs if s != -1)
+            secs = [total - known if s == -1 else s for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=axis))
+    return list(apply_op(fn, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    def fn(a):
+        return tuple(jnp.squeeze(p, axis=axis)
+                     for p in jnp.split(a, a.shape[axis], axis=axis))
+    return list(apply_op(fn, x))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = _static_shape(shape)
+    def fn(a):
+        tgt = tuple(a.shape[i - (len(shape) - a.ndim)] if s == -1 else s
+                    for i, s in enumerate(shape))
+        return jnp.broadcast_to(a, tgt)
+    return apply_op(fn, x)
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs)
+    return list(outs)
+
+
+def transpose(x, perm, name=None):
+    perm = _axes(perm)
+    return apply_op(lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    def fn(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return apply_op(fn, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(
+        lambda a: jnp.moveaxis(a, _axes(source), _axes(destination)), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.flip(a, axis=ax), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _axes(shifts)
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.roll(a, sh, axis=ax), x)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1
+                                          else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return a[flat_idx]
+    return apply_op(fn, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+    return apply_op(fn, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._bind(out._slot)
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, idx, u):
+        k = idx.shape[-1]
+        return a.at[tuple(idx[..., i] for i in range(k))].add(u)
+    return apply_op(fn, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+def index_sample(x, index):
+    def fn(a, i):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, i]
+    return apply_op(fn, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        idx = [slice(None)] * a.ndim
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[i].add(jnp.moveaxis(v, axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(fn, x, index, value)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (not jittable), same as reference
+    out = x.numpy()[np.asarray(mask.numpy(), dtype=bool)]
+    return Tensor(out)
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.value if isinstance(value, Tensor) else value
+    return apply_op(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                    x, mask)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                    arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "add":
+            return _put_along(a, i, v, axis, "add")
+        if reduce == "multiply" or reduce == "mul":
+            return _put_along(a, i, v, axis, "multiply")
+        return _put_along(a, i, v, axis, "assign")
+    if not isinstance(values, Tensor):
+        values = Tensor(np.asarray(values))
+    return apply_op(fn, arr, indices, values)
+
+
+def _put_along(a, idx, vals, axis, mode):
+    moved = jnp.moveaxis(a, axis, -1)
+    mi = jnp.moveaxis(idx, axis, -1)
+    mv = jnp.moveaxis(vals, axis, -1)
+    grid = jnp.indices(mi.shape)
+    index_tuple = tuple(grid[d] for d in range(mi.ndim - 1)) + (mi,)
+    if mode == "add":
+        out = moved.at[index_tuple].add(mv)
+    elif mode == "multiply":
+        out = moved.at[index_tuple].multiply(mv)
+    else:
+        out = moved.at[index_tuple].set(mv)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = np.unique(x.numpy(), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = x.numpy()
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+    else:
+        diff = np.any(np.diff(a, axis=axis) != 0,
+                      axis=tuple(i for i in range(a.ndim) if i != axis))
+        keep = np.concatenate([[True], diff])
+        a = np.compress(keep, x.numpy(), axis=axis)
+        return Tensor(a)
+    out = a[keep]
+    rets = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(inv))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(a)))
+        rets.append(Tensor(counts))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(i):
+        size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        ok = (i >= lo) & (i < hi)
+        return jnp.where(ok, i - lo, ignore_value)
+    return apply_op(fn, input)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats.numpy()
+        a = x.numpy()
+        return Tensor(np.repeat(a, reps, axis=axis))
+    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: a[..., 0] + 1j * a[..., 1], x)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a.tolist() if isinstance(a, Tensor) else a)
+                   if isinstance(a, (list, tuple, Tensor)) else a
+                   for a in ax)
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def slice(input, axes, starts, ends):
+    def val(v):
+        return int(v.item()) if isinstance(v, Tensor) else int(v)
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(val(s), val(e))
+        return a[tuple(idx)]
+    return apply_op(fn, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply_op(fn, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _static_shape(shape)
+    offs = [0] * len(shape) if offsets is None else [
+        int(o.item() if isinstance(o, Tensor) else o) for o in offsets]
+    def fn(a):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                    for i, (o, s) in enumerate(zip(offs, shape)))
+        return a[idx]
+    return apply_op(fn, x)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def fill_(x, value):
+    x._bind(apply_op(lambda a: jnp.full_like(a, value), x)._slot)
+    return x
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def fn(a):
+        n = min(a.shape[-2:])
+        i = jnp.arange(n - abs(offset))
+        r = i + (-offset if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        return a.at[..., r, c].set(value)
+    x._bind(apply_op(fn, x)._slot)
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)

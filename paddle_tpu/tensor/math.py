@@ -1,0 +1,370 @@
+"""Elementwise math, reductions, cumulative ops.
+
+Parity: python/paddle/tensor/math.py (reference). Every op is a pure jnp
+function dispatched through the eager tape (framework/core.py); under jit
+these trace straight into XLA HLO, which fuses elementwise chains into the
+surrounding matmuls (MXU) — no per-op kernels needed.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from ..framework.dtype import convert_dtype
+
+
+def _wrap_binary(jfn):
+    def op(x, y, name=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return apply_op(jfn, x, y)
+        if xt:
+            return apply_op(lambda a: jfn(a, y), x)
+        if yt:
+            return apply_op(lambda b: jfn(x, b), y)
+        return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
+    return op
+
+
+def _wrap_unary(jfn):
+    def op(x, name=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        return apply_op(jfn, x)
+    return op
+
+
+# -- elementwise binary -------------------------------------------------
+add = _wrap_binary(jnp.add)
+subtract = _wrap_binary(jnp.subtract)
+multiply = _wrap_binary(jnp.multiply)
+divide = _wrap_binary(jnp.divide)
+floor_divide = _wrap_binary(jnp.floor_divide)
+mod = _wrap_binary(jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _wrap_binary(jnp.power)
+maximum = _wrap_binary(jnp.maximum)
+minimum = _wrap_binary(jnp.minimum)
+fmax = _wrap_binary(jnp.fmax)
+fmin = _wrap_binary(jnp.fmin)
+atan2 = _wrap_binary(jnp.arctan2)
+logaddexp = _wrap_binary(jnp.logaddexp)
+heaviside = _wrap_binary(jnp.heaviside)
+hypot = _wrap_binary(jnp.hypot)
+copysign = _wrap_binary(jnp.copysign)
+nextafter = _wrap_binary(jnp.nextafter)
+gcd = _wrap_binary(jnp.gcd)
+lcm = _wrap_binary(jnp.lcm)
+ldexp = _wrap_binary(jnp.ldexp)
+
+# -- elementwise unary --------------------------------------------------
+abs = _wrap_unary(jnp.abs)
+exp = _wrap_unary(jnp.exp)
+expm1 = _wrap_unary(jnp.expm1)
+log = _wrap_unary(jnp.log)
+log2 = _wrap_unary(jnp.log2)
+log10 = _wrap_unary(jnp.log10)
+log1p = _wrap_unary(jnp.log1p)
+sqrt = _wrap_unary(jnp.sqrt)
+rsqrt = _wrap_unary(lambda x: 1.0 / jnp.sqrt(x))
+square = _wrap_unary(jnp.square)
+sign = _wrap_unary(jnp.sign)
+sin = _wrap_unary(jnp.sin)
+cos = _wrap_unary(jnp.cos)
+tan = _wrap_unary(jnp.tan)
+asin = _wrap_unary(jnp.arcsin)
+acos = _wrap_unary(jnp.arccos)
+atan = _wrap_unary(jnp.arctan)
+sinh = _wrap_unary(jnp.sinh)
+cosh = _wrap_unary(jnp.cosh)
+tanh = _wrap_unary(jnp.tanh)
+asinh = _wrap_unary(jnp.arcsinh)
+acosh = _wrap_unary(jnp.arccosh)
+atanh = _wrap_unary(jnp.arctanh)
+ceil = _wrap_unary(jnp.ceil)
+floor = _wrap_unary(jnp.floor)
+round = _wrap_unary(jnp.round)
+trunc = _wrap_unary(jnp.trunc)
+frac = _wrap_unary(lambda x: x - jnp.trunc(x))
+reciprocal = _wrap_unary(jnp.reciprocal)
+neg = _wrap_unary(jnp.negative)
+erf = _wrap_unary(lambda x: __import__("jax").scipy.special.erf(x))
+erfinv = _wrap_unary(lambda x: __import__("jax").scipy.special.erfinv(x))
+digamma = _wrap_unary(lambda x: __import__("jax").scipy.special.digamma(x))
+lgamma = _wrap_unary(lambda x: __import__("jax").scipy.special.gammaln(x))
+sigmoid = _wrap_unary(lambda x: __import__("jax").nn.sigmoid(x))
+angle = _wrap_unary(jnp.angle)
+conj = _wrap_unary(jnp.conj)
+real = _wrap_unary(jnp.real)
+imag = _wrap_unary(jnp.imag)
+deg2rad = _wrap_unary(jnp.deg2rad)
+rad2deg = _wrap_unary(jnp.rad2deg)
+i0 = _wrap_unary(jnp.i0)
+sinc = _wrap_unary(jnp.sinc)
+nan_to_num = _wrap_unary(jnp.nan_to_num)
+exp2 = _wrap_unary(jnp.exp2)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    sv = scale.value if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = apply_op(lambda a: a * sv + bias, x)
+    else:
+        out = apply_op(lambda a: (a + bias) * sv, x)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.value if isinstance(min, Tensor) else min
+    hi = max.value if isinstance(max, Tensor) else max
+    return apply_op(lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return stacked[idx.reshape(-1), jnp.arange(xs[0].shape[0])]
+    return apply_op(lambda *args: fn(args[-1], *args[:-1]),
+                    *(list(inputs) + [index]))
+
+
+# -- reductions ---------------------------------------------------------
+def _reduce(jfn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(axis)
+        dt = convert_dtype(dtype)
+        def fn(a):
+            out = jfn(a, axis=axis, keepdims=keepdim)
+            return out.astype(dt) if dt is not None else out
+        return apply_op(fn, x)
+    return op
+
+
+sum = _reduce(jnp.sum)
+nansum = _reduce(jnp.nansum)
+prod = _reduce(jnp.prod)
+mean = _reduce(jnp.mean)
+nanmean = _reduce(jnp.nanmean)
+amax = _reduce(jnp.max)
+amin = _reduce(jnp.min)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op(lambda a: jnp.max(a, axis=axis, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op(lambda a: jnp.min(a, axis=axis, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op(lambda a: jnp.all(a, axis=axis, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op(lambda a: jnp.any(a, axis=axis, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    import jax
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+        x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim), x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def fn(*xs):
+        out = xs[0]
+        for v in xs[1:]:
+            out = out + v
+        return out
+    return apply_op(fn, *inputs)
+
+
+# -- cumulative ---------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def fn(a):
+        if axis is None:
+            out = jnp.cumsum(a.reshape(-1))
+        else:
+            out = jnp.cumsum(a, axis=axis)
+        return out.astype(dt) if dt is not None else out
+    return apply_op(fn, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def fn(a):
+        out = jnp.cumprod(a, axis=dim)
+        return out.astype(dt) if dt is not None else out
+    return apply_op(fn, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(a):
+        flat = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = __import__("jax").lax.associative_scan(jnp.maximum, flat,
+                                                      axis=ax)
+        return vals
+    return apply_op(fn, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(a):
+        b = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        m = jnp.max(b, axis=ax, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(b - m), axis=ax)) + m
+    return apply_op(fn, x)
+
+
+# -- products / misc ----------------------------------------------------
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def inner(x, y, name=None):
+    def fn(a, b):
+        if a.ndim == 0 or b.ndim == 0:
+            return a * b
+        return jnp.tensordot(a, b, axes=[[-1], [-1]])
+    return apply_op(fn, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                           axis2=axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    p = prepend.value if isinstance(prepend, Tensor) else prepend
+    ap = append.value if isinstance(append, Tensor) else append
+    return apply_op(lambda a: jnp.diff(a, n=n, axis=axis, prepend=p,
+                                       append=ap), x)
+
+
+def gradient_op(x, *args, **kwargs):  # numpy-style gradient (rarely used)
+    return apply_op(lambda a: jnp.gradient(a, *args, **kwargs), x)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_op(lambda a: a + value, x)
+    x._bind(out._slot)
+    return x
+
+
+def isfinite(x, name=None):
+    return apply_op(jnp.isfinite, x)
+
+
+def isinf(x, name=None):
+    return apply_op(jnp.isinf, x)
+
+
+def isnan(x, name=None):
+    return apply_op(jnp.isnan, x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return apply_op(fn, x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            idx = idx % flat.shape[0]
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        return flat[idx.reshape(-1)].reshape(idx.shape)
+    return apply_op(fn, x, index)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op(lambda a, b: jnp.trapezoid(a, x=b, axis=axis), y, x)
+    return apply_op(
+        lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx, axis=axis), y)
+
+
+# in-place variants (Paddle `op_` style): rebind the tensor's slot
+def _inplace(op):
+    def ip(x, *a, **k):
+        out = op(x, *a, **k)
+        x._bind(out._slot)
+        return x
+    return ip
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)
+scale_ = _inplace(scale)
+clip_ = _inplace(clip)
+ceil_ = _inplace(ceil)
+floor_ = _inplace(floor)
+round_ = _inplace(round)
+exp_ = _inplace(exp)
+sqrt_ = _inplace(sqrt)
+rsqrt_ = _inplace(rsqrt)
+reciprocal_ = _inplace(reciprocal)
+tanh_ = _inplace(tanh)
+
+
+def zero_(x):
+    x._bind(apply_op(jnp.zeros_like, x)._slot)
+    return x

@@ -1,0 +1,108 @@
+"""Random ops. Parity: python/paddle/tensor/random.py.
+
+All draws go through framework.random.split_key(), i.e. the JAX functional
+PRNG threaded behind a paddle-style global seed (`paddle_tpu.seed`).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.random import split_key
+from .creation import _shape
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    return d if d is not None else (default or get_default_dtype())
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.value if isinstance(mean, Tensor) else mean
+        s = std.value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(split_key(), shp,
+                                                get_default_dtype()))
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(split_key(), shp,
+                                                 get_default_dtype()))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(split_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(split_key(), _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = split_key() if not seed else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max, seed)
+    x._bind(out._slot)
+    return x
+
+
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt(dtype, np.dtype(np.int64))
+    return Tensor(jax.random.randint(split_key(), _shape(shape), low, high,
+                                     dtype=jnp.int32).astype(d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(split_key(), n).astype(
+        _dt(dtype, np.dtype(np.int64))))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(split_key(), x.value).astype(x.dtype))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(split_key(), x.value).astype(x.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    out = jax.random.bernoulli(split_key(), p, tuple(x.shape))
+    x._bind(Tensor(out.astype(x.dtype))._slot)
+    return x
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    probs = x.value
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        out = jax.random.categorical(split_key(), logits,
+                                     shape=(num_samples,) + probs.shape[:-1]
+                                     if probs.ndim > 1 else (num_samples,))
+        if probs.ndim > 1:
+            out = jnp.moveaxis(out, 0, -1)
+        return Tensor(out.astype(jnp.int64) if out.dtype != jnp.int64
+                      else out)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(split_key(), probs.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx)
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = jax.random.exponential(split_key(), tuple(x.shape)) / lam
+    x._bind(Tensor(out.astype(x.value.dtype))._slot)
+    return x

@@ -1,0 +1,121 @@
+"""Search & sort ops. Parity: python/paddle/tensor/search.py."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim), x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis, descending=descending)
+        return idx
+    return apply_op(fn, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return apply_op(
+        lambda a: jnp.sort(a, axis=axis, descending=descending), x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    def fn(a):
+        ax = -1 if axis is None else axis
+        src = a if largest else -a
+        moved = jnp.moveaxis(src, ax, -1)
+        import jax
+        vals, idx = jax.lax.top_k(moved, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax))
+    v, i = apply_op(fn, x)
+    return v, i
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    xt = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    yt = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition, xt, yt)
+
+
+def where_(condition, x=None, y=None, name=None):
+    out = where(condition, x, y)
+    x._bind(out._slot)
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    nz = np.nonzero(x.numpy())
+    if as_tuple:
+        return tuple(Tensor(n.reshape(-1, 1)) for n in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        ids = jnp.take(idx, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            ids = jnp.expand_dims(ids, axis)
+        return vals, ids
+    return apply_op(fn, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = x.numpy()
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=a.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for r in range(flat.shape[0]):
+        u, c = np.unique(flat[r], return_counts=True)
+        best = u[np.argmax(c)]
+        vals[r] = best
+        idxs[r] = np.max(np.nonzero(flat[r] == best)[0])
+    shp = moved.shape[:-1]
+    vals, idxs = vals.reshape(shp), idxs.reshape(shp)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(vals), Tensor(idxs)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as ms
+    return ms(x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    def fn(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side)
+        import jax
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side)
+                        )(s.reshape(-1, s.shape[-1]),
+                          v.reshape(-1, v.shape[-1])).reshape(v.shape)
+    return apply_op(fn, sorted_sequence, values)
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as isel
+    return isel(x, index, axis)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

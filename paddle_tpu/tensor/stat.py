@@ -1,0 +1,55 @@
+"""Statistics ops. Parity: python/paddle/tensor/stat.py."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from .math import mean  # re-export for paddle.mean
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "numel"]
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.var(a, axis=_ax(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.std(a, axis=_ax(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.median(a, axis=_ax(axis),
+                                         keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanmedian(a, axis=_ax(axis),
+                                            keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    qv = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op(lambda a: jnp.quantile(a, qv, axis=_ax(axis),
+                                           keepdims=keepdim,
+                                           method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    qv = q.value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_op(lambda a: jnp.nanquantile(a, qv, axis=_ax(axis),
+                                              keepdims=keepdim,
+                                              method=interpolation), x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))

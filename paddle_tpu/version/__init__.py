@@ -1,0 +1,22 @@
+"""paddle.version parity (generated python/paddle/version.py in reference)."""
+full_version = "0.1.0"
+major, minor, patch = "0", "1", "0"
+rc = "0"
+commit = "tpu-native"
+with_mkl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = False
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -1,0 +1,20 @@
+"""Pin tests to the CPU backend with 8 virtual devices so distributed
+(mesh/sharding) tests run without real multi-chip hardware (SURVEY.md §4).
+
+jax may already be imported by the interpreter's sitecustomize (TPU tunnel
+registration), so setting env vars alone is not enough — we also flip the
+jax config before any backend initializes (first device use wins)."""
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
+assert jax.device_count() == 8, "expected 8 virtual CPU devices"

@@ -1,0 +1,138 @@
+"""Tape autograd semantics (SURVEY.md §2.2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def leaf(a):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32),
+                            stop_gradient=False)
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = leaf([1.0, 2.0, 3.0])
+        y = (x * x + 2 * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 2)
+
+    def test_branching(self):
+        x = leaf([2.0])
+        a = x * 3
+        b = x * 4
+        (a + b).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_matmul_grad(self):
+        rng = np.random.RandomState(0)
+        a_np = rng.rand(3, 4).astype(np.float32)
+        b_np = rng.rand(4, 2).astype(np.float32)
+        a, b = leaf(a_np), leaf(b_np)
+        paddle.matmul(a, b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(),
+                                   np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+        np.testing.assert_allclose(b.grad.numpy(),
+                                   a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+    def test_stop_gradient(self):
+        x = leaf([1.0])
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        z = x * y
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_grad_accumulation(self):
+        x = leaf([1.0])
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_detach(self):
+        x = leaf([3.0])
+        d = x.detach()
+        assert d.stop_gradient
+        y = x * x + d
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_grad(self):
+        x = leaf([1.0])
+        with paddle.no_grad():
+            y = x * 5
+        assert y.stop_gradient
+        z = x * 2
+        assert not z.stop_gradient
+
+    def test_non_scalar_backward_needs_grad_tensor(self):
+        x = leaf([1.0, 2.0])
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y2 = x * 2
+        y2.backward(grad_tensor=paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_int_inputs_no_record(self):
+        i = paddle.to_tensor(np.array([0, 1]), stop_gradient=False)
+        out = i + 1
+        assert out.stop_gradient  # integer path records nothing
+
+
+class TestGradAPI:
+    def test_grad_basic(self):
+        x = leaf([1.0, 2.0])
+        y = (x ** 2).sum()
+        (gx,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(gx.numpy(), 2 * x.numpy())
+        assert x.grad is None  # paddle.grad does not populate .grad
+
+    def test_grad_unused(self):
+        x, z = leaf([1.0]), leaf([1.0])
+        y = x * 2
+        with pytest.raises(ValueError):
+            paddle.grad(y, [z])
+        gx, gz = paddle.grad(x * 2, [x, z], allow_unused=True)
+        assert gz is None
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a * a
+
+            @staticmethod
+            def backward(ctx, g):
+                (a,) = ctx.saved_tensor
+                return g * 3 * a * a
+
+        x = leaf([2.0])
+        Cube.apply(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+class TestFunctional:
+    def test_vjp(self):
+        from paddle_tpu.autograd import vjp
+        x = leaf([1.0, 2.0])
+        out, g = vjp(lambda a: (a * a).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+        x = leaf([1.0, 2.0])
+        J = jacobian(lambda a: a * a, x)
+        np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        from paddle_tpu.autograd import hessian
+        x = leaf([1.0, 2.0])
+        H = hessian(lambda a: (a ** 3).sum(), x)
+        np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))

@@ -1,0 +1,275 @@
+"""Numeric parity of tensor ops vs numpy (SURVEY.md §4 — op_test model)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+        assert paddle.full([1], 7).dtype == np.int64
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(
+            paddle.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3,
+                                      dtype=np.float32))
+
+    def test_like(self):
+        x = t(np.ones((2, 2), np.float32))
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.full_like(x, 3).numpy().sum() == 12
+
+    def test_tril_triu_diag(self):
+        a = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_array_equal(paddle.tril(t(a)).numpy(), np.tril(a))
+        np.testing.assert_array_equal(paddle.triu(t(a)).numpy(), np.triu(a))
+        np.testing.assert_array_equal(
+            paddle.diag(t(np.array([1., 2.]))).numpy(), np.diag([1., 2.]))
+
+    def test_meshgrid(self):
+        x, y = paddle.meshgrid(t(np.arange(3.)), t(np.arange(2.)))
+        assert x.shape == [3, 2] and y.shape == [3, 2]
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        b = np.random.RandomState(1).rand(3, 4).astype(np.float32) + 0.5
+        for name, ref in [("add", a + b), ("subtract", a - b),
+                          ("multiply", a * b), ("divide", a / b),
+                          ("maximum", np.maximum(a, b)),
+                          ("pow", a ** b)]:
+            got = getattr(paddle, name)(t(a), t(b)).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_scalar_broadcast(self):
+        a = t([1.0, 2.0])
+        np.testing.assert_allclose((a + 1).numpy(), [2, 3])
+        np.testing.assert_allclose((3 - a).numpy(), [2, 1])
+        np.testing.assert_allclose((2 * a).numpy(), [2, 4])
+        np.testing.assert_allclose((1 / a).numpy(), [1, .5])
+
+    def test_unary(self):
+        a = np.random.RandomState(0).rand(10).astype(np.float32) + 0.1
+        for name, ref in [("exp", np.exp(a)), ("log", np.log(a)),
+                          ("sqrt", np.sqrt(a)), ("tanh", np.tanh(a)),
+                          ("floor", np.floor(a)), ("abs", np.abs(a)),
+                          ("rsqrt", 1 / np.sqrt(a)),
+                          ("sigmoid", 1 / (1 + np.exp(-a)))]:
+            np.testing.assert_allclose(getattr(paddle, name)(t(a)).numpy(),
+                                       ref, rtol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(a), axis=1).numpy(),
+                                   a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.max(t(a), axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(
+            paddle.prod(t(a), axis=-1, keepdim=True).numpy(),
+            a.prod(-1, keepdims=True), rtol=1e-4)
+
+    def test_cumsum_clip(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(),
+                                   a.cumsum(1))
+        np.testing.assert_allclose(paddle.clip(t(a), 1., 4.).numpy(),
+                                   a.clip(1, 4))
+
+    def test_logsumexp(self):
+        a = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+        from scipy.special import logsumexp as sls
+        np.testing.assert_allclose(
+            paddle.logsumexp(t(a), axis=1).numpy(), sls(a, axis=1),
+            rtol=1e-5)
+
+    def test_add_n(self):
+        xs = [t(np.full((2,), float(i), np.float32)) for i in range(3)]
+        np.testing.assert_allclose(paddle.add_n(xs).numpy(), [3, 3])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+        np.testing.assert_array_equal(
+            paddle.transpose(t(a), [2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+        assert paddle.flatten(t(a), 1, 2).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = np.ones((2, 3), np.float32)
+        c = paddle.concat([t(a), t(a * 2)], axis=0)
+        assert c.shape == [4, 3]
+        parts = paddle.split(c, 2, axis=0)
+        np.testing.assert_array_equal(parts[1].numpy(), a * 2)
+        parts = paddle.split(c, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+        s = paddle.stack([t(a), t(a)], axis=1)
+        assert s.shape == [2, 2, 3]
+
+    def test_squeeze_unsqueeze_tile(self):
+        a = np.ones((1, 3, 1), np.float32)
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.squeeze(t(a), axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t(a), [0, 4]).shape == [1, 1, 3, 1, 1]
+        assert paddle.tile(t(a), [2, 1, 2]).shape == [2, 3, 2]
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        np.testing.assert_array_equal(
+            paddle.gather(t(a), t(idx), axis=0).numpy(), a[idx])
+        upd = np.full((2, 3), 9, np.float32)
+        out = paddle.scatter(t(a), t(idx), t(upd))
+        assert out.numpy()[0, 0] == 9 and out.numpy()[2, 1] == 9
+
+    def test_gather_nd(self):
+        a = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+        idx = np.array([[0, 1], [1, 0]])
+        np.testing.assert_array_equal(
+            paddle.gather_nd(t(a), t(idx)).numpy(), a[[0, 1], [1, 0]])
+
+    def test_index_masked(self):
+        a = np.arange(6, dtype=np.float32)
+        mask = a > 2
+        np.testing.assert_array_equal(
+            paddle.masked_select(t(a), t(mask)).numpy(), a[mask])
+        np.testing.assert_array_equal(
+            paddle.index_select(t(a), t(np.array([1, 3]))).numpy(), a[[1, 3]])
+
+    def test_flip_roll(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(paddle.flip(t(a), [0]).numpy(),
+                                      a[::-1])
+        np.testing.assert_array_equal(paddle.roll(t(a), 1, 1).numpy(),
+                                      np.roll(a, 1, 1))
+
+    def test_getitem_setitem(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        x = t(a)
+        np.testing.assert_array_equal(x[1].numpy(), a[1])
+        np.testing.assert_array_equal(x[:, 1:3].numpy(), a[:, 1:3])
+        x[0, 0] = 100.0
+        assert x.numpy()[0, 0] == 100.0
+
+    def test_unique(self):
+        a = np.array([3, 1, 2, 1, 3])
+        np.testing.assert_array_equal(paddle.unique(t(a)).numpy(),
+                                      [1, 2, 3])
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(3, 4).astype(np.float32)
+        b = rng.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b,
+            rtol=1e-5)
+        c = rng.rand(2, 3, 4).astype(np.float32)
+        d = rng.rand(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.bmm(t(c), t(d)).numpy(), c @ d,
+                                   rtol=1e-5)
+
+    def test_norm_solve_inv(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        b = rng.rand(4, 2).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(t(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.norm(t(b)).numpy(),
+                                   np.linalg.norm(b), rtol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(4, 3).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(t(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vh.numpy(), a, atol=1e-4)
+        q, r = paddle.linalg.qr(t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = paddle.linalg.cholesky(t(spd)).numpy()
+        np.testing.assert_allclose(L @ L.T, spd, atol=1e-4)
+
+    def test_einsum(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(2, 3).astype(np.float32)
+        b = rng.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+            np.einsum("ij,jk->ik", a, b), rtol=1e-5)
+
+
+class TestLogicSearch:
+    def test_compare(self):
+        a, b = t([1.0, 2.0]), t([2.0, 2.0])
+        np.testing.assert_array_equal((a < b).numpy(), [True, False])
+        np.testing.assert_array_equal(
+            paddle.equal(a, b).numpy(), [False, True])
+        assert paddle.allclose(a, a).item()
+
+    def test_where_sort_topk(self):
+        a = np.array([3., 1., 2.])
+        np.testing.assert_array_equal(
+            paddle.where(t(a) > 1.5, t(a), t(np.zeros(3))).numpy(),
+            np.where(a > 1.5, a, 0))
+        np.testing.assert_array_equal(paddle.sort(t(a)).numpy(), np.sort(a))
+        np.testing.assert_array_equal(paddle.argsort(t(a)).numpy(),
+                                      np.argsort(a))
+        v, i = paddle.topk(t(a), 2)
+        np.testing.assert_array_equal(v.numpy(), [3., 2.])
+        np.testing.assert_array_equal(i.numpy(), [0, 2])
+
+    def test_argmax_nonzero(self):
+        a = np.array([[1., 5.], [7., 2.]])
+        assert paddle.argmax(t(a)).item() == 2
+        np.testing.assert_array_equal(paddle.argmax(t(a), axis=1).numpy(),
+                                      [1, 0])
+        nz = paddle.nonzero(t(np.array([0, 3, 0, 4])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+class TestStatRandom:
+    def test_stats(self):
+        a = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.std(t(a)).numpy(),
+                                   a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.var(t(a), axis=0).numpy(),
+                                   a.var(0, ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.median(t(a)).numpy(),
+                                   np.median(a), rtol=1e-5)
+
+    def test_random_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+        r = paddle.uniform([1000], min=0., max=1.).numpy()
+        assert 0 <= r.min() and r.max() <= 1 and abs(r.mean() - .5) < .05
+        p = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+    def test_dtype_system(self):
+        assert paddle.ones([1], dtype="float32").dtype == np.float32
+        assert paddle.ones([1], dtype=paddle.int32).dtype == np.int32
+        x = paddle.ones([1]).astype("bfloat16")
+        assert "bfloat16" in str(x.dtype)
