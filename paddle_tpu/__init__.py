@@ -22,6 +22,7 @@ from .framework import (Tensor, Parameter, to_tensor, no_grad, enable_grad,
                         complex128, set_default_dtype, get_default_dtype,
                         iinfo, finfo)
 from .framework.io import save, load
+from .framework.param_attr import ParamAttr
 from . import tensor
 from .tensor import *  # noqa: F401,F403 — paddle.* op surface
 from .tensor.creation import (to_tensor, zeros, ones, full, empty,

@@ -1,0 +1,109 @@
+"""Gradient clipping. Parity: python/paddle/fluid/clip.py."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """params_grads: list of (param, grad Tensor) pairs → clipped."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor(jnp.clip(g.value, self.min,
+                                               self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                n = jnp.sqrt(jnp.sum(jnp.square(
+                    g.value.astype(jnp.float32))))
+                factor = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12),
+                                     1.0)
+                out.append((p, Tensor((g.value * factor).astype(
+                    g.value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        with no_grad():
+            sq = 0.0
+            any_clip = False
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    continue
+                any_clip = True
+                sq = sq + jnp.sum(jnp.square(g.value.astype(jnp.float32)))
+            if not any_clip:
+                return params_grads
+            gn = jnp.sqrt(sq)
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(gn, 1e-12),
+                                 1.0)
+            out = []
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor((g.value * factor).astype(
+                    g.value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    with no_grad():
+        if norm_type == float("inf"):
+            total = max((jnp.max(jnp.abs(p.grad.value)) for p in params),
+                        default=0.0)
+        else:
+            total = sum(jnp.sum(jnp.abs(
+                p.grad.value.astype(jnp.float32)) ** norm_type)
+                for p in params) ** (1.0 / norm_type)
+        factor = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+        for p in params:
+            p.grad = Tensor((p.grad.value * factor).astype(
+                p.grad.value.dtype))
+    return Tensor(jnp.asarray(total))
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) \
+        else [parameters]
+    with no_grad():
+        for p in params:
+            if p.grad is not None:
+                p.grad = Tensor(jnp.clip(p.grad.value, -clip_value,
+                                         clip_value))

@@ -1,0 +1,192 @@
+"""Activation functionals. Parity: python/paddle/nn/functional/activation.py."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._bind(out._slot)
+    return x
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha=alpha), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha=alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply_op(jax.nn.log_sigmoid, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+        x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(
+        lambda a: jnp.where(a >= 0, a, negative_slope * a).astype(a.dtype), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a >= 0, a, wb * a).astype(a.dtype)
+    return apply_op(fn, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...framework.random import split_key
+    if training:
+        def fn(a):
+            r = jax.random.uniform(split_key(), a.shape, a.dtype, lower,
+                                   upper)
+            return jnp.where(a >= 0, a, r * a)
+        return apply_op(fn, x)
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op(fn, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._bind(out._slot)
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op(fn, x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)
+                            ).astype(a.dtype), x)
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, x)
+
+
+def swish(x, name=None):
+    return apply_op(jax.nn.silu, x)
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, x)
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, x)
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a, 0.0).astype(a.dtype), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op(fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op(fn, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import split_key
+    def fn(a):
+        g = jax.random.gumbel(split_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply_op(fn, x)

@@ -1,0 +1,91 @@
+"""Attention functionals.
+
+Parity: python/paddle/nn/functional/sparse_attention.py + the attention
+core of python/paddle/nn/layer/transformer.py. On TPU the hot path is the
+Pallas flash-attention kernel (paddle_tpu/ops/pallas/flash_attention.py);
+this module exposes the framework-level API and falls back to the XLA
+softmax(QK^T)V composition when the kernel is unavailable (CPU tests).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+
+def _sdpa_reference(q, k, v, mask=None, dropout_p=0.0, is_causal=False,
+                    scale=None):
+    # q,k,v: [B, T, H, D] (paddle layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # B,H,T,D
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) * s
+    if is_causal:
+        Tq, Tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """Flash attention on TPU; XLA reference composition elsewhere.
+
+    Layout follows paddle incubate fused attention: [batch, seq, heads, dim].
+    """
+    from ...ops import flash_attention_available, flash_attention
+
+    use_flash = (flash_attention_available() and dropout_p == 0.0
+                 and attn_mask is None)
+    if use_flash:
+        return flash_attention(query, key, value, causal=is_causal,
+                               scale=scale)
+
+    def fn(q, k, v, *rest):
+        m = rest[0] if rest else None
+        return _sdpa_reference(q, k, v, m, dropout_p, is_causal, scale)
+
+    args = [query, key, value]
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply_op(fn, *args)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention (reference: nn/functional/sparse_attention.py,
+    CUDA-only there). TPU design: we compute dense flash attention with the
+    sparsity pattern applied as a mask — XLA/Pallas tiles skip fully-masked
+    blocks. CSR pattern is converted to a dense boolean mask."""
+    if sparse_csr_offset is None:
+        return scaled_dot_product_attention(query, key, value,
+                                            attn_mask=attn_mask)
+
+    def fn(q, k, v, off, cols):
+        B, H, T, D = q.shape[0], q.shape[2], q.shape[1], q.shape[-1]
+        # build mask [B,H,T,T] from CSR rows
+        mask = jnp.zeros((off.shape[0], off.shape[1], T, T), bool)
+        import numpy as np
+        offn = np.asarray(off)
+        colsn = np.asarray(cols)
+        m = np.zeros(mask.shape, dtype=bool)
+        for b in range(offn.shape[0]):
+            for h in range(offn.shape[1]):
+                for r in range(T):
+                    lo, hi = offn[b, h, r], offn[b, h, r + 1]
+                    m[b, h, r, colsn[b, h, lo:hi]] = True
+        return _sdpa_reference(q, k, v, jnp.asarray(m))
+    return apply_op(fn, query, key, value, sparse_csr_offset,
+                    sparse_csr_columns)

@@ -1,0 +1,237 @@
+"""Common functionals: linear, dropout, padding, interpolate, etc.
+Parity: python/paddle/nn/functional/common.py."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+from ...framework.random import split_key
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shaped [in, out] (paddle layout). Pure MXU work."""
+    if bias is None:
+        return apply_op(lambda a, w: a @ w, x, weight)
+    return apply_op(lambda a, w, b: a @ w + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op(lambda a: a * (1.0 - p), x)
+        return x
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(split_key(), 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a_coef = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+    def fn(a):
+        keep = jax.random.bernoulli(split_key(), 1.0 - p, a.shape)
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:  # full per-dim spec (paddle "NCHW all dims")
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad covers spatial dims, reversed order
+            # (last dim first), like torch.nn.functional.pad
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C"):  # NHWC-style: spatial before C
+                spatial_axes = list(range(1, 1 + n_spatial))
+            else:
+                spatial_axes = list(range(nd - n_spatial, nd))
+            for i, ax in enumerate(reversed(spatial_axes)):
+                widths[ax] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply_op(fn, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op(fn, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bm,omn,bn->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    if bias is not None:
+        return apply_op(fn, x1, x2, weight, bias)
+    return apply_op(fn, x1, x2, weight)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    mode = mode.lower()
+    if isinstance(size, Tensor):
+        size = [int(v) for v in size.numpy()]
+    if size is not None and not isinstance(size, (list, tuple)):
+        size = [int(size)]
+    if scale_factor is not None and not isinstance(scale_factor,
+                                                   (list, tuple)):
+        scale_factor = [scale_factor] * (1 if size is None else len(size))
+
+    def fn(a):
+        channel_last = data_format.endswith("C")
+        nd = a.ndim
+        n_spatial = nd - 2
+        sp_axes = list(range(1, 1 + n_spatial)) if channel_last \
+            else list(range(2, nd))
+        in_sizes = [a.shape[i] for i in sp_axes]
+        if size is not None:
+            out_sizes = [int(s) for s in size]
+        else:
+            out_sizes = [int(round(s * f))
+                         for s, f in zip(in_sizes, scale_factor)]
+        out_shape = list(a.shape)
+        for ax, s in zip(sp_axes, out_sizes):
+            out_shape[ax] = s
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "trilinear": "linear", "linear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(a, out_shape, method=method
+                                    ).astype(a.dtype)
+        # align_corners: gather with exact corner-aligned coordinates
+        out = a
+        for ax, osz in zip(sp_axes, out_sizes):
+            isz = out.shape[ax]
+            if isz == osz:
+                continue
+            pos = jnp.linspace(0.0, isz - 1.0, osz)
+            lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, isz - 1)
+            hi = jnp.clip(lo + 1, 0, isz - 1)
+            w = (pos - lo).astype(a.dtype)
+            shape = [1] * out.ndim
+            shape[ax] = osz
+            w = w.reshape(shape)
+            out = jnp.take(out, lo, axis=ax) * (1 - w) + \
+                jnp.take(out, hi, axis=ax) * w
+        return out.astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def aslist(v, n=2):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+    k = aslist(kernel_sizes)
+    s = aslist(strides)
+    p = aslist(paddings) if isinstance(paddings, (list, tuple)) \
+        else [paddings] * 2
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    d = aslist(dilations)
+
+    def fn(a):
+        N, C, H, W = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = a[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                       j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # N,C,k0*k1,oh,ow
+        return out.reshape(N, C * k[0] * k[1], oh * ow)
+    return apply_op(fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def aslist(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 2
+    out_hw = aslist(output_sizes)
+    k = aslist(kernel_sizes)
+    s = aslist(strides)
+    p = aslist(paddings)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    d = aslist(dilations)
+
+    def fn(a):
+        N, CKK, L = a.shape
+        C = CKK // (k[0] * k[1])
+        H = out_hw[0] + p[0] + p[2]
+        W = out_hw[1] + p[1] + p[3]
+        oh = (H - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (W - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a4 = a.reshape(N, C, k[0], k[1], oh, ow)
+        out = jnp.zeros((N, C, H, W), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                             j * d[1]: j * d[1] + ow * s[1]: s[1]].add(
+                    a4[:, :, i, j])
+        return out[:, :, p[0]: H - p[2], p[1]: W - p[3]]
+    return apply_op(fn, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return apply_op(fn, label, prior_dist)
+    return apply_op(fn, label)

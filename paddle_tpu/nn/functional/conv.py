@@ -1,0 +1,186 @@
+"""Convolutions via lax.conv_general_dilated.
+Parity: python/paddle/nn/functional/conv.py.
+
+The reference dispatches to cudnn/im2col kernels (paddle/fluid/operators/
+conv_op.cc); on TPU, XLA lowers conv_general_dilated straight onto the MXU,
+so a single primitive covers conv1d/2d/3d, grouped, dilated and transposed
+convs for both NCHW and NHWC layouts.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import Tensor, apply_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        out = list(v)
+        if len(out) == 1:
+            out = out * n
+        return tuple(int(x) for x in out)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n:
+            return tuple((int(v), int(v)) for v in p)
+        if len(p) == 2 * n:  # [before0, after0, before1, after1...]
+            return tuple((int(p[2 * i]), int(p[2 * i + 1]))
+                         for i in range(n))
+        if len(p) == 1:
+            return tuple((int(p[0]), int(p[0]))) * n
+        # nested [[b,a],...]
+        return tuple((int(a), int(b)) for a, b in p)
+    return tuple((int(padding), int(padding)) for _ in range(n))
+
+
+def _dn(n, channel_last):
+    sp = "DHW"[3 - n:]
+    if channel_last:
+        lhs = "N" + sp + "C"
+    else:
+        lhs = "NC" + sp
+    rhs = "OI" + sp
+    return lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                      (lhs, rhs, lhs))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          channel_last):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+
+    def fn(a, w, *rest):
+        dn = lax.conv_dimension_numbers(
+            a.shape, w.shape,
+            (("N" + "DHW"[3 - n:] + "C") if channel_last
+             else ("NC" + "DHW"[3 - n:]),
+             "OI" + "DHW"[3 - n:],
+             ("N" + "DHW"[3 - n:] + "C") if channel_last
+             else ("NC" + "DHW"[3 - n:])))
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if a.dtype == jnp.bfloat16 else None)
+        out = out.astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply_op(fn, x, weight, bias)
+    return apply_op(fn, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format == "NLC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format == "NDHWC")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, channel_last, output_size):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    pad_arg = padding
+
+    def fn(a, w, *rest):
+        sp = "DHW"[3 - n:]
+        lhs_spec = ("N" + sp + "C") if channel_last else ("NC" + sp)
+        dn = lax.conv_dimension_numbers(
+            a.shape, (w.shape[1] * groups, w.shape[0] // groups)
+            + w.shape[2:], (lhs_spec, "OI" + sp, lhs_spec))
+        # gradient-of-conv formulation: transpose conv = lhs-dilated conv
+        if isinstance(pad_arg, str):
+            pads = pad_arg.upper()
+            raise NotImplementedError(
+                "string padding for conv_transpose unsupported")
+        p = _padding(pad_arg, n)
+        k = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(n)]
+        trans_pads = [(k[i] - 1 - p[i][0], k[i] - 1 - p[i][1] + opad[i])
+                      for i in range(n)]
+        # weight layout paddle: [in_c, out_c/groups, *k]; flip spatial and
+        # swap io for the equivalent forward conv
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            wt = jnp.swapaxes(wt, 0, 1)
+        else:
+            ci, co_g = w.shape[0], w.shape[1]
+            wt = wt.reshape((groups, ci // groups, co_g) + w.shape[2:])
+            wt = jnp.swapaxes(wt, 1, 2)
+            wt = wt.reshape((groups * co_g, ci // groups) + w.shape[2:])
+        out = lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=trans_pads,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups)
+        out = out.astype(a.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    out = apply_op(fn, x, weight, bias) if bias is not None \
+        else apply_op(fn, x, weight)
+    if output_size is not None:
+        want = list(output_size if isinstance(output_size, (list, tuple))
+                    else [output_size] * n)
+        sp_axes = list(range(1, 1 + n)) if channel_last \
+            else list(range(2, 2 + n))
+        cur = [out.shape[i] for i in sp_axes]
+        extra = [int(w) - int(c) for w, c in zip(want, cur)]
+        if any(e > 0 for e in extra):
+            widths = [(0, 0)] * len(out.shape)
+            for ax, e in zip(sp_axes, extra):
+                widths[ax] = (0, max(e, 0))
+            out = apply_op(lambda a: jnp.pad(a, widths), out)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC",
+                           output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC",
+                           output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC",
+                           output_size)

@@ -1,0 +1,46 @@
+"""Extension functionals. Parity: python/paddle/nn/functional/extension.py."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...framework.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    if maxlen is None:
+        maxlen = int(x.numpy().max())
+    ml = int(maxlen.item()) if isinstance(maxlen, Tensor) else int(maxlen)
+
+    def fn(lens):
+        r = jnp.arange(ml)
+        return (r[None, :] < lens[..., None]).astype(dt)
+    return apply_op(fn, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op(fn, x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    from ...tensor.creation import diag_embed as de
+    return de(x, offset, dim1, dim2)

@@ -1,0 +1,23 @@
+"""Input functionals: one_hot, embedding.
+Parity: python/paddle/nn/functional/input.py."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32), x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of `weight`; padding_idx rows emit zeros (and therefore
+    receive zero grad, matching reference embedding op semantics)."""
+    def fn(i, w):
+        out = jnp.take(w, i.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            pad = (i == padding_idx)[..., None]
+            out = jnp.where(pad, 0.0, out).astype(w.dtype)
+        return out
+    return apply_op(lambda i, w: fn(i, w), x, weight)

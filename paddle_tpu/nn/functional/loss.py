@@ -1,0 +1,339 @@
+"""Loss functionals. Parity: python/paddle/nn/functional/loss.py.
+
+cross_entropy fuses log_softmax+gather; the Pallas softmax-xent kernel in
+ops/pallas is substituted on the jit path for large vocab sizes.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def fn(logits, lab, *rest):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label:
+            tgt = lab.astype(jnp.float32)
+            if label_smoothing:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=bool)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:  # [N,...,1] style labels
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+            if label_smoothing:
+                k = logits.shape[axis]
+                smooth = -jnp.mean(logp, axis=axis)
+                loss = (1 - label_smoothing) * loss + label_smoothing * smooth
+            loss = jnp.where(valid, loss, 0.0)
+            if rest:  # per-class weights
+                w = rest[0].astype(jnp.float32)
+                wsel = jnp.where(valid, jnp.take(w, safe), 0.0)
+                loss = loss * wsel
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            n = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / n
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(fn, *args)
+
+
+softmax_with_cross_entropy = None  # defined below
+
+
+def _softmax_with_cross_entropy(logits, label, soft_label=False,
+                                ignore_index=-100, numeric_stable_mode=True,
+                                return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+softmax_with_cross_entropy = _softmax_with_cross_entropy
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fn(logp, lab, *rest):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        w_all = None
+        if rest:
+            w_all = jnp.take(rest[0], safe)
+            loss = loss * w_all
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, w_all, 0.0)) if w_all is not None \
+                else jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(fn, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fn(p, y, *rest):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fn(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(
+            jnp.exp(-jnp.abs(z32)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]
+            i += 1
+            logsig = jax.nn.log_sigmoid(z32)
+            logsig_neg = jax.nn.log_sigmoid(-z32)
+            base = -(pw * y32 * logsig + (1 - y32) * logsig_neg)
+        if weight is not None:
+            base = base * rest[i]
+        return _reduce(base, reduction)
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(pos_weight)
+    if weight is not None:
+        args.append(weight)
+    return apply_op(fn, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss * delta, reduction)
+    return apply_op(fn, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d,
+                         delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op(fn, input1, input2, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda a, y: _reduce(jnp.log1p(jnp.exp(-y * a)), reduction),
+        input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p,
+                           axis=-1) ** (1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce(loss, reduction)
+    return apply_op(fn, input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin,
+                                   swap=swap, reduction=reduction)
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        from ...tensor.math import minimum
+        d_neg = minimum(d_neg, distance_function(positive, negative))
+    from ...tensor.math import maximum as tmax
+    from ...tensor import mean as tmean, sum as tsum
+    loss = tmax(d_pos - d_neg + margin, Tensor(np.float32(0.0)))
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z.astype(jnp.float32))
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op(fn, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the classic alpha-recursion in log space (lax.scan over T).
+    Reference kernel: paddle/fluid/operators/warpctc_op.* (warp-ctc);
+    here it is a pure-XLA scan, jit-compatible."""
+    def fn(lp, lab, ilen, llen):
+        # lp: [T, N, C] log-softmaxed; paddle passes [T,N,C] logits
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1
+        # extended label seq: blank l1 blank l2 ... blank
+        ext_lab = jnp.full((N, ext), blank, dtype=jnp.int32)
+        ext_lab = ext_lab.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = -1e30
+        alpha0 = jnp.full((N, ext), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext_lab[:, 1:2], axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(llen > 0, first_lab, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool),
+             ext_lab[:, 2:] == ext_lab[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_prev2 = jnp.where(same_as_prev2, neg_inf, a_prev2)
+            m = jnp.maximum(jnp.maximum(alpha, a_prev1), a_prev2)
+            m_safe = jnp.maximum(m, neg_inf)
+            summed = jnp.exp(alpha - m_safe) + jnp.exp(a_prev1 - m_safe) + \
+                jnp.exp(a_prev2 - m_safe)
+            new = m_safe + jnp.log(summed)
+            emit = jnp.take_along_axis(lp_t, ext_lab, axis=1)
+            return new + emit, new + emit
+
+        alphaT, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        # stack alpha0 at t=0
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+        t_idx = jnp.clip(ilen - 1, 0, T - 1).astype(jnp.int32)
+        final = all_alphas[t_idx, jnp.arange(N)]  # [N, ext]
+        endpos = 2 * llen.astype(jnp.int32)
+        last_blank = jnp.take_along_axis(final, endpos[:, None], axis=1)[:, 0]
+        last_lab = jnp.take_along_axis(
+            final, jnp.maximum(endpos - 1, 0)[:, None], axis=1)[:, 0]
+        m = jnp.maximum(last_blank, last_lab)
+        ll = m + jnp.log(jnp.exp(last_blank - m) + jnp.exp(last_lab - m))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+        return _reduce(loss, reduction)
+    return apply_op(fn, log_probs, labels, input_lengths, label_lengths)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y):
+        B = a.shape[0]
+        sim = a @ p.T
+        y = y.reshape(-1, 1)
+        tgt = (y == y.T).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) +
+                        jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+    return apply_op(fn, anchor, positive, labels)

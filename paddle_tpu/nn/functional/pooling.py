@@ -1,0 +1,242 @@
+"""Pooling via lax.reduce_window. Parity: python/paddle/nn/functional/pooling.py."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import Tensor, apply_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        out = [int(x) for x in v]
+        return tuple(out * n) if len(out) == 1 else tuple(out)
+    return (int(v),) * n
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, op, ceil_mode=False,
+          exclusive=True, count_include_pad=False):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _tuple(padding, n) if not (isinstance(padding, (list, tuple))
+                                       and len(padding) == 2 * n) else None
+        if p is not None:
+            pads = [(v, v) for v in p]
+        else:
+            pads = [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                    for i in range(n)]
+
+    def fn(a):
+        nd = a.ndim
+        if channel_last:
+            sp_axes = list(range(1, 1 + n))
+        else:
+            sp_axes = list(range(2, nd))
+        dims = [1] * nd
+        strides = [1] * nd
+        for i, ax in enumerate(sp_axes):
+            dims[ax] = k[i]
+            strides[ax] = s[i]
+        if pad_mode is not None:
+            padding_cfg = pad_mode
+        else:
+            padding_cfg = [(0, 0)] * nd
+            for i, ax in enumerate(sp_axes):
+                lo, hi = pads[i]
+                if ceil_mode:
+                    isz = a.shape[ax]
+                    out = -(-(isz + lo + hi - k[i]) // s[i]) + 1
+                    need = (out - 1) * s[i] + k[i] - isz - lo
+                    hi = max(hi, need)
+                padding_cfg[ax] = (lo, hi)
+
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, dims, strides,
+                                     padding_cfg)
+        # avg
+        summed = lax.reduce_window(a, 0.0, lax.add,
+                                   dims, strides, padding_cfg)
+        if not exclusive:  # paddle exclusive=False == count_include_pad
+            return (summed / float(np.prod(k))).astype(a.dtype)
+        if (pad_mode == "VALID" or
+                (pads is not None and all(p == (0, 0) for p in pads))) \
+                and not ceil_mode:
+            denom = float(np.prod(k))
+            return (summed / denom).astype(a.dtype)
+        ones = jnp.ones(a.shape, a.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                   padding_cfg)
+        return (summed / counts).astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                "max", ceil_mode)
+    if return_mask:
+        return out, _pool_indices(x, out, kernel_size, stride, padding, 1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                "max", ceil_mode)
+    if return_mask:
+        return out, _pool_indices(x, out, kernel_size, stride, padding, 2)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                "max", ceil_mode)
+    if return_mask:
+        return out, _pool_indices(x, out, kernel_size, stride, padding, 3)
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "avg", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "avg", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "avg", ceil_mode, exclusive)
+
+
+def _pool_indices(x, out, kernel, stride, padding, n):
+    """Argmax indices for return_mask (flattened per-channel plane ids)."""
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+
+    def fn(a, o):
+        # brute-force via patches; only used when return_mask=True
+        pads = _tuple(padding, n)
+        widths = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+        ap = jnp.pad(a, widths, constant_values=-jnp.inf)
+        sp_in = a.shape[2:]
+        sp_out = o.shape[2:]
+        idx_grids = jnp.meshgrid(*[jnp.arange(v) for v in sp_in],
+                                 indexing="ij")
+        flat_pos = jnp.zeros(sp_in, dtype=jnp.int64)
+        mul = 1
+        for g in reversed(range(n)):
+            flat_pos = flat_pos + idx_grids[g] * mul
+            mul *= sp_in[g]
+        posp = jnp.pad(flat_pos, [(p, p) for p in pads],
+                       constant_values=-1)
+        patches_v, patches_i = [], []
+        for offs in np.ndindex(*k):
+            sl = tuple(slice(offs[d], offs[d] + sp_out[d] * s[d], s[d])
+                       for d in range(n))
+            patches_v.append(ap[(slice(None), slice(None)) + sl])
+            patches_i.append(posp[sl])
+        vs = jnp.stack(patches_v, axis=-1)
+        is_ = jnp.stack(patches_i, axis=-1)
+        sel = jnp.argmax(vs, axis=-1)
+        return jnp.take_along_axis(
+            jnp.broadcast_to(is_, vs.shape), sel[..., None], axis=-1
+        )[..., 0]
+    return apply_op(fn, x, out)
+
+
+def _adaptive_pool(x, output_size, n, channel_last, op):
+    if not isinstance(output_size, (list, tuple)):
+        output_size = [output_size] * n
+    out_sz = [int(v) if v is not None else None for v in output_size]
+
+    def fn(a):
+        sp_axes = list(range(1, 1 + n)) if channel_last \
+            else list(range(a.ndim - n, a.ndim))
+        out = a
+        for i, ax in enumerate(sp_axes):
+            tgt = out_sz[i]
+            if tgt is None or tgt == out.shape[ax]:
+                continue
+            isz = out.shape[ax]
+            if isz % tgt == 0:
+                k = isz // tgt
+                shape = out.shape[:ax] + (tgt, k) + out.shape[ax + 1:]
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=ax + 1) if op == "max" \
+                    else jnp.mean(r, axis=ax + 1)
+            else:
+                # general case: per-output-bin segments
+                starts = (np.arange(tgt) * isz) // tgt
+                ends = ((np.arange(tgt) + 1) * isz + tgt - 1) // tgt
+                segs = []
+                for st, en in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[ax] = slice(int(st), int(en))
+                    seg = out[tuple(sl)]
+                    red = jnp.max(seg, axis=ax) if op == "max" \
+                        else jnp.mean(seg, axis=ax)
+                    segs.append(red)
+                out = jnp.stack(segs, axis=ax)
+        return out.astype(a.dtype)
+    return apply_op(fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, "max")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    k = _tuple(kernel_size, 2)
+    s = _tuple(stride if stride is not None else kernel_size, 2)
+
+    def fn(a, idx):
+        N, C, H, W = a.shape
+        if output_size is not None:
+            oh, ow = int(output_size[-2]), int(output_size[-1])
+        else:
+            oh = (H - 1) * s[0] + k[0] - 2 * _tuple(padding, 2)[0]
+            ow = (W - 1) * s[1] + k[1] - 2 * _tuple(padding, 2)[1]
+        out = jnp.zeros((N, C, oh * ow), a.dtype)
+        flat = a.reshape(N, C, -1)
+        fidx = idx.reshape(N, C, -1)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, fidx, flat)
+        return out.reshape(N, C, oh, ow)
+    return apply_op(fn, x, indices)

@@ -1,0 +1,111 @@
+"""Vision functionals. Parity: python/paddle/nn/functional/vision.py."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            oc = C // (r * r)
+            out = a.reshape(N, oc, r, r, H, W)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(N, oc, H * r, W * r)
+        N, H, W, C = a.shape
+        oc = C // (r * r)
+        out = a.reshape(N, H, W, r, r, oc)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(N, H * r, W * r, oc)
+    return apply_op(fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, C, H // r, r, W // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H // r, r, W // r, r, C)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(N, H // r, W // r, C * r * r)
+    return apply_op(fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            out = a.reshape(N, groups, C // groups, H, W)
+            out = out.transpose(0, 2, 1, 3, 4)
+            return out.reshape(N, C, H, W)
+        N, H, W, C = a.shape
+        out = a.reshape(N, H, W, groups, C // groups)
+        out = out.transpose(0, 1, 2, 4, 3)
+        return out.reshape(N, H, W, C)
+    return apply_op(fn, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+
+    def fn(th):
+        N, C, H, W = [int(v) for v in out_shape]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # H,W,3
+        out = jnp.einsum("hwk,nik->nhwi", base, th.astype(jnp.float32))
+        return out.astype(th.dtype)
+    return apply_op(fn, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def fn(a, g):
+        N, C, H, W = a.shape
+        gx, gy = g[..., 0].astype(jnp.float32), g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def sample(ix, iy):
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            vals = a[jnp.arange(N)[:, None, None], :, iyc, ixc]
+            if padding_mode == "zeros":
+                vals = jnp.where(inb[..., None], vals, 0.0)
+            return vals  # N,Hg,Wg,C
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (sample(x0, y0) * (1 - wx) * (1 - wy) +
+                   sample(x1, y0) * wx * (1 - wy) +
+                   sample(x0, y1) * (1 - wx) * wy +
+                   sample(x1, y1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1).astype(a.dtype)
+    return apply_op(fn, x, grid)

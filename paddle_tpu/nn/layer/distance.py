@@ -1,0 +1,24 @@
+"""Distance layers. Parity: python/paddle/nn/layer/distance.py."""
+import jax.numpy as jnp
+
+from ...framework.core import apply_op
+from .layers import Layer
+
+__all__ = ["PairwiseDistance"]
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        def fn(a, b):
+            d = a - b + eps
+            return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                           keepdims=keep) ** (1.0 / p)
+        return apply_op(fn, x, y)

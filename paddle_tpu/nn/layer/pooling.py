@@ -1,0 +1,106 @@
+"""Pooling layers. Parity: python/paddle/nn/layer/pooling.py."""
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D", "MaxUnPool2D"]
+
+
+class _PoolNd(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+
+class AvgPool1D(_PoolNd):
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
+
+
+class AvgPool2D(_PoolNd):
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
+
+
+class AvgPool3D(_PoolNd):
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
+
+
+class MaxPool1D(_PoolNd):
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
+
+
+class MaxPool2D(_PoolNd):
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
+
+
+class MaxPool3D(_PoolNd):
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            **self.kwargs)
+
+
+class _AdaptivePoolNd(Layer):
+    def __init__(self, output_size, **kwargs):
+        super().__init__()
+        self.output_size = output_size
+        self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+
+class AdaptiveAvgPool1D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, **self.kwargs)
+
+
+class AdaptiveAvgPool3D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, **self.kwargs)
+
+
+class AdaptiveMaxPool1D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.data_format,
+                              self.output_size)

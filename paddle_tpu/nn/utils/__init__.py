@@ -1,0 +1,146 @@
+"""nn.utils. Parity: python/paddle/nn/utils/."""
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, no_grad
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p.value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    with no_grad():
+        for p in parameters:
+            n = p.size
+            p.set_value(vec.value[offset:offset + n].reshape(p.shape))
+            offset += n
+
+
+class _WeightNormHook:
+    """Reparameterize weight = g * v / ||v|| via a forward-pre hook
+    (reference: python/paddle/nn/utils/weight_norm_hook.py)."""
+
+    def __init__(self, layer, name, dim):
+        self.name = name
+        self.dim = dim
+        w = getattr(layer, name)
+        from ...framework.core import Parameter
+        wv = w.value
+        norm = self._norm(wv)
+        g = Parameter(norm, name=(w.name or name) + "_g")
+        v = Parameter(wv, name=(w.name or name) + "_v")
+        del layer._parameters[name]
+        layer.add_parameter(name + "_g", g)
+        layer.add_parameter(name + "_v", v)
+        self._compute(layer)
+
+    def _norm(self, wv):
+        if self.dim is None:
+            return jnp.sqrt(jnp.sum(jnp.square(wv))).reshape(())
+        axes = tuple(i for i in range(wv.ndim) if i != self.dim)
+        return jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes))
+
+    def _compute(self, layer):
+        from ...framework.core import apply_op
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        dim = self.dim
+
+        def fn(gv, vv):
+            if dim is None:
+                n = jnp.sqrt(jnp.sum(jnp.square(vv)))
+                return gv * vv / jnp.maximum(n, 1e-12)
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            n = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return gv.reshape(shape) * vv / jnp.maximum(n, 1e-12)
+        w = apply_op(fn, g, v)
+        object.__setattr__(layer, "_wn_cached_" + self.name, w)
+
+    def __call__(self, layer, inputs):
+        self._compute(layer)
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    hook = _WeightNormHook(layer, name, dim)
+    helper = layer.register_forward_pre_hook(hook)
+    layer._wn_helper = helper
+    layer._wn_hook = hook
+
+    # route attribute access for `name` to the cached computed weight
+    cls = type(layer)
+    if not getattr(cls, "_wn_patched", False):
+        orig_getattr = cls.__getattr__
+
+        def patched(self, attr):
+            if attr.startswith("_"):
+                return orig_getattr(self, attr)
+            hook_obj = self.__dict__.get("_wn_hook")
+            if hook_obj is not None and attr == hook_obj.name:
+                cached = self.__dict__.get("_wn_cached_" + attr)
+                if cached is not None:
+                    return cached
+            return orig_getattr(self, attr)
+        cls.__getattr__ = patched
+        cls._wn_patched = True
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook = layer.__dict__.get("_wn_hook")
+    if hook is None:
+        return layer
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    hook._compute(layer)
+    w = layer.__dict__["_wn_cached_" + name]
+    from ...framework.core import Parameter
+    layer._wn_helper.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.__dict__.pop("_wn_cached_" + name, None)
+    layer.__dict__.pop("_wn_hook", None)
+    layer.add_parameter(name, Parameter(w.value))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm as SNLayer
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SNLayer(w.shape, dim=dim, power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer("_spectral_norm", sn)
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        with no_grad():
+            pass
+        normalized = sn(getattr(layer, name + "_orig"))
+        object.__setattr__(layer, "_sn_cached", normalized)
+        return orig_forward(*args, **kwargs)
+
+    from ...framework.core import Parameter
+    layer.add_parameter(name + "_orig", Parameter(w.value))
+    del layer._parameters[name]
+    cls = type(layer)
+    orig_getattr = cls.__getattr__
+
+    def patched(self, attr):
+        if attr == name and "_sn_cached" in self.__dict__:
+            return self.__dict__["_sn_cached"]
+        if attr == name:
+            return sn(orig_getattr(self, name + "_orig"))
+        return orig_getattr(self, attr)
+    cls.__getattr__ = patched
+    layer.forward = forward
+    return layer

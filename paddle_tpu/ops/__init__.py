@@ -1,0 +1,42 @@
+"""paddle_tpu.ops — hand-written Pallas TPU kernels for the hot paths
+(SURVEY.md §6): flash attention, fused layer_norm, softmax-cross-entropy.
+
+Kernels run natively on TPU; on CPU (tests) they run in Pallas interpret
+mode or fall back to the XLA composition.
+"""
+import os
+
+import jax
+
+_FLASH_ENV = os.environ.get("PADDLE_TPU_FLASH", "auto")
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() in ("tpu",)
+    except Exception:
+        return False
+
+
+def flash_attention_available():
+    if _FLASH_ENV == "0":
+        return False
+    try:
+        from .pallas import flash_attention as _  # noqa
+        return _on_tpu() or _FLASH_ENV == "interpret"
+    except Exception:
+        return False
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    from .pallas.flash_attention import flash_attention as fa
+    return fa(q, k, v, causal=causal, scale=scale)
+
+
+def fused_layer_norm_available():
+    return _on_tpu()
+
+
+def fused_layer_norm(x, weight, bias, eps=1e-5):
+    from .pallas.layer_norm import layer_norm as ln
+    return ln(x, weight, bias, eps)

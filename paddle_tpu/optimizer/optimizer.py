@@ -1,0 +1,376 @@
+"""Optimizers. Parity: python/paddle/optimizer/*.py.
+
+Each optimizer defines a pure functional update core
+(`_init_state` / `_update`) over jax arrays; the eager `step()` walks
+parameters applying it, and the jit trainer (paddle_tpu.jit) calls
+`apply_gradients` on whole pytrees inside a single compiled step — the
+same math, fused by XLA. Master-weight (multi_precision) fp32 copies are
+kept for bf16/fp16 params, mirroring the reference's multi-precision adam
+(paddle/fluid/operators/optimizers/adam_op.h).
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter, no_grad
+from ..regularizer import WeightDecayRegularizer, L2Decay
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if parameters is not None and isinstance(parameters, (list, tuple)) \
+                and len(parameters) and isinstance(parameters[0], dict):
+            self._param_groups = [dict(g) for g in parameters]
+            self._parameters = [p for g in self._param_groups
+                                for p in g["params"]]
+        else:
+            self._param_groups = None
+            self._parameters = list(parameters) if parameters is not None \
+                else []
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float):
+            self._regularization = L2Decay(weight_decay)
+        else:
+            self._regularization = weight_decay  # regularizer or None
+        self._states = {}
+        self._step_count = 0
+        self._accumulators = {}
+
+    # -- lr ------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the lr is an LRScheduler instance")
+        self._learning_rate = float(value)
+
+    def _lr_for(self, p):
+        return self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
+            if isinstance(p, Parameter) else self.get_lr()
+
+    # -- functional core (override in subclasses) -----------------------
+    def _init_state(self, p_val):
+        return ()
+
+    def _update(self, p, g, state, lr, step):
+        raise NotImplementedError
+
+    def _decoupled_decay_coeff(self):
+        return 0.0
+
+    # -- eager path -----------------------------------------------------
+    def _ensure_state(self, p):
+        if id(p) not in self._states:
+            val = p.value
+            master = val.astype(jnp.float32) if (
+                self._multi_precision and val.dtype != jnp.float32) else None
+            self._states[id(p)] = [self._init_state(
+                val.astype(jnp.float32) if master is not None else val),
+                master]
+        return self._states[id(p)]
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        pg = [(p, p.grad) for p in self._parameters
+              if p.grad is not None and p.trainable]
+        # coupled regularization (L1/L2Decay): add dR/dw to the gradient,
+        # per-param regularizer wins over the global one (reference
+        # semantics: fluid/regularizer.py append_regularization_ops)
+        fixed = []
+        for p, g in pg:
+            reg = p.regularizer if getattr(p, "regularizer", None) \
+                is not None else self._regularization
+            if isinstance(reg, WeightDecayRegularizer) and \
+                    not isinstance(self, AdamW):
+                g = Tensor(g.value + reg.grad_term(
+                    p.value.astype(g.value.dtype)))
+            fixed.append((p, g))
+        pg = fixed
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        for p, g in pg:
+            state_box = self._ensure_state(p)
+            state, master = state_box
+            work = master if master is not None else p.value
+            gval = g.value.astype(work.dtype)
+            lr = self._lr_for(p)
+            wd = self._decoupled_decay_coeff()
+            if wd and self._decay_applies(p):
+                work = work * (1.0 - lr * wd)
+            new_p, new_state = self._update(work, gval, state, lr,
+                                            self._step_count)
+            state_box[0] = new_state
+            if master is not None:
+                state_box[1] = new_p
+                p.set_value(new_p.astype(p.value.dtype))
+            else:
+                p.set_value(new_p)
+
+    def _decay_applies(self, p):
+        apply_fn = getattr(self, "_apply_decay_param_fun", None)
+        if apply_fn is None:
+            return True
+        return apply_fn(p.name)
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameters]
+
+    # -- state dict ----------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        for i, p in enumerate(self._parameters):
+            if id(p) in self._states:
+                state, master = self._states[id(p)]
+                out[f"state_{i}"] = [Tensor(s) for s in state]
+                if master is not None:
+                    out[f"master_{i}"] = Tensor(master)
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step", 0))
+        for i, p in enumerate(self._parameters):
+            key = f"state_{i}"
+            if key in state_dict:
+                state = tuple(t.value for t in state_dict[key])
+                master = state_dict.get(f"master_{i}")
+                self._states[id(p)] = [
+                    state, master.value if master is not None else None]
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    set_dict = set_state_dict
+
+    # -- functional API for the jit path --------------------------------
+    def init_tree_state(self, params_tree):
+        import jax
+        return jax.tree.map(lambda v: self._init_state(v), params_tree,
+                            is_leaf=lambda x: hasattr(x, "dtype"))
+
+    def apply_gradients_tree(self, params_tree, grads_tree, state_tree, lr,
+                             step):
+        """Pure: returns (new_params, new_state). Call under jit."""
+        import jax
+        wd = self._decoupled_decay_coeff()
+
+        def upd(p, g, s):
+            w = p
+            if wd:
+                w = w * (1.0 - lr * wd)
+            return self._update(w, g.astype(p.dtype), s, lr, step)
+
+        flat_p, treedef = jax.tree.flatten(params_tree)
+        flat_g = treedef.flatten_up_to(grads_tree)
+        flat_s = treedef.flatten_up_to(state_tree)
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return treedef.unflatten(new_p), treedef.unflatten(new_s)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _update(self, p, g, state, lr, step):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, v):
+        return (jnp.zeros_like(v),)
+
+    def _update(self, p, g, state, lr, step):
+        (vel,) = state
+        vel = self._momentum * vel + g
+        if self._nesterov:
+            p = p - lr * (g + self._momentum * vel)
+        else:
+            p = p - lr * vel
+        return p, (vel,)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, v):
+        return (jnp.zeros_like(v), jnp.zeros_like(v))
+
+    def _update(self, p, g, state, lr, step):
+        m, v = state
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * (1 - b2 ** step) ** 0.5 / (1 - b1 ** step)
+        p = p - lr_t * m / (jnp.sqrt(v) + eps)
+        return p, (m, v)
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) \
+            else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_decay_coeff(self):
+        return self._coeff
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, v):
+        return (jnp.zeros_like(v), jnp.zeros_like(v))
+
+    def _update(self, p, g, state, lr, step):
+        m, u = state
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * m + (1 - b1) * g
+        u = jnp.maximum(b2 * u, jnp.abs(g))
+        p = p - lr / (1 - b1 ** step) * m / (u + eps)
+        return p, (m, u)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, v):
+        return (jnp.full_like(v, self._init_acc),)
+
+    def _update(self, p, g, state, lr, step):
+        (acc,) = state
+        acc = acc + g * g
+        p = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return p, (acc,)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, v):
+        return (jnp.zeros_like(v), jnp.zeros_like(v))
+
+    def _update(self, p, g, state, lr, step):
+        acc_g, acc_x = state
+        rho, eps = self._rho, self._epsilon
+        acc_g = rho * acc_g + (1 - rho) * g * g
+        upd = jnp.sqrt(acc_x + eps) / jnp.sqrt(acc_g + eps) * g
+        acc_x = rho * acc_x + (1 - rho) * upd * upd
+        return p - lr * upd, (acc_g, acc_x)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, v):
+        return (jnp.zeros_like(v), jnp.zeros_like(v), jnp.zeros_like(v))
+
+    def _update(self, p, g, state, lr, step):
+        ms, mg, mom = state
+        rho, eps = self._rho, self._epsilon
+        ms = rho * ms + (1 - rho) * g * g
+        if self._centered:
+            mg = rho * mg + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * mom + lr * g / denom
+        return p - mom, (ms, mg, mom)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, False,
+                         name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, v):
+        return (jnp.zeros_like(v), jnp.zeros_like(v))
+
+    def _update(self, p, g, state, lr, step):
+        m, v = state
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** step)
+        v_hat = v / (1 - b2 ** step)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + self._wd * p
+        p_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr * ratio * r, (m, v)

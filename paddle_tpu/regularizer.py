@@ -1,0 +1,23 @@
+"""Weight decay regularizers. Parity: python/paddle/regularizer.py."""
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+    def grad_term(self, param_value):
+        """Extra gradient contribution dR/dw."""
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def grad_term(self, param_value):
+        return self._coeff * jnp.sign(param_value)
+
+
+class L2Decay(WeightDecayRegularizer):
+    def grad_term(self, param_value):
+        return self._coeff * param_value

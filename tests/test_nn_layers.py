@@ -1,0 +1,347 @@
+"""Layer semantics tests (SURVEY.md §2.3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestLinearEmbedding:
+    def test_linear_math(self):
+        lin = nn.Linear(3, 2)
+        w = np.arange(6, dtype=np.float32).reshape(3, 2)
+        b = np.array([1.0, -1.0], np.float32)
+        lin.weight.set_value(w)
+        lin.bias.set_value(b)
+        x = np.ones((4, 3), np.float32)
+        np.testing.assert_allclose(lin(t(x)).numpy(), x @ w + b, rtol=1e-6)
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(5, 3, padding_idx=0)
+        assert np.all(emb.weight.numpy()[0] == 0)
+        out = emb(t(np.array([0, 2])))
+        assert np.all(out.numpy()[0] == 0)
+
+    def test_embedding_grad_rows(self):
+        emb = nn.Embedding(5, 3)
+        idx = t(np.array([1, 1, 3]))
+        emb(idx).sum().backward()
+        g = emb.weight.grad.numpy()
+        assert np.all(g[1] == 2.0) and np.all(g[3] == 1.0)
+        assert np.all(g[0] == 0.0)
+
+
+class TestConv:
+    def test_conv2d_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 8, 8).astype(np.float32)
+        w = rng.rand(5, 3, 3, 3).astype(np.float32)
+        b = rng.rand(5).astype(np.float32)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                        stride=2, padding=1).numpy()
+        got = F.conv2d(t(x), t(w), t(b), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_groups_dilation(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 4, 10, 10).astype(np.float32)
+        w = rng.rand(8, 2, 3, 3).astype(np.float32)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), None, padding=2,
+                        dilation=2, groups=2).numpy()
+        got = F.conv2d(t(x), t(w), None, padding=2, dilation=2,
+                       groups=2).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 4, 5, 5).astype(np.float32)
+        w = rng.rand(4, 6, 3, 3).astype(np.float32)
+        ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, padding=1).numpy()
+        got = F.conv2d_transpose(t(x), t(w), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_conv1d(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 3, 12).astype(np.float32)
+        w = rng.rand(4, 3, 3).astype(np.float32)
+        ref = tF.conv1d(torch.tensor(x), torch.tensor(w), padding=1).numpy()
+        got = F.conv1d(t(x), t(w), padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestNorm:
+    def test_layer_norm_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 5, 8).astype(np.float32)
+        w = rng.rand(8).astype(np.float32)
+        b = rng.rand(8).astype(np.float32)
+        ref = tF.layer_norm(torch.tensor(x), [8], torch.tensor(w),
+                            torch.tensor(b)).numpy()
+        got = F.layer_norm(t(x), [8], t(w), t(b)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_running_stats(self):
+        bn = nn.BatchNorm1D(4, momentum=0.9, data_format="NCL")
+        x = t(np.random.RandomState(0).rand(8, 4, 6).astype(np.float32))
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        y1 = bn(x).numpy()
+        y2 = bn(x).numpy()
+        np.testing.assert_allclose(y1, y2)
+
+    def test_batch_norm_eval_math(self):
+        bn = nn.BatchNorm2D(3)
+        bn.eval()
+        x = np.random.RandomState(1).rand(2, 3, 4, 4).astype(np.float32)
+        got = bn(t(x)).numpy()
+        np.testing.assert_allclose(got, x / np.sqrt(1 + 1e-5), rtol=1e-5)
+
+    def test_group_norm_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(2)
+        x = rng.rand(2, 6, 4, 4).astype(np.float32)
+        ref = tF.group_norm(torch.tensor(x), 3).numpy()
+        got = F.group_norm(t(x), 3).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_instance_norm_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 3, 5, 5).astype(np.float32)
+        ref = tF.instance_norm(torch.tensor(x)).numpy()
+        got = F.instance_norm(t(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name,torch_name", [
+        ("relu", "relu"), ("gelu", "gelu"), ("silu", "silu"),
+        ("mish", "mish"), ("relu6", "relu6"), ("hardswish", "hardswish"),
+        ("softplus", "softplus"), ("elu", "elu"), ("selu", "selu"),
+        ("leaky_relu", "leaky_relu"),
+    ])
+    def test_vs_torch(self, name, torch_name):
+        import torch
+        import torch.nn.functional as tF
+        x = np.linspace(-3, 3, 31).astype(np.float32)
+        ref = getattr(tF, torch_name)(torch.tensor(x)).numpy()
+        got = getattr(F, name)(t(x)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_softmax_logsoftmax(self):
+        from scipy.special import softmax as ssoftmax, log_softmax as sls
+        x = np.random.RandomState(0).rand(3, 5).astype(np.float32)
+        np.testing.assert_allclose(F.softmax(t(x)).numpy(),
+                                   ssoftmax(x, -1), rtol=1e-5)
+        np.testing.assert_allclose(F.log_softmax(t(x)).numpy(),
+                                   sls(x, -1), rtol=1e-5)
+
+    def test_glu_maxout(self):
+        x = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+        out = F.glu(t(x)).numpy()
+        assert out.shape == (4, 3)
+        xm = np.random.RandomState(0).rand(2, 6, 3).astype(np.float32)
+        assert F.maxout(t(xm), 2, axis=1).shape == [2, 3, 3]
+
+
+class TestLoss:
+    def test_cross_entropy_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        logits = rng.rand(6, 10).astype(np.float32)
+        labels = rng.randint(0, 10, size=(6,))
+        ref = tF.cross_entropy(torch.tensor(logits),
+                               torch.tensor(labels)).item()
+        got = F.cross_entropy(t(logits), t(labels)).item()
+        assert abs(ref - got) < 1e-5
+
+    def test_cross_entropy_ignore_index(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        logits = rng.rand(6, 10).astype(np.float32)
+        labels = np.array([1, 2, -100, 4, -100, 5])
+        ref = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                               ignore_index=-100).item()
+        got = F.cross_entropy(t(logits), t(labels),
+                              ignore_index=-100).item()
+        assert abs(ref - got) < 1e-5
+
+    def test_cross_entropy_soft_label(self):
+        rng = np.random.RandomState(0)
+        logits = rng.rand(4, 5).astype(np.float32)
+        soft = np.abs(rng.rand(4, 5).astype(np.float32))
+        soft /= soft.sum(-1, keepdims=True)
+        from scipy.special import log_softmax as sls
+        ref = float((-soft * sls(logits, -1)).sum(-1).mean())
+        got = F.cross_entropy(t(logits), t(soft), soft_label=True).item()
+        assert abs(ref - got) < 1e-5
+
+    def test_bce_mse_l1(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        p = rng.rand(8).astype(np.float32) * 0.9 + 0.05
+        y = (rng.rand(8) > 0.5).astype(np.float32)
+        assert abs(F.binary_cross_entropy(t(p), t(y)).item() -
+                   tF.binary_cross_entropy(torch.tensor(p),
+                                           torch.tensor(y)).item()) < 1e-5
+        z = rng.randn(8).astype(np.float32)
+        assert abs(
+            F.binary_cross_entropy_with_logits(t(z), t(y)).item() -
+            tF.binary_cross_entropy_with_logits(
+                torch.tensor(z), torch.tensor(y)).item()) < 1e-5
+        a, b = rng.rand(5).astype(np.float32), rng.rand(5).astype(np.float32)
+        assert abs(F.mse_loss(t(a), t(b)).item() -
+                   float(((a - b) ** 2).mean())) < 1e-6
+        assert abs(F.l1_loss(t(a), t(b)).item() -
+                   float(np.abs(a - b).mean())) < 1e-6
+
+    def test_kl_smooth_l1(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        logp = np.log(rng.dirichlet(np.ones(5), 4).astype(np.float32))
+        q = rng.dirichlet(np.ones(5), 4).astype(np.float32)
+        ref = tF.kl_div(torch.tensor(logp), torch.tensor(q),
+                        reduction="mean").item()
+        got = F.kl_div(t(logp), t(q), reduction="mean").item()
+        assert abs(ref - got) < 1e-5
+
+    def test_ctc_loss_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        T, N, C, S = 12, 3, 6, 4
+        logits = rng.randn(T, N, C).astype(np.float32)
+        labels = rng.randint(1, C, size=(N, S)).astype(np.int64)
+        ilen = np.array([12, 10, 8], np.int64)
+        llen = np.array([4, 3, 2], np.int64)
+        ref = tF.ctc_loss(
+            torch.tensor(logits).log_softmax(-1), torch.tensor(labels),
+            torch.tensor(ilen), torch.tensor(llen), blank=0,
+            reduction="none").numpy()
+        got = F.ctc_loss(t(logits), t(labels), t(ilen), t(llen), blank=0,
+                         reduction="none").numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+class TestPooling:
+    def test_pool_vs_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 8, 8).astype(np.float32)
+        ref = tF.max_pool2d(torch.tensor(x), 2).numpy()
+        np.testing.assert_allclose(F.max_pool2d(t(x), 2).numpy(), ref)
+        ref = tF.avg_pool2d(torch.tensor(x), 3, stride=2,
+                            padding=1).numpy()
+        got = F.avg_pool2d(t(x), 3, stride=2, padding=1,
+                           exclusive=False).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_adaptive(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 9, 9).astype(np.float32)
+        ref = tF.adaptive_avg_pool2d(torch.tensor(x), 3).numpy()
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(t(x), 3).numpy(), ref, rtol=1e-5)
+        ref = tF.adaptive_max_pool2d(torch.tensor(x), 4).numpy()
+        np.testing.assert_allclose(
+            F.adaptive_max_pool2d(t(x), 4).numpy(), ref, rtol=1e-5)
+
+
+class TestContainers:
+    def test_sequential_layerlist(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+        assert len(m) == 2
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4 and len(ll.parameters()) == 8
+
+    def test_layerdict(self):
+        d = nn.LayerDict({"a": nn.Linear(2, 2)})
+        d["b"] = nn.ReLU()
+        assert "a" in d and len(d) == 2
+
+    def test_apply_train_eval(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        m(t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+        h.remove()
+        m(t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+
+
+class TestGradClip:
+    def test_global_norm(self):
+        lin = nn.Linear(4, 4)
+        x = t(np.ones((2, 4), np.float32))
+        (lin(x) * 100).sum().backward()
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        pg = clip([(p, p.grad) for p in lin.parameters()])
+        total = np.sqrt(sum(float((g.numpy() ** 2).sum()) for _, g in pg))
+        assert abs(total - 1.0) < 1e-4
+
+    def test_by_value(self):
+        lin = nn.Linear(2, 2)
+        lin(t(np.ones((1, 2), np.float32))).sum().backward()
+        clip = nn.ClipGradByValue(0.5)
+        pg = clip([(p, p.grad) for p in lin.parameters()])
+        for _, g in pg:
+            assert g.numpy().max() <= 0.5
+
+
+class TestUtils:
+    def test_params_vector_roundtrip(self):
+        m = nn.Linear(3, 2)
+        from paddle_tpu.nn.utils import parameters_to_vector, \
+            vector_to_parameters
+        vec = parameters_to_vector(m.parameters())
+        assert vec.shape == [8]
+        vector_to_parameters(vec * 0 + 1.0, m.parameters())
+        assert np.all(m.weight.numpy() == 1.0)
+
+    def test_weight_norm(self):
+        from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+        lin = weight_norm(nn.Linear(3, 4))
+        names = dict(lin.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        x = t(np.ones((1, 3), np.float32))
+        y1 = lin(x).numpy()
+        remove_weight_norm(lin)
+        y2 = lin(x).numpy()
+        np.testing.assert_allclose(y1, y2, rtol=1e-5)

@@ -1,0 +1,198 @@
+"""Optimizer + LR scheduler math (SURVEY.md §2.4)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+def quad_problem():
+    """One-parameter quadratic: loss = (w*x - y)^2 summed."""
+    p = paddle.framework.Parameter(np.array([2.0, -1.0], np.float32))
+    return p
+
+
+class TestSGDMomentum:
+    def test_sgd_step(self):
+        p = quad_problem()
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        (p * p).sum().backward()
+        o.step()
+        np.testing.assert_allclose(p.numpy(), [2.0 - 0.1 * 4, -1 + 0.1 * 2],
+                                   rtol=1e-6)
+
+    def test_momentum(self):
+        p = quad_problem()
+        o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        g = 2 * p.numpy()
+        (p * p).sum().backward()
+        o.step()
+        v1 = g
+        w1 = np.array([2.0, -1.0]) - 0.1 * v1
+        np.testing.assert_allclose(p.numpy(), w1, rtol=1e-5)
+        p.clear_grad()
+        g2 = 2 * p.numpy()
+        (p * p).sum().backward()
+        o.step()
+        v2 = 0.9 * v1 + g2
+        np.testing.assert_allclose(p.numpy(), w1 - 0.1 * v2, rtol=1e-5)
+
+    def test_weight_decay_coupled(self):
+        p = quad_problem()
+        o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        (p * 0).sum().backward()  # zero grad; only decay acts
+        o.step()
+        np.testing.assert_allclose(p.numpy(),
+                                   np.array([2.0, -1.0]) * (1 - 0.05),
+                                   rtol=1e-5)
+
+
+class TestAdamFamily:
+    def test_adam_vs_torch(self):
+        import torch
+        w0 = np.array([1.0, 2.0, -3.0], np.float32)
+        tp = torch.tensor(w0, requires_grad=True)
+        topt = torch.optim.Adam([tp], lr=0.01)
+        p = paddle.framework.Parameter(w0.copy())
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        for _ in range(5):
+            tl = (tp ** 2).sum()
+            topt.zero_grad()
+            tl.backward()
+            topt.step()
+            (p * p).sum().backward()
+            o.step()
+            p.clear_grad()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_adamw_vs_torch(self):
+        import torch
+        w0 = np.array([1.0, 2.0, -3.0], np.float32)
+        tp = torch.tensor(w0, requires_grad=True)
+        topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.1)
+        p = paddle.framework.Parameter(w0.copy())
+        o = opt.AdamW(learning_rate=0.01, parameters=[p], weight_decay=0.1)
+        for _ in range(5):
+            topt.zero_grad()
+            ((tp ** 2).sum()).backward()
+            topt.step()
+            (p * p).sum().backward()
+            o.step()
+            p.clear_grad()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("cls,tcls,kwargs,tkwargs", [
+        ("Adagrad", "Adagrad", {"learning_rate": 0.05, "epsilon": 1e-10},
+         {"lr": 0.05}),
+        ("RMSProp", "RMSprop", {"learning_rate": 0.01, "rho": 0.99,
+                                "epsilon": 1e-8},
+         {"lr": 0.01, "alpha": 0.99, "eps": 1e-8}),
+        ("Adamax", "Adamax", {"learning_rate": 0.01},
+         {"lr": 0.01}),
+    ])
+    def test_others_vs_torch(self, cls, tcls, kwargs, tkwargs):
+        import torch
+        w0 = np.array([0.5, -1.5], np.float32)
+        tp = torch.tensor(w0, requires_grad=True)
+        topt = getattr(torch.optim, tcls)([tp], **tkwargs)
+        p = paddle.framework.Parameter(w0.copy())
+        o = getattr(opt, cls)(parameters=[p], **kwargs)
+        for _ in range(4):
+            topt.zero_grad()
+            ((tp ** 2).sum()).backward()
+            topt.step()
+            (p * p).sum().backward()
+            o.step()
+            p.clear_grad()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_converges(self):
+        m = nn.Linear(2, 1)
+        o = opt.Adam(learning_rate=0.05, parameters=m.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(32, 2).astype(np.float32))
+        y = paddle.to_tensor(
+            (x.numpy() @ np.array([[2.0], [-1.0]]) + 0.5).astype(np.float32))
+        for i in range(150):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert loss.item() < 1e-3
+
+    def test_state_dict_roundtrip(self):
+        p = quad_problem()
+        o = opt.Adam(learning_rate=0.01, parameters=[p])
+        (p * p).sum().backward()
+        o.step()
+        sd = o.state_dict()
+        p2 = quad_problem()
+        o2 = opt.Adam(learning_rate=0.01, parameters=[p2])
+        o2.set_state_dict(sd)
+        assert o2._step_count == 1
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        lrs = [s()]
+        for _ in range(4):
+            s.step()
+            lrs.append(s())
+        assert lrs == [1.0, 1.0, 0.5, 0.5, 0.25]
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-6
+        for _ in range(10):
+            s.step()
+        assert abs(s() - 0.0) < 1e-6
+
+    def test_warmup(self):
+        s = opt.lr.LinearWarmup(learning_rate=1.0, warmup_steps=5,
+                                start_lr=0.0, end_lr=1.0)
+        vals = [s()]
+        for _ in range(5):
+            s.step()
+            vals.append(s())
+        assert vals[0] == 0.0 and abs(vals[-1] - 1.0) < 1e-6
+
+    def test_noam(self):
+        s = opt.lr.NoamDecay(d_model=512, warmup_steps=4000)
+        v0 = s()
+        for _ in range(3999):
+            s.step()
+        peak = s()
+        s.step()
+        assert peak > v0
+
+    def test_piecewise(self):
+        s = opt.lr.PiecewiseDecay(boundaries=[3, 6], values=[1.0, 0.5, 0.1])
+        out = []
+        for _ in range(8):
+            out.append(s())
+            s.step()
+        assert out[0] == 1.0 and out[4] == 0.5 and out[7] == 0.1
+
+    def test_scheduler_in_optimizer(self):
+        sched = opt.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        p = quad_problem()
+        o = opt.SGD(learning_rate=sched, parameters=[p])
+        assert abs(o.get_lr() - 0.1) < 1e-9
+        sched.step()
+        assert abs(o.get_lr() - 0.01) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(learning_rate=1.0, patience=1,
+                                   factor=0.5)
+        s.step(metrics=1.0)
+        s.step(metrics=1.0)
+        s.step(metrics=1.0)
+        s.step(metrics=1.0)
+        assert s() < 1.0
