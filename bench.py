@@ -1,0 +1,85 @@
+"""Headline benchmark: tokens/sec/chip on a GPT train step (bf16).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline ratchets against BENCH_BASE.json (first run records the base;
+BASELINE.json carries no published numbers to compare against directly).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        batch, seq = 8, 1024
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_position_embeddings=seq,
+                        dropout=0.0)
+    else:  # smoke-size on CPU so the script always runs
+        batch, seq = 2, 128
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=seq,
+                        dropout=0.0)
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.bfloat16() if on_tpu else None
+
+    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        V = logits.shape[-1]
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, o)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = step(ids, ids)
+    float(loss.item())
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    loss.value.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASE.json")
+    vs = 1.0
+    if on_tpu:
+        if os.path.exists(base_path):
+            with open(base_path) as f:
+                base = json.load(f).get("tokens_per_sec", tokens_per_sec)
+            vs = tokens_per_sec / base
+        else:
+            with open(base_path, "w") as f:
+                json.dump({"tokens_per_sec": tokens_per_sec}, f)
+    print(json.dumps({
+        "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+"""paddle.jit namespace. Parity: python/paddle/jit/__init__.py."""
+from .api import to_static, not_to_static, TrainStep, functional_call, \
+    StaticFunction
+from .save_load import save, load, TranslatedLayer, InputSpec
+
+declarative = to_static

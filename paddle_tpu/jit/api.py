@@ -1,0 +1,242 @@
+"""paddle.jit — trace-and-compile path.
+
+Parity: python/paddle/fluid/dygraph/jit.py + dygraph_to_static/ (the
+ProgramTranslator). TPU-native design: instead of AST-rewriting Python into
+a ProgramDesc, we *trace* Layer.forward into a jaxpr via a functional view
+of the layer (params pytree -> outputs) and hand it to jax.jit — XLA is the
+graph program. Python control flow over tensors must use paddle.static.nn
+cond/while_loop (lax-backed) exactly as the reference requires graph ops.
+
+`functional_call(layer, params, args)` is the keystone: it temporarily
+binds traced arrays into the layer's Parameters so the ordinary eager
+forward runs under trace, with the tape disabled (jax.grad provides
+differentiation on this path).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter, no_grad, _Slot
+from ..framework.random import rng_scope, split_key
+
+__all__ = ["functional_call", "to_static", "TrainStep", "not_to_static"]
+
+
+def state_arrays(layer):
+    """(param_dict, buffer_dict) of raw jax arrays."""
+    params = {k: p.value for k, p in layer.named_parameters()}
+    buffers = {k: b.value for k, b in layer.named_buffers()}
+    return params, buffers
+
+
+def _bind(layer, arrays):
+    """Temporarily swap tensor values; returns restore list."""
+    saved = []
+    named = dict(layer.named_parameters())
+    named.update(dict(layer.named_buffers()))
+    for k, arr in arrays.items():
+        t = named.get(k)
+        if t is None:
+            continue
+        saved.append((t, t._slot))
+        t._slot = _Slot(arr)
+    return saved
+
+
+def _restore(saved):
+    for t, slot in saved:
+        t._slot = slot
+
+
+def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
+                    training=None):
+    """Run layer.forward with the given arrays bound — pure w.r.t. inputs."""
+    kwargs = kwargs or {}
+    arrays = dict(params)
+    arrays.update(buffers)
+    saved = _bind(layer, arrays)
+    prev_training = layer.training
+    try:
+        if training is not None:
+            layer.train() if training else layer.eval()
+        wrapped_args = [Tensor(a) if not isinstance(a, Tensor) else a
+                        for a in args]
+        with no_grad():
+            if rng_key is not None:
+                with rng_scope(rng_key):
+                    out = layer(*wrapped_args, **kwargs)
+            else:
+                out = layer(*wrapped_args, **kwargs)
+    finally:
+        _restore(saved)
+        layer.train() if prev_training else layer.eval()
+    return jax.tree.map(
+        lambda t: t.value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class StaticFunction:
+    """Compiled wrapper around a Layer or a Tensor function.
+    Parity: TranslatedLayer / StaticFunction in the reference."""
+
+    def __init__(self, obj, input_spec=None, build_strategy=None,
+                 training=None):
+        self._obj = obj
+        self._input_spec = input_spec
+        self._training = training
+        self._cache = {}
+        from ..nn.layer.layers import Layer
+        self._is_layer = isinstance(obj, Layer)
+
+    def _sig(self, arrays):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+    def _compile(self, sig, example_args):
+        if self._is_layer:
+            layer = self._obj
+            training = layer.training if self._training is None \
+                else self._training
+
+            def pure(params, buffers, key, *xs):
+                return functional_call(layer, params, buffers, xs,
+                                       rng_key=key, training=training)
+            jitted = jax.jit(pure)
+        else:
+            fn = self._obj
+
+            def pure(key, *xs):
+                with no_grad(), rng_scope(key):
+                    out = fn(*[Tensor(x) for x in xs])
+                return jax.tree.map(
+                    lambda t: t.value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+            jitted = jax.jit(pure)
+        self._cache[sig] = jitted
+        return jitted
+
+    def __call__(self, *args, **kwargs):
+        arrays = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        sig = self._sig(arrays)
+        jitted = self._cache.get(sig)
+        if jitted is None:
+            jitted = self._compile(sig, arrays)
+        key = split_key()
+        if self._is_layer:
+            params, buffers = state_arrays(self._obj)
+            out = jitted(params, buffers, key, *arrays)
+        else:
+            out = jitted(key, *arrays)
+        return jax.tree.map(Tensor, out)
+
+    # Layer-protocol passthroughs so a converted layer still acts like one
+    def __getattr__(self, name):
+        return getattr(self._obj, name)
+
+    @property
+    def forward(self):
+        return self.__call__
+
+    def concrete_program(self):
+        return self._cache
+
+    @property
+    def wrapped(self):
+        return self._obj
+
+
+def to_static(layer_or_function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static: decorator or call. Compiles via jax.jit."""
+    def wrap(obj):
+        if getattr(obj, "_not_to_static", False):
+            return obj
+        return StaticFunction(obj, input_spec, build_strategy)
+    if layer_or_function is None:
+        return wrap
+    return wrap(layer_or_function)
+
+
+class TrainStep:
+    """One fully-jitted training step: forward + loss + grads + optimizer.
+
+    The TPU-native analogue of the reference's whole-program executor path:
+    everything — including the optimizer update — is a single XLA
+    computation; parameter/optimizer-state buffers are donated so updates
+    are in-place in HBM.
+
+        step = TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)          # device arrays stay resident
+        step.sync_to_model()       # copy back into Parameters when needed
+    """
+
+    def __init__(self, model, loss_fn, optimizer, mesh=None,
+                 in_shardings=None, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        params, self.buffers = state_arrays(model)
+        # buffers are donated every step; take a private copy so the
+        # model's own Parameters stay valid for eager use
+        self.params = jax.tree.map(jnp.array, params)
+        self.opt_state = jax.tree.map(
+            lambda v: self.optimizer._init_state(v), self.params,
+            is_leaf=lambda x: hasattr(x, "dtype"))
+        self._step_i = 0
+        self._mesh = mesh
+
+        def step_fn(params, opt_state, buffers, key, lr, step_i, *batch):
+            def loss_of(ps):
+                out = functional_call(model, ps, buffers, batch[:-1],
+                                      rng_key=key, training=True)
+                tgt = Tensor(batch[-1])
+                loss_t = loss_fn(
+                    out if isinstance(out, Tensor) else Tensor(out), tgt)
+                return loss_t.value if isinstance(loss_t, Tensor) else loss_t
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            clip = self.optimizer._grad_clip
+            if clip is not None:
+                from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, \
+                    ClipGradByValue
+                if isinstance(clip, ClipGradByGlobalNorm):
+                    gn = jnp.sqrt(sum(
+                        jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads)))
+                    factor = jnp.minimum(
+                        clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+                    grads = jax.tree.map(
+                        lambda g: (g * factor).astype(g.dtype), grads)
+                elif isinstance(clip, ClipGradByValue):
+                    grads = jax.tree.map(
+                        lambda g: jnp.clip(g, clip.min, clip.max), grads)
+            new_params, new_state = self.optimizer.apply_gradients_tree(
+                params, grads, opt_state, lr, step_i)
+            return loss, new_params, new_state
+
+        donate_argnums = (0, 1) if donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+
+    def __call__(self, *batch):
+        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        self._step_i += 1
+        key = split_key()
+        lr = self.optimizer.get_lr()
+        loss, self.params, self.opt_state = self._jitted(
+            self.params, self.opt_state, self.buffers, key,
+            jnp.asarray(lr, jnp.float32), self._step_i, *arrays)
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        named = dict(self.model.named_parameters())
+        with no_grad():
+            for k, v in self.params.items():
+                named[k]._slot = _Slot(v)

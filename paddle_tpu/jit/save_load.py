@@ -1,0 +1,140 @@
+"""paddle.jit.save / load.
+
+Parity: python/paddle/fluid/dygraph/jit.py:save + io.py:TranslatedLayer.
+TPU-native format: instead of a ProgramDesc proto + LoDTensor params
+(`__model__` + `*.pdiparams`), we serialize the traced computation as
+portable StableHLO bytes via jax.export plus a pickled numpy state dict:
+
+    <path>.pdmodel   — serialized StableHLO (jax.export.Exported bytes)
+    <path>.pdiparams — pickled {name: ndarray} state
+    <path>.meta      — input specs / structure
+
+The exported artifact is exactly what Paddle Inference loads (see
+paddle_tpu/inference), and runs on any PjRt backend.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..framework.core import Tensor, no_grad
+from .api import StaticFunction, functional_call, state_arrays
+
+__all__ = ["save", "load", "TranslatedLayer", "InputSpec"]
+
+
+class InputSpec:
+    """Parity: python/paddle/static/input.py:InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    _sym_counter = [0]
+
+    def to_shape_dtype(self):
+        from ..framework.dtype import convert_dtype
+        dims = []
+        for s in self.shape:
+            if s is None or s == -1:
+                # dynamic axis → jax.export symbolic dimension, so the
+                # serialized StableHLO stays batch-polymorphic
+                InputSpec._sym_counter[0] += 1
+                dims.append(f"_pd_b{InputSpec._sym_counter[0]}")
+            else:
+                dims.append(str(int(s)))
+        if any(d.startswith("_pd_b") for d in dims):
+            shape = jax_export.symbolic_shape(",".join(dims))
+        else:
+            shape = tuple(int(d) for d in dims)
+        return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
+
+
+def save(layer, path, input_spec=None, **configs):
+    from ..nn.layer.layers import Layer
+    if isinstance(layer, StaticFunction):
+        layer = layer.wrapped
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer (or converted Layer)")
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on first save")
+
+    params, buffers = state_arrays(layer)
+    specs = [s.to_shape_dtype() if isinstance(s, InputSpec)
+             else jax.ShapeDtypeStruct(tuple(s.shape),
+                                       s.value.dtype) for s in input_spec]
+
+    def pure(params, buffers, *xs):
+        return functional_call(layer, params, buffers, xs, training=False)
+
+    exported = jax_export.export(jax.jit(pure))(
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                     params),
+        jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                     buffers),
+        *specs)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    state = {"params": {k: np.asarray(v) for k, v in params.items()},
+             "buffers": {k: np.asarray(v) for k, v in buffers.items()}}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    meta = {"input_specs": [(tuple(str(d) for d in s.shape), str(s.dtype))
+                            for s in specs]}
+    with open(path + ".meta", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+class TranslatedLayer:
+    """A loaded inference computation. Callable like the original Layer."""
+
+    def __init__(self, exported, params, buffers, meta):
+        self._exported = exported
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._buffers = {k: jnp.asarray(v) for k, v in buffers.items()}
+        self._meta = meta
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, *args):
+        arrays = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = self._call(self._params, self._buffers, *arrays)
+        return jax.tree.map(Tensor, out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+    def parameters(self):
+        return [Tensor(v) for v in self._params.values()]
+
+    def state_dict(self):
+        out = {k: Tensor(v) for k, v in self._params.items()}
+        out.update({k: Tensor(v) for k, v in self._buffers.items()})
+        return out
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    meta = {}
+    if os.path.exists(path + ".meta"):
+        with open(path + ".meta", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(exported, state["params"], state["buffers"],
+                           meta)

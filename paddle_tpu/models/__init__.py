@@ -1,0 +1,3 @@
+"""Framework model zoo for the BASELINE.json configs (GPT / BERT-ERNIE)."""
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, gpt_tiny, gpt_small,
+                  gpt_medium, gpt_1p3b, gpt_6p7b)

