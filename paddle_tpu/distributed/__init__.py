@@ -1,0 +1,23 @@
+"""paddle.distributed namespace.
+Parity: python/paddle/distributed/__init__.py."""
+from .env import (init_parallel_env, get_rank, get_world_size, barrier,
+                  ParallelEnv, get_mesh, set_mesh, build_mesh,
+                  is_initialized)
+from .collective import (ReduceOp, all_reduce, all_gather, broadcast,
+                         reduce, scatter, alltoall, send, recv,
+                         reduce_scatter, split, new_group, wait,
+                         psum, pmean, pmax, all_gather_axis, ppermute,
+                         all_to_all_axis, axis_index)
+from .parallel import DataParallel
+from .spawn import spawn
+from . import fleet
+from . import auto_parallel
+from .auto_parallel import shard_tensor, shard_op, ProcessMesh
+from . import meta_parallel
+from .fleet.utils.recompute import recompute
+from . import launch as launch_module
+
+
+def launch():
+    from .launch import main
+    main()

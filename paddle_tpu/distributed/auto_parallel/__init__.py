@@ -1,0 +1,93 @@
+"""Semi-auto parallel annotations. Parity:
+python/paddle/distributed/auto_parallel/ (shard_tensor / shard_op +
+planner). TPU-native: these ARE jax's native GSPMD annotations —
+shard_tensor places/constrains an array with a NamedSharding and XLA's
+partitioner (the production auto-parallel planner) propagates shardings
+through the whole program.
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework.core import Tensor, apply_op
+from ..env import get_mesh
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op"]
+
+
+class ProcessMesh:
+    """Parity: auto_parallel/process_mesh.py."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None and hasattr(mesh, "devices"):
+            self._mesh = mesh
+        else:
+            shape = shape or (np.asarray(mesh).shape if mesh is not None
+                              else (jax.device_count(),))
+            dim_names = dim_names or [f"d{i}" for i in range(len(shape))]
+            devs = np.array(jax.devices()[:int(np.prod(shape))]
+                            ).reshape(shape)
+            self._mesh = Mesh(devs, tuple(dim_names))
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return tuple(self._mesh.devices.shape)
+
+    @property
+    def dim_names(self):
+        return tuple(self._mesh.axis_names)
+
+
+def _to_spec(dist_attr, ndim):
+    if dist_attr is None:
+        return PartitionSpec()
+    if isinstance(dist_attr, PartitionSpec):
+        return dist_attr
+    if isinstance(dist_attr, dict):
+        dims = dist_attr.get("dims_mapping",
+                             dist_attr.get("sharding_specs"))
+    else:
+        dims = dist_attr
+    return PartitionSpec(*[d if isinstance(d, str) and d else None
+                           for d in list(dims)[:ndim]])
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):
+    mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) \
+        else (process_mesh or get_mesh())
+    spec = _to_spec(shard_spec if shard_spec is not None else dist_attr,
+                    x.ndim if hasattr(x, "ndim") else 0)
+    sharding = NamedSharding(mesh, spec)
+    arr = x.value if isinstance(x, Tensor) else x
+    if isinstance(arr, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(arr, sharding)
+        return Tensor(out) if isinstance(x, Tensor) else out
+    placed = jax.device_put(arr, sharding)
+    if isinstance(x, Tensor):
+        x._bind(Tensor(placed)._slot)
+        return x
+    return placed
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None, **kwargs):
+    mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) \
+        else (process_mesh or get_mesh())
+
+    def wrapped(*args):
+        out = op_fn(*args)
+        if out_shard_specs is not None:
+            specs = out_shard_specs if isinstance(out_shard_specs, list) \
+                else [out_shard_specs]
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            new = []
+            for o, s in zip(outs, specs):
+                new.append(shard_tensor(o, ProcessMesh(mesh), s))
+            return new if isinstance(out, (list, tuple)) else new[0]
+        return out
+    return wrapped

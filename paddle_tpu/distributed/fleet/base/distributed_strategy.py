@@ -1,0 +1,69 @@
+"""DistributedStrategy. Parity:
+python/paddle/distributed/fleet/base/distributed_strategy.py (a protobuf-
+backed config in the reference; a plain config object here — the strategy
+fields map onto mesh axes and jit options instead of graph passes).
+"""
+
+__all__ = ["DistributedStrategy"]
+
+
+class _Cfg(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (consumed by fleet.init → Mesh axes)
+        self.hybrid_configs = _Cfg({
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        })
+        # feature switches — each maps to a TPU-native mechanism
+        self.amp = False                      # bf16/fp16 autocast policy
+        self.amp_configs = _Cfg({"init_loss_scaling": 32768.0,
+                                 "use_pure_fp16": False,
+                                 "use_bf16": True,
+                                 "custom_white_list": [],
+                                 "custom_black_list": []})
+        self.recompute = False                # jax.checkpoint on blocks
+        self.recompute_configs = _Cfg({"checkpoints": []})
+        self.sharding = False                 # ZeRO over 'sharding' axis
+        self.sharding_configs = _Cfg({"stage": 1,
+                                      "sharding_degree": 1})
+        self.pipeline = False
+        self.pipeline_configs = _Cfg({"accumulate_steps": 1,
+                                      "micro_batch_size": 1,
+                                      "schedule_mode": "1F1B"})
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Cfg({"tensor_parallel_degree": 1})
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Cfg({"k_steps": 1, "avg": True})
+        self.lamb = False
+        self.lamb_configs = _Cfg({"lamb_weight_decay": 0.01})
+        self.lars = False
+        self.lars_configs = _Cfg({})
+        self.dgc = False
+        self.localsgd = False
+        self.asp = False
+        self.fuse_all_reduce_ops = True       # XLA fuses automatically
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = _Cfg({"scale_strategy": "avg"})
+        self.a_sync = False                   # parameter-server mode: N/A
+        self.a_sync_configs = _Cfg({})
+        self.auto = False
+        self.semi_auto = False
+
+    def __repr__(self):
+        flags = [k for k in ("amp", "recompute", "sharding", "pipeline",
+                             "tensor_parallel") if getattr(self, k)]
+        return (f"DistributedStrategy(hybrid={dict(self.hybrid_configs)}, "
+                f"enabled={flags})")

@@ -1,0 +1,131 @@
+"""Hybrid-parallel topology. Parity:
+python/paddle/distributed/fleet/base/topology.py (CommunicateTopology,
+HybridCommunicateGroup). Here the topology IS the jax Mesh: axis order
+(dp, sharding, pp, mp, sp) matches the reference's hybrid order
+(data / sharding / pipe / model), laid out so mp/sp ride the innermost
+(fastest) ICI dimension.
+"""
+import numpy as np
+import jax
+
+from ...env import build_mesh, set_mesh, get_mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "sharding", "pipe",
+                                           "model", "sep"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, name):
+        return self._dims[self._names.index(name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = [kwargs.get(n, 0) for n in self._names]
+        rank = 0
+        for c, d in zip(coord, self._dims):
+            rank = rank * d + c
+        return rank
+
+    def get_coord(self, rank):
+        coord = []
+        for d in reversed(self._dims):
+            coord.append(rank % d)
+            rank //= d
+        return tuple(reversed(coord))
+
+
+class HybridCommunicateGroup:
+    """Owns the global mesh; answers 'my mp/pp/dp rank' queries. On the
+    single-controller SPMD model these are per-device concepts resolved by
+    lax.axis_index inside traced code; the Python-level accessors report
+    process-level info for API parity."""
+
+    AXIS_MAP = {"data": "dp", "sharding": "sharding", "pipe": "pp",
+                "model": "mp", "sep": "sp"}
+
+    def __init__(self, topology):
+        self._topo = topology
+        dims = {n: topology.get_dim(n) for n in
+                topology.get_hybrid_group_names()}
+        self.mesh = build_mesh(dp=dims.get("data", 1),
+                               sharding=dims.get("sharding", 1),
+                               pp=dims.get("pipe", 1),
+                               mp=dims.get("model", 1),
+                               sp=dims.get("sep", 1))
+        set_mesh(self.mesh)
+        self._dims = dims
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self.mesh.shape["dp"]
+
+    def get_model_parallel_world_size(self):
+        return self.mesh.shape["mp"]
+
+    def get_pipe_parallel_world_size(self):
+        return self.mesh.shape["pp"]
+
+    def get_sharding_parallel_world_size(self):
+        return self.mesh.shape["sharding"]
+
+    def get_sep_parallel_world_size(self):
+        return self.mesh.shape["sp"]
+
+    # ranks (controller-level: 0; true per-device rank is axis_index)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_global_rank(self):
+        return jax.process_index()
+
+    # group handles: mesh axis names stand in for communicator objects
+    def get_data_parallel_group(self):
+        from ..collective import Group
+        return Group(None, "dp", 1)
+
+    def get_model_parallel_group(self):
+        from ..collective import Group
+        return Group(None, "mp", 2)
+
+    def get_pipe_parallel_group(self):
+        from ..collective import Group
+        return Group(None, "pp", 3)
+
+    def get_sharding_parallel_group(self):
+        from ..collective import Group
+        return Group(None, "sharding", 4)
+
+    def get_check_parallel_group(self):
+        from ..collective import Group
+        return Group(None, "dp", 5)
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def topology(self):
+        return self._topo

@@ -1,0 +1,208 @@
+"""Hybrid-parallel jitted train step — the fleet execution engine.
+
+The TPU-native replacement for the reference's HybridParallelOptimizer +
+PipelineParallel + ShardingStage2 runtime classes (distributed/fleet/
+meta_parallel/*): one jax.jit'ed SPMD program over the fleet mesh where
+
+- batch is sharded over ('dp',)                       [data parallel]
+- params follow per-layer PartitionSpecs over 'mp'    [tensor parallel]
+- optimizer states are additionally sharded over the
+  'sharding' axis (ZeRO-1/2)                          [sharding]
+- blocks can be rematerialized (jax.checkpoint)       [recompute]
+- gradient accumulation folds microbatches in a scan  [gradient_merge /
+                                                       pipeline microbatch]
+
+XLA inserts psum for dp grad sync (reference: reducer.cc fused allreduce),
+allreduce/allgather for mp (reference: mp_allreduce), and reduce-scatter
+for ZeRO — all over ICI.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.core import Tensor, no_grad, _Slot
+from ...framework.random import split_key
+from ...jit.api import functional_call, state_arrays
+
+__all__ = ["HybridTrainStep", "default_param_rules"]
+
+
+def default_param_rules(name, arr):
+    """Name-based PartitionSpec rules for transformer-family models when a
+    layer doesn't announce its own sharding_spec."""
+    if arr.ndim == 2:
+        if any(k in name for k in ("qkv_proj.weight", "fc_in.weight",
+                                   "q_proj.weight", "k_proj.weight",
+                                   "v_proj.weight", "linear1.weight")):
+            return P(None, "mp")
+        if any(k in name for k in ("out_proj.weight", "fc_out.weight",
+                                   "linear2.weight")):
+            return P("mp", None)
+        if any(k in name for k in ("wte.weight", "embed_tokens.weight",
+                                   "word_embeddings.weight")):
+            return P("mp", None)
+    if arr.ndim == 1 and any(k in name for k in ("qkv_proj.bias",
+                                                 "fc_in.bias",
+                                                 "linear1.bias")):
+        return P("mp")
+    return P()
+
+
+def _collect_specs(model, params):
+    """Layer-announced sharding_spec()s override the name rules."""
+    specs = {}
+    for lname, layer in model.named_sublayers(include_self=True):
+        spec_fn = getattr(layer, "sharding_spec", None)
+        if spec_fn is None:
+            continue
+        for pname, spec in spec_fn().items():
+            full = f"{lname}.{pname}" if lname else pname
+            specs[full] = spec
+    out = {}
+    for k, v in params.items():
+        out[k] = specs.get(k, default_param_rules(k, v))
+    return out
+
+
+def _zero_spec(pspec, mesh, arr):
+    """Extend a param spec with the 'sharding' axis on the first
+    axis that is unsharded and divisible (ZeRO state placement)."""
+    deg = mesh.shape.get("sharding", 1)
+    if deg == 1:
+        return pspec
+    dims = list(pspec) + [None] * (arr.ndim - len(pspec))
+    for i, d in enumerate(dims):
+        if d is None and arr.shape[i] % deg == 0 and arr.shape[i] >= deg:
+            dims[i] = "sharding"
+            return P(*dims)
+    return pspec
+
+
+class HybridTrainStep:
+    """Build once, call per batch. See module docstring."""
+
+    def __init__(self, model, loss_fn, optimizer, mesh, recompute=False,
+                 accumulate_steps=1, donate=True, param_dtype=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.accumulate_steps = accumulate_steps
+        self._step_i = 0
+
+        params, buffers = state_arrays(model)
+        if param_dtype is not None:
+            from ...framework.dtype import convert_dtype
+            dt = convert_dtype(param_dtype)
+            params = {k: v.astype(dt) if jnp.issubdtype(
+                v.dtype, jnp.floating) else v for k, v in params.items()}
+        self.param_specs = _collect_specs(model, params)
+        self.param_shardings = {
+            k: NamedSharding(mesh, s) for k, s in self.param_specs.items()}
+        self.params = {
+            k: jax.device_put(v, self.param_shardings[k])
+            for k, v in params.items()}
+        self.buffers = buffers
+
+        # optimizer state: param spec + ZeRO sharding axis
+        def init_state(k, v):
+            st = optimizer._init_state(v)
+            sh = NamedSharding(mesh, _zero_spec(self.param_specs[k], mesh,
+                                                v))
+            return tuple(jax.device_put(s, sh) for s in st)
+        self.opt_state = {k: init_state(k, v)
+                          for k, v in self.params.items()}
+
+        self.batch_sharding = NamedSharding(mesh, P(("dp",)))
+        loss_sharding = NamedSharding(mesh, P())
+
+        model_ref = model
+        opt = optimizer
+
+        def loss_of(ps, bufs, key, micro):
+            def run(inputs):
+                out = functional_call(model_ref, ps, bufs, inputs[:-1],
+                                      rng_key=key, training=True)
+                tgt = Tensor(inputs[-1])
+                l = loss_fn(out if isinstance(out, Tensor) else Tensor(out),
+                            tgt)
+                return l.value if isinstance(l, Tensor) else l
+            if recompute:
+                run = jax.checkpoint(run)
+            return run(micro)
+
+        def step_fn(params_, opt_state_, bufs, key, lr, step_i, *batch):
+            if accumulate_steps > 1:
+                micros = [jnp.stack(jnp.split(b, accumulate_steps, axis=0))
+                          for b in batch]
+
+                def acc_body(carry, micro):
+                    loss_sum, grads_sum = carry
+                    l, g = jax.value_and_grad(
+                        lambda ps: loss_of(ps, bufs, key, micro))(params_)
+                    return (loss_sum + l,
+                            jax.tree.map(jnp.add, grads_sum, g)), None
+
+                zeros = jax.tree.map(jnp.zeros_like, params_)
+                (loss_sum, grads), _ = jax.lax.scan(
+                    acc_body, (jnp.zeros((), jnp.float32), zeros),
+                    tuple(micros))
+                loss = loss_sum / accumulate_steps
+                grads = jax.tree.map(lambda g: g / accumulate_steps, grads)
+            else:
+                loss, grads = jax.value_and_grad(
+                    lambda ps: loss_of(ps, bufs, key, batch))(params_)
+
+            clip = opt._grad_clip
+            if clip is not None:
+                from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+                if isinstance(clip, ClipGradByGlobalNorm):
+                    gn = jnp.sqrt(sum(
+                        jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads)))
+                    f = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12),
+                                    1.0)
+                    grads = jax.tree.map(
+                        lambda g: (g * f).astype(g.dtype), grads)
+                elif isinstance(clip, ClipGradByValue):
+                    grads = jax.tree.map(
+                        lambda g: jnp.clip(g, clip.min, clip.max), grads)
+            new_params, new_state = opt.apply_gradients_tree(
+                params_, grads, opt_state_, lr, step_i)
+            return loss, new_params, new_state
+
+        state_shardings = {k: tuple(
+            NamedSharding(mesh, _zero_spec(self.param_specs[k], mesh,
+                                           self.params[k]))
+            for _ in self.opt_state[k]) for k in self.opt_state}
+        self._jitted = jax.jit(
+            step_fn,
+            donate_argnums=(0, 1) if donate else (),
+            out_shardings=(loss_sharding, self.param_shardings,
+                           state_shardings))
+
+    def __call__(self, *batch):
+        arrays = [jax.device_put(
+            b.value if isinstance(b, Tensor) else jnp.asarray(b),
+            self.batch_sharding) for b in batch]
+        self._step_i += 1
+        lr = self.optimizer.get_lr()
+        loss, self.params, self.opt_state = self._jitted(
+            self.params, self.opt_state, self.buffers, split_key(),
+            jnp.asarray(lr, jnp.float32), self._step_i, *arrays)
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        named = dict(self.model.named_parameters())
+        with no_grad():
+            for k, v in self.params.items():
+                named[k]._slot = _Slot(v)
+
+    def compiled_text(self, *batch):
+        """Return the optimized HLO for inspection/tests."""
+        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        return self._jitted.lower(
+            self.params, self.opt_state, self.buffers, split_key(),
+            jnp.asarray(0.1, jnp.float32), 1, *arrays).compile().as_text()

@@ -1,0 +1,1 @@
+from .recompute import recompute, recompute_sequential, recompute_hybrid

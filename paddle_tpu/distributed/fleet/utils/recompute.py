@@ -1,0 +1,61 @@
+"""Activation recomputation. Parity:
+python/paddle/distributed/fleet/utils/recompute.py (the RecomputeFunction
+PyLayer that replays forward under saved RNG state).
+
+TPU-native: on the traced/jit path this is jax.checkpoint (remat) — XLA
+re-runs the forward in the backward pass, and JAX's functional PRNG makes
+the replayed dropout bit-exact for free (no RNG state tracker needed). On
+the eager tape, recompute is a no-op semantically (the tape stores inputs
+already), so we simply call the function.
+"""
+import jax
+
+from ....framework.core import Tensor, no_grad
+
+__all__ = ["recompute", "recompute_sequential", "recompute_hybrid"]
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    tracing = any(isinstance(t.value, jax.core.Tracer) for t in tensor_args)
+    if not tracing:
+        return function(*args, **kwargs)
+
+    def pure(*arrays):
+        rebuilt = []
+        it = iter(arrays)
+        for a in args:
+            rebuilt.append(Tensor(next(it)) if isinstance(a, Tensor) else a)
+        with no_grad():
+            out = function(*rebuilt, **kwargs)
+        return jax.tree.map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    ck = jax.checkpoint(pure)
+    out = ck(*[t.value for t in tensor_args])
+    return jax.tree.map(Tensor, out)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    out = args[0] if len(args) == 1 else args
+    fns = list(functions)
+    per = max(len(fns) // max(segments, 1), 1)
+    i = 0
+    while i < len(fns):
+        chunk = fns[i:i + per]
+
+        def run_chunk(x, _chunk=chunk):
+            for f in _chunk:
+                x = f(x)
+            return x
+        out = recompute(run_chunk, out)
+        i += per
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,55 @@
+"""paddle.distributed.launch. Parity: python/paddle/distributed/launch.py.
+
+The reference spawns one process per GPU and wires NCCL endpoints. On TPU
+the unit is a *host*: single-host runs need no launcher (one process owns
+all local chips); multi-host (pod/DCN) runs start one process per host
+with a coordinator, mapped onto jax.distributed.initialize. Usage:
+
+    python -m paddle_tpu.distributed.launch \
+        --nnodes 4 --node_rank 0 --master addr:port train.py [args...]
+"""
+import argparse
+import os
+import runpy
+import sys
+
+__all__ = ["main", "launch"]
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--master",
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for CLI parity; one process drives all "
+                        "local TPU chips")
+    p.add_argument("--devices", default=None)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch(script, script_args=(), nnodes=1, node_rank=0, master=""):
+    if nnodes > 1:
+        if not master:
+            raise ValueError("--master addr:port required when nnodes > 1")
+        os.environ["PADDLE_TPU_COORDINATOR"] = master
+        os.environ["PADDLE_TPU_NUM_PROCESSES"] = str(nnodes)
+        os.environ["PADDLE_TPU_PROCESS_ID"] = str(node_rank)
+    sys.argv = [script] + list(script_args)
+    runpy.run_path(script, run_name="__main__")
+
+
+def main():
+    args = _parse()
+    launch(args.training_script, args.training_script_args, args.nnodes,
+           args.node_rank, args.master)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Parity: python/paddle/distributed/fleet/meta_parallel/__init__.py."""
+from .parallel_layers.mp_layers import (ColumnParallelLinear,
+                                        RowParallelLinear,
+                                        VocabParallelEmbedding,
+                                        ParallelCrossEntropy)
+from .parallel_layers.pp_layers import PipelineLayer, LayerDesc, \
+    SharedLayerDesc
+from .pipeline_parallel import PipelineParallel

@@ -1,0 +1,3 @@
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding, ParallelCrossEntropy)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc

@@ -1,0 +1,108 @@
+"""Pipeline layer descriptions. Parity:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py
+(PipelineLayer / LayerDesc / SharedLayerDesc).
+
+The reference materializes only the local stage's layers per rank and
+moves activations with NCCL p2p. TPU-native design: PipelineLayer keeps
+the full logical stack and partitions it into `num_stages` segments; the
+PipelineParallel engine (pipeline_parallel.py) stacks per-stage params and
+runs all stages in SPMD over the 'pp' mesh axis, rotating microbatch
+activations with lax.ppermute (GPipe schedule — fill, steady state, drain
+— expressed as one lax.scan).
+"""
+import numpy as np
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Holds the full layer stack + its partition into stages."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        descs = list(layers)
+        built = []
+        self._shared = {}
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, "fn"))
+            else:
+                raise TypeError(f"unsupported pipeline item {d!r}")
+        self.run_function = built
+        self._layers_list = LayerList(
+            [l for l, tag in built if isinstance(l, Layer)])
+
+        if topology is not None:
+            self._num_stages = topology.get_dim("pipe")
+        else:
+            self._num_stages = num_stages or 1
+        n = len(built)
+        per = -(-n // self._num_stages)
+        self.segments = [built[i * per:(i + 1) * per]
+                         for i in range(self._num_stages)]
+        self.recompute_interval = recompute_interval
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_stage_layers(self, stage_id):
+        return self.segments[stage_id]
+
+    def forward(self, x):
+        """Reference semantics: run the whole stack (single-device path)."""
+        for item, tag in self.run_function:
+            if tag == "fn":
+                x = item(x)
+            elif tag is not None and tag != "fn":
+                x = tag(item, x)
+            else:
+                x = item(x)
+        return x
+
+    def loss(self, x, label):
+        out = self.forward(x)
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(out, label)

@@ -1,0 +1,178 @@
+"""Pipeline-parallel execution engine.
+
+Parity: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py
+(PipelineParallel: 1F1B/GPipe schedules over NCCL p2p).
+
+TPU-native design: the schedule is ONE SPMD program. Per-stage parameter
+pytrees are stacked on a leading [pp] axis and sharded over the 'pp' mesh
+axis; inside shard_map every device runs the same stage function on its
+local shard while lax.ppermute rotates microbatch activations to the next
+stage over ICI. The fill/steady/drain phases of GPipe fall out of a single
+fori_loop of length (n_micro + n_stages - 1); reverse-mode AD through
+ppermute yields the backward pipeline automatically, so 1F1B-style
+interleaving is XLA's scheduling problem, not hand-written control flow
+(see PAPERS.md: MPMD pipeline parallelism — we deliberately choose the
+SPMD formulation natural to XLA).
+
+Constraint (documented): stages must be structurally uniform (same layer
+stack per stage) — embedding/head run replicated outside the pipelined
+segment. This matches how transformer trunks are pipelined in practice.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ...framework.core import Tensor
+from ...jit.api import functional_call, state_arrays
+
+__all__ = ["PipelineParallel", "pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, n_stages,
+                   n_micro):
+    """Run the GPipe schedule. stacked_params leaves: [pp, ...];
+    x_micro: [n_micro, mb, ...] (replicated over pp). Returns stacked
+    last-stage outputs [n_micro, mb, ...]."""
+
+    def spmd(params_local, xs):
+        # params_local leaves: [1, ...] → this stage's params
+        params_here = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index("pp")
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        T = n_micro + n_stages - 1
+        mb_shape = xs.shape[1:]
+        outputs = jnp.zeros((n_micro,) + mb_shape, xs.dtype)
+        carry = jnp.zeros(mb_shape, xs.dtype)
+
+        def tick(t, state):
+            recv, outputs = state
+            feed_idx = jnp.clip(t, 0, n_micro - 1)
+            first_in = jnp.where(t < n_micro, xs[feed_idx],
+                                 jnp.zeros(mb_shape, xs.dtype))
+            inp = jnp.where(stage == 0, first_in, recv)
+            out = stage_fn(params_here, inp)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            is_valid = (t >= n_stages - 1) & (stage == n_stages - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(is_valid, out, outputs[out_idx]), out_idx, 0)
+            recv = jax.lax.ppermute(out, "pp", perm)
+            return recv, outputs
+
+        recv, outputs = jax.lax.fori_loop(0, T, tick, (carry, outputs))
+        # broadcast last-stage outputs to every pp rank so downstream
+        # (replicated head/loss) sees them
+        outputs = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outputs, 0.0), "pp")
+        return outputs
+
+    pp_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(pp_specs, P()), out_specs=P(),
+        check_vma=False)(stacked_params, x_micro)
+
+
+class PipelineParallel:
+    """Engine over a PipelineLayer: builds the stacked-stage params and a
+    jitted train step. Used by fleet and by tests/dryrun."""
+
+    def __init__(self, pipeline_layer, optimizer, mesh, n_micro=2,
+                 loss_fn=None):
+        self.layer = pipeline_layer
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.n_micro = n_micro
+        self.n_stages = pipeline_layer.num_stages
+        self.loss_fn = loss_fn or pipeline_layer._loss_fn
+        self._step_i = 0
+
+        # build stacked per-stage params; stages must be uniform
+        seg_params = []
+        for seg in pipeline_layer.segments:
+            stage_arrays = {}
+            for idx, (layer, tag) in enumerate(seg):
+                if tag == "fn" or not hasattr(layer, "named_parameters"):
+                    continue
+                for name, p in layer.named_parameters():
+                    stage_arrays[f"{idx}.{name}"] = p.value
+            seg_params.append(stage_arrays)
+        keys = sorted(seg_params[0].keys())
+        for sp in seg_params[1:]:
+            if sorted(sp.keys()) != keys:
+                raise ValueError(
+                    "pipeline stages are not structurally uniform: "
+                    f"{sorted(sp.keys())} vs {keys}")
+        self.stacked = {
+            k: jnp.stack([sp[k] for sp in seg_params]) for k in keys}
+        pp_shard = {k: NamedSharding(mesh, P("pp"))
+                    for k in self.stacked}
+        self.stacked = {k: jax.device_put(v, pp_shard[k])
+                        for k, v in self.stacked.items()}
+        self.opt_state = {
+            k: tuple(jax.device_put(s, pp_shard[k])
+                     for s in optimizer._init_state(v))
+            for k, v in self.stacked.items()}
+
+        seg0 = pipeline_layer.segments[0]
+        layers0 = [l for l, tag in seg0 if hasattr(l, "named_parameters")]
+
+        def stage_fn(params_here, x):
+            out = x
+            for idx, (layer, tag) in enumerate(seg0):
+                if tag == "fn":
+                    out = layer(Tensor(out)).value if isinstance(
+                        out, jnp.ndarray) else layer(out)
+                    continue
+                prefix = f"{idx}."
+                sub = {name[len(prefix):]: arr
+                       for name, arr in params_here.items()
+                       if name.startswith(prefix)}
+                out = functional_call(layer, sub, {}, (out,),
+                                      training=True)
+            return out
+
+        self._stage_fn = stage_fn
+        mesh_ = mesh
+        n_stages = self.n_stages
+        n_micro_ = n_micro
+        opt = optimizer
+        lfn = self.loss_fn
+
+        def train_step(stacked, opt_state, lr, step_i, x, y):
+            xm = jnp.stack(jnp.split(x, n_micro_, axis=0))
+
+            def loss_of(ps):
+                outs = pipeline_apply(stage_fn, ps, xm, mesh_, n_stages,
+                                      n_micro_)
+                flat = outs.reshape((-1,) + outs.shape[2:])
+                l = lfn(Tensor(flat), Tensor(y))
+                return l.value if isinstance(l, Tensor) else l
+
+            loss, grads = jax.value_and_grad(loss_of)(stacked)
+            new_p, new_s = opt.apply_gradients_tree(stacked, grads,
+                                                    opt_state, lr, step_i)
+            return loss, new_p, new_s
+
+        self._jitted = jax.jit(train_step, donate_argnums=(0, 1))
+
+    def train_batch(self, x, y):
+        self._step_i += 1
+        xa = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        ya = y.value if isinstance(y, Tensor) else jnp.asarray(y)
+        loss, self.stacked, self.opt_state = self._jitted(
+            self.stacked, self.opt_state,
+            jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+            self._step_i, xa, ya)
+        return Tensor(loss)
+
+    def forward(self, x):
+        xa = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        xm = jnp.stack(jnp.split(xa, self.n_micro, axis=0))
+        outs = pipeline_apply(self._stage_fn, self.stacked, xm, self.mesh,
+                              self.n_stages, self.n_micro)
+        return Tensor(outs.reshape((-1,) + outs.shape[2:]))

@@ -1,0 +1,3 @@
+from .sharding_stage import (ShardingOptimizerStage2, ShardingStage2,
+                             ShardingStage3, GroupShardedOptimizerStage2,
+                             GroupShardedStage2, GroupShardedStage3)

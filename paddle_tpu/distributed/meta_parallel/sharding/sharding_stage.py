@@ -1,0 +1,79 @@
+"""ZeRO sharding stages. Parity:
+python/paddle/distributed/fleet/meta_parallel/sharding/ (sharding_stage2/
+sharding_stage3 + sharding_optimizer_stage2).
+
+Reference mechanics: each rank owns a slice of optimizer state (stage 1/2)
+or parameters (stage 3) and materializes the rest on demand with NCCL
+broadcast/allgather. TPU-native: the state/param pytrees simply carry a
+NamedSharding with the 'sharding' mesh axis; XLA's SPMD partitioner emits
+the reduce-scatter for gradient averaging and the all-gather before use —
+the exact ZeRO communication schedule — without bespoke runtime classes.
+These wrappers exist for API parity and to stamp the shardings onto an
+optimizer/layer used with fleet's HybridTrainStep (which already applies
+`_zero_spec` placement when sharding_degree > 1).
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....framework.core import Tensor
+
+__all__ = ["ShardingOptimizerStage2", "ShardingStage2", "ShardingStage3",
+           "GroupShardedOptimizerStage2", "GroupShardedStage2",
+           "GroupShardedStage3"]
+
+
+class ShardingOptimizerStage2:
+    """Optimizer-state (+grad) sharding over the 'sharding' axis."""
+
+    def __init__(self, params, optim, group=None, offload=False, **kw):
+        self._optim = optim
+        self._params = params
+        optim._sharding_stage = 2
+
+    def __getattr__(self, name):
+        return getattr(self._optim, name)
+
+    def step(self):
+        self._optim.step()
+
+    def clear_grad(self):
+        self._optim.clear_grad()
+
+
+class ShardingStage2:
+    """Layer wrapper marking grads for reduce-scatter over 'sharding'."""
+
+    def __init__(self, layer, sharding_optimizer=None, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+        self._layer = layer
+        layer._sharding_stage = 2
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+class ShardingStage3:
+    """Parameter sharding (ZeRO-3): params live sharded over 'sharding'
+    and are all-gathered per-layer by XLA at use sites."""
+
+    def __init__(self, layer, device="tpu", group=None, sync_buffers=False,
+                 segment_size=2 ** 20, **kw):
+        self._layer = layer
+        layer._sharding_stage = 3
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    def get_all_parameters(self):
+        return self._layer.parameters()
+
+
+GroupShardedOptimizerStage2 = ShardingOptimizerStage2
+GroupShardedStage2 = ShardingStage2
+GroupShardedStage3 = ShardingStage3

@@ -1,0 +1,43 @@
+"""Data parallel. Parity: python/paddle/fluid/dygraph/parallel.py
+(DataParallel with the C++ reducer, imperative/reducer.cc).
+
+TPU-native: there is no per-rank process holding a replica — the jit path
+shards the batch over the 'dp' mesh axis and XLA inserts one fused psum
+over the gradients (the moral equivalent of the reducer's bucketed
+allreduce, but scheduled by the compiler). DataParallel therefore wraps
+the layer for API parity and marks it so fleet/TrainStep builders shard
+the batch; eager single-device behavior is identity.
+"""
+from ..framework.core import Tensor
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel:
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        layers._is_data_parallel = True
+        self.find_unused_parameters = find_unused_parameters
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # XLA emits the dp psum inside the jitted step
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,20 @@
+"""paddle.distributed.spawn parity (python/paddle/distributed/spawn.py).
+
+In the single-controller SPMD model one process drives every local chip,
+so spawn degenerates to calling the function once with the parallel env
+initialized — the semantics user code observes (func sees a world with
+all devices) are preserved.
+"""
+from .env import init_parallel_env
+
+__all__ = ["spawn"]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    init_parallel_env()
+    result = func(*args)
+
+    class _Context:
+        def join(self):
+            return result
+    return _Context()

@@ -1,0 +1,229 @@
+"""Distributed tests on the 8-virtual-device CPU mesh (SURVEY.md §4)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.env import build_mesh
+from paddle_tpu.distributed.meta_parallel import (PipelineLayer,
+                                                  PipelineParallel,
+                                                  LayerDesc)
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+
+def make_loss_fn():
+    def loss_fn(out, y):
+        return nn.functional.cross_entropy(
+            out.reshape([-1, out.shape[-1]]), y.reshape([-1]))
+    return loss_fn
+
+
+class TestMesh:
+    def test_build_mesh_axes(self):
+        mesh = build_mesh(dp=2, mp=2, sharding=2)
+        assert dict(mesh.shape) == {"dp": 2, "sharding": 2, "pp": 1,
+                                    "mp": 2, "sp": 1}
+
+    def test_fleet_init_topology(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 4
+        strategy.hybrid_configs["mp_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 4
+        assert hcg.get_model_parallel_world_size() == 2
+
+
+class TestHybridTrain:
+    def test_dp_mp_sharding_step(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 2
+        strategy.hybrid_configs["mp_degree"] = 2
+        strategy.hybrid_configs["sharding_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = fleet.build_train_step(m, make_loss_fn(), o)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        l0 = step(ids, ids).item()
+        for _ in range(3):
+            l = step(ids, ids).item()
+        assert l < l0
+        pk = "gpt.h.0.attn.qkv_proj.weight"
+        assert "mp" in str(step.params[pk].sharding.spec)
+        assert "sharding" in str(step.opt_state[pk][0].sharding.spec)
+
+    def test_collectives_in_hlo(self):
+        """The compiled hybrid step must contain real cross-device
+        collectives (dp grad psum / mp activity)."""
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 4
+        strategy.hybrid_configs["mp_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.SGD(learning_rate=1e-3, parameters=m.parameters())
+        step = fleet.build_train_step(m, make_loss_fn(), o)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        hlo = step.compiled_text(ids, ids)
+        assert "all-reduce" in hlo or "all-gather" in hlo or \
+            "reduce-scatter" in hlo
+
+    def test_dp_matches_single_device(self):
+        """dp=8 training must produce the same loss trajectory as a
+        single-device run on the same global batch."""
+        paddle.seed(0)
+        m1 = GPTForCausalLM(gpt_tiny())
+        sd = m1.state_dict()
+
+        ids = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 1024, size=(8, 16)))
+        from paddle_tpu.jit import TrainStep
+
+        o1 = opt.SGD(learning_rate=0.01, parameters=m1.parameters())
+        s1 = TrainStep(m1, make_loss_fn(), o1)
+        seq = [s1(ids, ids).item() for _ in range(3)]
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 8
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m2 = GPTForCausalLM(gpt_tiny())
+        m2.set_state_dict(sd)
+        o2 = opt.SGD(learning_rate=0.01, parameters=m2.parameters())
+        s2 = fleet.build_train_step(m2, make_loss_fn(), o2)
+        par = [s2(ids, ids).item() for _ in range(3)]
+        np.testing.assert_allclose(seq, par, rtol=1e-4, atol=1e-5)
+
+    def test_grad_accumulation(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.SGD(learning_rate=1e-2, parameters=m.parameters())
+        step = fleet.build_train_step(m, make_loss_fn(), o,
+                                      accumulate_steps=2)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        l0 = step(ids, ids).item()
+        l1 = step(ids, ids).item()
+        assert np.isfinite(l0) and l1 < l0
+
+
+class TestPipeline:
+    def test_forward_parity_and_training(self):
+        paddle.seed(0)
+        mesh = build_mesh(dp=1, pp=4, mp=1, devices=jax.devices()[:4])
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 16, 16) for _ in range(8)],
+            num_stages=4, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        o = opt.SGD(learning_rate=0.02, parameters=pipe.parameters())
+        pp = PipelineParallel(pipe, o, mesh, n_micro=4)
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        np.testing.assert_allclose(pp.forward(x).numpy(),
+                                   pipe(x).numpy(), rtol=1e-4, atol=1e-5)
+        l0 = pp.train_batch(x, y).item()
+        for _ in range(10):
+            l = pp.train_batch(x, y).item()
+        assert l < l0
+
+    def test_nonuniform_stages_rejected(self):
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Linear, 16, 8),
+             LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.ReLU)],
+            num_stages=2)
+        o = opt.SGD(parameters=pipe.parameters())
+        mesh = build_mesh(dp=1, pp=2, mp=1, devices=jax.devices()[:2])
+        with pytest.raises(ValueError):
+            PipelineParallel(pipe, o, mesh, n_micro=2)
+
+
+class TestMPLayers:
+    def test_column_row_roundtrip(self):
+        from paddle_tpu.distributed.meta_parallel import (
+            ColumnParallelLinear, RowParallelLinear)
+        paddle.seed(0)
+        col = ColumnParallelLinear(8, 16, gather_output=False)
+        row = RowParallelLinear(16, 8, input_is_parallel=True)
+        x = paddle.randn([4, 8])
+        out = row(col(x))
+        assert out.shape == [4, 8]
+        # eager equivalence to plain two-layer matmul
+        ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) \
+            @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_vocab_parallel_embedding(self):
+        from paddle_tpu.distributed.meta_parallel import \
+            VocabParallelEmbedding
+        emb = VocabParallelEmbedding(100, 16)
+        ids = paddle.to_tensor(np.array([[1, 5], [7, 99]]))
+        assert emb(ids).shape == [2, 2, 16]
+
+
+class TestRecompute:
+    def test_recompute_matches_plain(self):
+        from paddle_tpu.distributed.fleet.utils.recompute import recompute
+        paddle.seed(0)
+        lin = nn.Linear(8, 8)
+        x = paddle.randn([4, 8])
+
+        from paddle_tpu.jit.api import functional_call, state_arrays
+        params, _ = state_arrays(lin)
+
+        def with_remat(ps):
+            def f(p):
+                def inner(xx):
+                    return functional_call(lin, p, {}, (xx,))
+                return jax.checkpoint(inner)(x.value).sum()
+            return f(ps)
+
+        def plain(ps):
+            return functional_call(lin, ps, {}, (x.value,)).sum()
+
+        g1 = jax.grad(with_remat)(params)
+        g2 = jax.grad(plain)(params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(g1[k]),
+                                       np.asarray(g2[k]), rtol=1e-5)
+
+
+class TestAutoParallel:
+    def test_shard_tensor(self):
+        from paddle_tpu.distributed import shard_tensor, ProcessMesh
+        mesh = ProcessMesh(shape=(4, 2), dim_names=["x", "y"])
+        t = paddle.ones([8, 4])
+        shard_tensor(t, mesh, ["x", None])
+        assert "x" in str(t.value.sharding.spec)
+
+
+class TestCollectivesAPI:
+    def test_spmd_psum(self):
+        from paddle_tpu.distributed import psum
+        from jax.sharding import PartitionSpec as P
+        mesh = build_mesh(dp=8)
+
+        def f(x):
+            return psum(x, "dp")
+        out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
+                            out_specs=P())(jnp.arange(8.0))
+        assert float(out[0]) == 28.0
+
+    def test_eager_api_parity(self):
+        import paddle_tpu.distributed as dist
+        t = paddle.ones([4])
+        dist.all_reduce(t)
+        lst = []
+        dist.all_gather(lst, t)
+        assert len(lst) == 1
+        dist.broadcast(t, 0)
+        assert dist.get_world_size() == 8

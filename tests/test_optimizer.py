@@ -113,13 +113,14 @@ class TestAdamFamily:
                                    rtol=1e-3, atol=1e-4)
 
     def test_converges(self):
+        paddle.seed(123)
         m = nn.Linear(2, 1)
         o = opt.Adam(learning_rate=0.05, parameters=m.parameters())
         x = paddle.to_tensor(
             np.random.RandomState(0).rand(32, 2).astype(np.float32))
         y = paddle.to_tensor(
             (x.numpy() @ np.array([[2.0], [-1.0]]) + 0.5).astype(np.float32))
-        for i in range(150):
+        for i in range(250):
             loss = ((m(x) - y) ** 2).mean()
             loss.backward()
             o.step()
