@@ -120,7 +120,7 @@ class TestAdamFamily:
             np.random.RandomState(0).rand(32, 2).astype(np.float32))
         y = paddle.to_tensor(
             (x.numpy() @ np.array([[2.0], [-1.0]]) + 0.5).astype(np.float32))
-        for i in range(250):
+        for i in range(400):
             loss = ((m(x) - y) ** 2).mean()
             loss.backward()
             o.step()
