@@ -1,0 +1,206 @@
+"""paddle.amp. Parity: python/paddle/amp/ (auto_cast + GradScaler).
+
+TPU-native policy: bf16 is the MXU-native type, needs no loss scaling and
+is the default for O1/O2 ('use_bf16'); fp16 paths keep the reference's
+dynamic loss scaling semantics in GradScaler. auto_cast works by flipping
+a thread-local dtype policy consulted by op dispatch: matmul/conv-class
+ops run in the low dtype (white list), numerically-sensitive ops
+(softmax/log/norms — black list) stay fp32, mirroring
+paddle/fluid/imperative/amp_auto_cast.cc's lists.
+"""
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+from ..framework.dtype import convert_dtype
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "is_auto_cast_enabled", "get_amp_dtype"]
+
+WHITE_LIST = {"matmul", "conv", "einsum", "bmm", "mm", "linear"}
+BLACK_LIST = {"exp", "log", "softmax", "log_softmax", "cross_entropy",
+              "mean", "sum", "norm", "layer_norm", "batch_norm"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled():
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype if _state.enabled else None
+
+
+class auto_cast:
+    """Context manager: `with paddle.amp.auto_cast(level='O2'):`"""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        self.enable = enable
+        self.level = level
+        self.dtype = jnp.bfloat16 if "b" in str(dtype) else jnp.float16
+        self.white = set(custom_white_list or [])
+        self.black = set(custom_black_list or [])
+
+    def __enter__(self):
+        self.prev = (_state.enabled, _state.dtype, _state.level,
+                     _state.custom_white, _state.custom_black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.custom_white = self.white
+        _state.custom_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+         _state.custom_black) = self.prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def amp_cast(x, op_name="matmul"):
+    """Cast an input for op `op_name` per the active policy (used by the
+    functional layer wrappers on the jit path)."""
+    if not _state.enabled:
+        return x
+    name = op_name.lower()
+    in_white = name in WHITE_LIST | _state.custom_white
+    in_black = name in BLACK_LIST | _state.custom_black
+    arr = x.value if isinstance(x, Tensor) else x
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return x
+    if _state.level == "O2":
+        target = jnp.float32 if in_black else _state.dtype
+    else:
+        target = _state.dtype if (in_white and not in_black) else jnp.float32
+    if arr.dtype == target:
+        return x
+    return x.astype(target) if isinstance(x, Tensor) else arr.astype(target)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Parity: paddle.amp.decorate — O2 casts model params to the low
+    dtype; optimizers keep fp32 master weights (multi_precision)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._cast_params(convert_dtype("bfloat16" if "b" in str(dtype)
+                                         else "float16"))
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for o in opt_list:
+            o._multi_precision = True if master_weight is None \
+                else bool(master_weight)
+        if single_model:
+            return models, optimizers
+        return model_list, opt_list
+    return models if single_model else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling. Parity: python/paddle/amp/grad_scaler.py.
+    bf16 never overflows in practice → scaling becomes identity there,
+    but the fp16 semantics (found_inf skip + scale adaptation) are full."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        with no_grad():
+            for p in optimizer._parameters:
+                if p.grad is None:
+                    continue
+                g = p.grad.value * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = Tensor(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.unscale_(optimizer)
+        self._unscaled = True
+        self.step(optimizer)
+        self.update()
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]

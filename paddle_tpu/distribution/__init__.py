@@ -1,0 +1,280 @@
+"""paddle.distribution. Parity: python/paddle/distribution/."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from ..framework.random import split_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
+           "Dirichlet", "Multinomial", "ExponentialFamily",
+           "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(np.asarray(x, dtype=np.float32))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value).value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(split_key(), shape)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(split_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            arr = _arr(logits)
+            self.logits = arr - jax.scipy.special.logsumexp(
+                arr, -1, keepdims=True)
+        else:
+            p = _arr(probs if probs is not None else logits)
+            p = p / jnp.sum(p, -1, keepdims=True)
+            self.logits = jnp.log(jnp.maximum(p, 1e-38))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        out = jax.random.categorical(split_key(), self.logits,
+                                     shape=shape + self.batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        idx = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self.logits, idx[..., None], -1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return Tensor(-jnp.sum(p * self.logits, -1))
+
+    def kl_divergence(self, other):
+        p = jnp.exp(self.logits)
+        return Tensor(jnp.sum(p * (self.logits - other.logits), -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.beta(split_key(), self.alpha, self.beta, shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lg = jax.scipy.special.gammaln
+        lbeta = lg(self.alpha) + lg(self.beta) - lg(self.alpha + self.beta)
+        return Tensor((self.alpha - 1) * jnp.log(v) +
+                      (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        a, b = self.alpha, self.beta
+        lbeta = lg(a) + lg(b) - lg(a + b)
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b) +
+                      (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / jnp.sum(c, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        out = jax.random.dirichlet(split_key(), self.concentration,
+                                   tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        c = self.concentration
+        lg = jax.scipy.special.gammaln
+        norm = jnp.sum(lg(c), -1) - lg(jnp.sum(c, -1))
+        return Tensor(jnp.sum((c - 1) * jnp.log(v), -1) - norm)
+
+    def entropy(self):
+        c = self.concentration
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        c0 = jnp.sum(c, -1)
+        k = c.shape[-1]
+        lnB = jnp.sum(lg(c), -1) - lg(c0)
+        return Tensor(lnB + (c0 - k) * dg(c0) -
+                      jnp.sum((c - 1) * dg(c), -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self.probs_arr = p / jnp.sum(p, -1, keepdims=True)
+        super().__init__(p.shape[:-1], p.shape[-1:])
+
+    def sample(self, shape=()):
+        cat = jax.random.categorical(
+            split_key(), jnp.log(self.probs_arr),
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        k = self.probs_arr.shape[-1]
+        onehot = jax.nn.one_hot(cat, k)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lg = jax.scipy.special.gammaln
+        logits = jnp.log(self.probs_arr)
+        return Tensor(lg(jnp.asarray(self.total_count + 1.0)) -
+                      jnp.sum(lg(v + 1), -1) + jnp.sum(v * logits, -1))
+
+
+class ExponentialFamily(Distribution):
+    pass
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        return p.kl_divergence(q)
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+        t = lg(a1 + b1) - lg(a1) - lg(b1) - \
+            (lg(a2 + b2) - lg(a2) - lg(b2))
+        return Tensor(t + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1) +
+                      (a2 - a1 + b2 - b1) * dg(a1 + b1))
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        lg = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        c1, c2 = p.concentration, q.concentration
+        s1 = jnp.sum(c1, -1)
+        t = lg(s1) - jnp.sum(lg(c1), -1) - \
+            (lg(jnp.sum(c2, -1)) - jnp.sum(lg(c2), -1))
+        return Tensor(t + jnp.sum(
+            (c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,68 @@
+"""paddle.fft. Parity: python/paddle/fft.py — jnp.fft delegation (XLA FFT)."""
+import jax.numpy as jnp
+
+from .framework.core import Tensor, apply_op
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft",
+           "irfft", "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk(jfn, has_n=True):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        if has_n:
+            return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
+        return apply_op(lambda a: jfn(a, axis=axis, norm=norm), x)
+    return op
+
+
+fft = _mk(jnp.fft.fft)
+ifft = _mk(jnp.fft.ifft)
+rfft = _mk(jnp.fft.rfft)
+irfft = _mk(jnp.fft.irfft)
+hfft = _mk(jnp.fft.hfft)
+ihfft = _mk(jnp.fft.ihfft)
+
+
+def _mk2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda a: jfn(a, s=s, axes=tuple(axes), norm=norm),
+                        x)
+    return op
+
+
+fft2 = _mk2(jnp.fft.fft2)
+ifft2 = _mk2(jnp.fft.ifft2)
+rfft2 = _mk2(jnp.fft.rfft2)
+irfft2 = _mk2(jnp.fft.irfft2)
+
+
+def _mkn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply_op(lambda a: jfn(a, s=s, axes=ax, norm=norm), x)
+    return op
+
+
+fftn = _mkn(jnp.fft.fftn)
+ifftn = _mkn(jnp.fft.ifftn)
+rfftn = _mkn(jnp.fft.rfftn)
+irfftn = _mkn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=ax), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=ax), x)

@@ -1,0 +1,5 @@
+"""paddle.hapi. Parity: python/paddle/hapi/__init__.py."""
+from .model import Model
+from . import callbacks
+from .model_summary import summary
+from .dynamic_flops import flops

@@ -1,0 +1,54 @@
+"""paddle.flops. Parity: python/paddle/hapi/dynamic_flops.py."""
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["flops"]
+
+
+def _conv_flops(layer, ins, out):
+    k = int(np.prod(layer._kernel_size))
+    cin = layer._in_channels // layer._groups
+    out_elems = out.size
+    return out_elems * (2 * cin * k - 1)
+
+
+def _linear_flops(layer, ins, out):
+    return out.size * (2 * layer._in_features - 1)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .. import zeros
+    total = [0]
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(layer):
+        def hook(l, ins, out):
+            ty = type(l).__name__
+            if type(l) in custom_ops:
+                total[0] += custom_ops[type(l)](l, ins, out)
+            elif ty.startswith("Conv"):
+                total[0] += _conv_flops(l, ins, out)
+            elif ty == "Linear":
+                total[0] += _linear_flops(l, ins, out)
+            elif "Norm" in ty or ty.startswith("ReLU"):
+                total[0] += out.size if isinstance(out, Tensor) else 0
+        return hook
+
+    for _, layer in net.named_sublayers():
+        if not layer._sub_layers:
+            hooks.append(layer.register_forward_post_hook(make_hook(layer)))
+    x = zeros(list(input_size))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]:,}")
+    return int(total[0])

@@ -1,0 +1,200 @@
+"""paddle.Model high-level API. Parity: python/paddle/hapi/model.py.
+
+fit/evaluate/predict drive the jitted TrainStep (single XLA computation
+per step) rather than per-op dygraph — the reference's DynamicGraphAdapter
+replaced by the functional path.
+"""
+import os
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from ..io import DataLoader
+from ..metric import Metric
+from . import callbacks as cb_mod
+
+__all__ = ["Model"]
+
+
+class _InputSpecList(list):
+    pass
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        return self
+
+    def _loss_fn(self, outputs, labels):
+        if callable(self._loss):
+            return self._loss(outputs, labels)
+        raise RuntimeError("Model.prepare(loss=...) required")
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from ..jit import TrainStep
+            self._train_step = TrainStep(self.network, self._loss_fn,
+                                         self._optimizer)
+
+    # -- steps ---------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self._ensure_train_step()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        loss = self._train_step(*ins, labs[0])
+        return [float(loss.item())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+            self._train_step = None
+        self.network.eval()
+        out = self.network(*ins)
+        loss = self._loss_fn(out, labs[0]) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(out, labs[0])
+            m.update(res)
+            metrics.append(m.accumulate())
+        self.network.train()
+        return ([float(loss.item())] if loss is not None else []), metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+            self._train_step = None
+        self.network.eval()
+        out = self.network(*ins)
+        self.network.train()
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.numpy() for o in outs]
+
+    # -- loops ---------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbks = cb_mod.config_callbacks(callbacks, self, epochs, None,
+                                       verbose, log_freq, save_dir,
+                                       save_freq, self._metrics)
+        cbks.on_begin("train")
+        steps_done = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                ins, labs = batch[:-1], batch[-1]
+                cbks.on_batch_begin("train", step, logs)
+                losses = self.train_batch(list(ins), labs)
+                logs = {"loss": losses, "step": step}
+                cbks.on_batch_end("train", step, logs)
+                steps_done += 1
+                if num_iters is not None and steps_done >= num_iters:
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eres = self.evaluate(eval_data, batch_size=batch_size,
+                                     verbose=0, num_workers=num_workers)
+                logs.update({"eval_" + k: v for k, v in eres.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+            if num_iters is not None and steps_done >= num_iters:
+                break
+        cbks.on_end("train")
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, labs = batch[:-1], batch[-1]
+            l, _ = self.eval_batch(list(ins), labs)
+            losses.extend(l)
+        out = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            out[m.name()] = m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            ins = batch if not isinstance(batch, (list, tuple)) else batch
+            if isinstance(ins, (list, tuple)) and len(ins) > 1:
+                ins = ins[:-1]
+            outputs.append(self.predict_batch(list(ins)
+                                              if isinstance(ins, (list,
+                                                                  tuple))
+                                              else [ins]))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        if training:
+            psave(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                psave(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from ..jit import save as jit_save
+            if not self._inputs:
+                raise ValueError("inference save needs Model(inputs=...)")
+            jit_save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        state = pload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if os.path.exists(opt_path) and self._optimizer is not None \
+                and not reset_optimizer:
+            self._optimizer.set_state_dict(pload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size or
+                       [tuple(s.shape) for s in (self._inputs or [])])

@@ -1,0 +1,63 @@
+"""paddle.summary. Parity: python/paddle/hapi/model_summary.py."""
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .. import zeros
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, ins, out):
+            try:
+                oshape = list(out.shape) if isinstance(out, Tensor) \
+                    else [list(o.shape) for o in out
+                          if isinstance(o, Tensor)]
+            except Exception:
+                oshape = "?"
+            n_params = sum(p.size for p in l._parameters.values()
+                           if p is not None)
+            rows.append((name, type(l).__name__, oshape, n_params))
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    if input is not None:
+        ins = input if isinstance(input, (list, tuple)) else [input]
+    else:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        ins = [zeros([s if s is not None and s != -1 else 1
+                      for s in size]) for size in sizes]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*ins)
+    finally:
+        net.train() if was_training else None
+        for h in hooks:
+            h.remove()
+
+    total_params = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    width = 76
+    print("-" * width)
+    print(f"{'Layer (type)':<38}{'Output Shape':<24}{'Param #':<12}")
+    print("=" * width)
+    for name, ty, oshape, n in rows:
+        print(f"{name + ' (' + ty + ')':<38}{str(oshape):<24}{n:<12}")
+    print("=" * width)
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total_params - trainable:,}")
+    print("-" * width)
+    return {"total_params": int(total_params),
+            "trainable_params": int(trainable)}

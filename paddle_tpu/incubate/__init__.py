@@ -1,0 +1,155 @@
+"""paddle.incubate. Parity: python/paddle/incubate/__init__.py (subset:
+the pieces the training stack uses — fused ops route to Pallas/XLA)."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "graph_send_recv", "segment_sum", "segment_mean", "segment_max",
+           "segment_min", "optimizer", "nn"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    return apply_op(
+        lambda a, m: jax.nn.softmax(a + m.astype(a.dtype), -1), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    def fn(a):
+        T = a.shape[-1]
+        causal = jnp.tril(jnp.ones((a.shape[-2], T), bool), k=T - a.shape[-2])
+        return jax.nn.softmax(jnp.where(causal, a, -1e30), -1)
+    return apply_op(fn, x)
+
+
+def _segment(op, init):
+    def seg(data, segment_ids, name=None):
+        def fn(d, ids):
+            n = int(jnp.max(ids)) + 1 if not isinstance(
+                ids, jax.core.Tracer) else d.shape[0]
+            out = jnp.full((n,) + d.shape[1:], init, d.dtype)
+            if op == "sum" or op == "mean":
+                out = jnp.zeros((n,) + d.shape[1:], d.dtype).at[ids].add(d)
+                if op == "mean":
+                    cnt = jnp.zeros((n,), d.dtype).at[ids].add(1.0)
+                    out = out / jnp.maximum(cnt, 1.0).reshape(
+                        (-1,) + (1,) * (d.ndim - 1))
+                return out
+            if op == "max":
+                return out.at[ids].max(d)
+            return out.at[ids].min(d)
+        return apply_op(fn, data, segment_ids)
+    return seg
+
+
+segment_sum = _segment("sum", 0.0)
+segment_mean = _segment("mean", 0.0)
+segment_max = _segment("max", -jnp.inf)
+segment_min = _segment("min", jnp.inf)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    def fn(a, src, dst):
+        gathered = a[src]
+        n = a.shape[0] if out_size is None else out_size
+        if pool_type in ("sum", "mean"):
+            out = jnp.zeros((n,) + a.shape[1:], a.dtype).at[dst].add(
+                gathered)
+            if pool_type == "mean":
+                cnt = jnp.zeros((n,), a.dtype).at[dst].add(1.0)
+                out = out / jnp.maximum(cnt, 1.0).reshape(
+                    (-1,) + (1,) * (a.ndim - 1))
+            return out
+        if pool_type == "max":
+            return jnp.full((n,) + a.shape[1:], -jnp.inf,
+                            a.dtype).at[dst].max(gathered)
+        return jnp.full((n,) + a.shape[1:], jnp.inf,
+                        a.dtype).at[dst].min(gathered)
+    return apply_op(fn, x, src_index, dst_index)
+
+
+class optimizer:
+    """paddle.incubate.optimizer — LookAhead / ModelAverage."""
+
+    class LookAhead:
+        def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+            self.inner = inner_optimizer
+            self.alpha = alpha
+            self.k = k
+            self._slow = None
+            self._count = 0
+
+        def step(self):
+            from ..framework.core import no_grad
+            self.inner.step()
+            self._count += 1
+            if self._slow is None:
+                self._slow = [p.value for p in self.inner._parameters]
+            if self._count % self.k == 0:
+                with no_grad():
+                    for p, s in zip(self.inner._parameters, self._slow):
+                        new_slow = s + self.alpha * (p.value - s)
+                        p.set_value(new_slow)
+                    self._slow = [p.value for p in self.inner._parameters]
+
+        def clear_grad(self):
+            self.inner.clear_grad()
+
+        def minimize(self, loss):
+            loss.backward()
+            self.step()
+
+    class ModelAverage:
+        def __init__(self, average_window_rate, parameters=None,
+                     min_average_window=10000,
+                     max_average_window=10000, name=None):
+            self.parameters = parameters or []
+            self._sum = None
+            self._n = 0
+
+        def step(self):
+            if self._sum is None:
+                self._sum = [p.value for p in self.parameters]
+            else:
+                self._sum = [s + p.value
+                             for s, p in zip(self._sum, self.parameters)]
+            self._n += 1
+
+        def apply(self, executor=None, need_restore=True):
+            import contextlib
+
+            @contextlib.contextmanager
+            def ctx():
+                from ..framework.core import no_grad
+                backup = [p.value for p in self.parameters]
+                with no_grad():
+                    for p, s in zip(self.parameters, self._sum):
+                        p.set_value(s / max(self._n, 1))
+                yield
+                if need_restore:
+                    with no_grad():
+                        for p, b in zip(self.parameters, backup):
+                            p.set_value(b)
+            return ctx()
+
+
+class nn:
+    """paddle.incubate.nn — fused layer entry points map onto Pallas."""
+
+    class FusedMultiHeadAttention:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                "use paddle_tpu.nn.MultiHeadAttention — it already "
+                "dispatches to the fused Pallas flash-attention kernel")
+
+    class FusedFeedForward:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                "XLA fuses the FFN (matmul+gelu+matmul) automatically")
+
+    @staticmethod
+    def fused_multi_head_attention(*a, **k):
+        raise NotImplementedError(
+            "use nn.functional.scaled_dot_product_attention")

@@ -1,0 +1,164 @@
+"""Paddle Inference API. Parity: python/paddle/inference/__init__.py +
+paddle/fluid/inference/api/ (AnalysisConfig/AnalysisPredictor).
+
+TPU-native: the serialized model is StableHLO (jit.save format); the
+Predictor deserializes it into a PjRt executable — XLA replaces the
+reference's IR analysis passes and TensorRT engine. Zero-copy handles map
+onto device arrays.
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 5
+
+
+class Config:
+    def __init__(self, model_path=None, params_path=None):
+        # jit.save writes <prefix>.pdmodel/.pdiparams; accept either the
+        # prefix or the explicit .pdmodel path like the reference
+        if model_path and model_path.endswith(".pdmodel"):
+            model_path = model_path[:-len(".pdmodel")]
+        self._prefix = model_path
+        self._use_tpu = True
+        self._precision = PrecisionType.Float32
+        self._enable_memory_optim = True
+        self._cpu_math_library_num_threads = 1
+
+    def model_dir(self):
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # device knobs: XLA owns placement; these record intent for parity
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    def enable_tpu(self):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def use_gpu(self):
+        return self._use_tpu
+
+    def enable_memory_optim(self, x=True):
+        self._enable_memory_optim = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_library_num_threads = n
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, x=True):
+        pass  # XLA pipeline always optimizes
+
+    def switch_use_feed_fetch_ops(self, x):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # XLA is the engine
+
+    def set_precision(self, p):
+        self._precision = p
+
+    def summary(self):
+        return f"Config(prefix={self._prefix}, tpu={self._use_tpu})"
+
+
+class _IOHandle:
+    """Zero-copy style input/output handle over a device array slot."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._p._inputs[self._name] = jnp.asarray(np.asarray(arr))
+
+    def reshape(self, shape):
+        pass
+
+    def copy_to_cpu(self):
+        return np.asarray(self._p._outputs[self._name])
+
+    def to_dlpack(self):
+        return self._p._outputs[self._name].__dlpack__()
+
+    def shape(self):
+        src = self._p._inputs if self._is_input else self._p._outputs
+        return list(src[self._name].shape)
+
+
+class Predictor:
+    def __init__(self, config):
+        from ..jit import load as jit_load
+        self._config = config
+        self._layer = jit_load(config._prefix)
+        n_in = len(self._layer._meta.get("input_specs", [])) or 1
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._output_names = []
+        self._inputs = {}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        if not self._output_names:
+            return ["output_0"]
+        return self._output_names
+
+    def get_input_handle(self, name):
+        return _IOHandle(self, name, True)
+
+    def get_output_handle(self, name):
+        return _IOHandle(self, name, False)
+
+    def run(self, inputs=None):
+        if inputs is not None:  # direct list API
+            arrs = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                    for a in inputs]
+        else:
+            arrs = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*arrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        for n, o in zip(self._output_names, outs):
+            self._outputs[n] = o.value if isinstance(o, Tensor) else o
+        if inputs is not None:
+            return [np.asarray(self._outputs[n])
+                    for n in self._output_names]
+        return True
+
+    def clone(self):
+        return Predictor(self._config)
+
+
+def create_predictor(config):
+    return Predictor(config)

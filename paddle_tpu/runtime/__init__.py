@@ -1,0 +1,58 @@
+"""Native runtime bindings: build + load the C++ core via ctypes."""
+import ctypes
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "cpp", "runtime_core.cpp")
+_BUILD = os.path.join(_HERE, "build")
+_SO = os.path.join(_BUILD, "libpaddle_tpu_runtime.so")
+
+_lib = None
+
+
+def _build():
+    os.makedirs(_BUILD, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building on first use) the native runtime; None if no
+    toolchain is available (pure-python fallbacks take over)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    try:
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.rb_create.restype = ctypes.c_void_p
+        lib.rb_create.argtypes = [ctypes.c_size_t]
+        lib.rb_push.restype = ctypes.c_int
+        lib.rb_push.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                ctypes.c_int]
+        lib.rb_pop.restype = ctypes.c_int
+        lib.rb_pop.argtypes = [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.c_int]
+        lib.rb_close.argtypes = [ctypes.c_void_p]
+        lib.rb_size.restype = ctypes.c_size_t
+        lib.rb_size.argtypes = [ctypes.c_void_p]
+        lib.rb_destroy.argtypes = [ctypes.c_void_p]
+        lib.fast_stack.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_void_p, ctypes.c_int]
+        lib.parallel_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_size_t, ctypes.c_int]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+from . import prefetch  # noqa: E402
+from .prefetch import fast_collate_numpy  # noqa: E402

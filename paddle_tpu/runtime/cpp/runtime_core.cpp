@@ -1,0 +1,147 @@
+// paddle_tpu native runtime core.
+//
+// TPU-native counterpart of the reference's C++ reader/feeder machinery
+// (paddle/fluid/operators/reader/buffered_reader.cc + blocking_queue.h):
+// a bounded MPMC ring buffer used by the DataLoader to overlap host-side
+// batch assembly with device compute, and a multithreaded memcpy batch
+// collator (the reference stacks samples inside DataFeeder; here large
+// numeric batches bypass numpy's single-threaded np.stack).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------- bounded MPMC ring buffer of opaque handles ------------
+struct RingBuffer {
+    std::deque<uint64_t> items;
+    size_t capacity;
+    bool closed = false;
+    std::mutex mu;
+    std::condition_variable not_full;
+    std::condition_variable not_empty;
+};
+
+void* rb_create(size_t capacity) {
+    auto* rb = new RingBuffer();
+    rb->capacity = capacity ? capacity : 1;
+    return rb;
+}
+
+// returns 0 on success, -1 if closed
+int rb_push(void* handle, uint64_t item, int timeout_ms) {
+    auto* rb = static_cast<RingBuffer*>(handle);
+    std::unique_lock<std::mutex> lk(rb->mu);
+    auto pred = [rb] { return rb->closed || rb->items.size() < rb->capacity; };
+    if (timeout_ms > 0) {
+        if (!rb->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred))
+            return -2;  // timeout
+    } else {
+        rb->not_full.wait(lk, pred);
+    }
+    if (rb->closed) return -1;
+    rb->items.push_back(item);
+    rb->not_empty.notify_one();
+    return 0;
+}
+
+// returns 0 on success, -1 if closed+empty, -2 on timeout
+int rb_pop(void* handle, uint64_t* out, int timeout_ms) {
+    auto* rb = static_cast<RingBuffer*>(handle);
+    std::unique_lock<std::mutex> lk(rb->mu);
+    auto pred = [rb] { return rb->closed || !rb->items.empty(); };
+    if (timeout_ms > 0) {
+        if (!rb->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred))
+            return -2;
+    } else {
+        rb->not_empty.wait(lk, pred);
+    }
+    if (rb->items.empty()) return -1;  // closed and drained
+    *out = rb->items.front();
+    rb->items.pop_front();
+    rb->not_full.notify_one();
+    return 0;
+}
+
+void rb_close(void* handle) {
+    auto* rb = static_cast<RingBuffer*>(handle);
+    {
+        std::lock_guard<std::mutex> lk(rb->mu);
+        rb->closed = true;
+    }
+    rb->not_full.notify_all();
+    rb->not_empty.notify_all();
+}
+
+size_t rb_size(void* handle) {
+    auto* rb = static_cast<RingBuffer*>(handle);
+    std::lock_guard<std::mutex> lk(rb->mu);
+    return rb->items.size();
+}
+
+void rb_destroy(void* handle) {
+    delete static_cast<RingBuffer*>(handle);
+}
+
+// ---------------- multithreaded batch collation ------------------------
+// Stack n_samples buffers of item_bytes each into dst (contiguous).
+// Released-GIL callers get parallel memcpy across worker threads.
+void fast_stack(const void** srcs, size_t n_samples, size_t item_bytes,
+                void* dst, int n_threads) {
+    if (n_threads <= 1 || n_samples < 4) {
+        for (size_t i = 0; i < n_samples; ++i) {
+            std::memcpy(static_cast<char*>(dst) + i * item_bytes, srcs[i],
+                        item_bytes);
+        }
+        return;
+    }
+    std::vector<std::thread> threads;
+    size_t per = (n_samples + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+        size_t lo = t * per;
+        size_t hi = lo + per < n_samples ? lo + per : n_samples;
+        if (lo >= hi) break;
+        threads.emplace_back([=] {
+            for (size_t i = lo; i < hi; ++i) {
+                std::memcpy(static_cast<char*>(dst) + i * item_bytes,
+                            srcs[i], item_bytes);
+            }
+        });
+    }
+    for (auto& th : threads) th.join();
+}
+
+// ---------------- host pinned-staging copy (device feed) ----------------
+// Chunked parallel memcpy used when staging a large batch into the
+// transfer buffer handed to PjRt.
+void parallel_copy(const void* src, void* dst, size_t nbytes,
+                   int n_threads) {
+    if (n_threads <= 1 || nbytes < (1u << 20)) {
+        std::memcpy(dst, src, nbytes);
+        return;
+    }
+    std::vector<std::thread> threads;
+    size_t per = (nbytes + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+        size_t lo = t * per;
+        size_t hi = lo + per < nbytes ? lo + per : nbytes;
+        if (lo >= hi) break;
+        threads.emplace_back([=] {
+            std::memcpy(static_cast<char*>(dst) + lo,
+                        static_cast<const char*>(src) + lo, hi - lo);
+        });
+    }
+    for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,145 @@
+"""DataLoader prefetch engine over the native ring buffer.
+
+Worker threads pull index batches, run dataset+collate (python), park the
+result in a slot table and push the slot id through the C++ MPMC ring
+buffer (runtime_core.cpp) — the consumer blocks in native code, not on a
+Python queue, and the buffer bounds memory. Falls back to queue.Queue when
+the native lib is unavailable.
+"""
+import ctypes
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+_SENTINEL = object()
+
+
+def prefetch_iterator(index_iter, make_batch, num_workers, capacity,
+                      timeout, worker_init_fn):
+    from . import get_lib
+    lib = get_lib()
+    if lib is None:
+        yield from _py_prefetch(index_iter, make_batch, num_workers,
+                                capacity, worker_init_fn)
+        return
+
+    rb = lib.rb_create(capacity)
+    slots = {}
+    slots_lock = threading.Lock()
+    slot_ids = itertools.count(1)
+    index_lock = threading.Lock()
+    n_inflight = [0]
+    errors = []
+
+    def worker(wid):
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        while True:
+            with index_lock:
+                try:
+                    indices = next(index_iter)
+                except StopIteration:
+                    return
+                n_inflight[0] += 1
+            try:
+                batch = make_batch(indices)
+            except Exception as e:  # propagate to consumer
+                errors.append(e)
+                batch = _SENTINEL
+            sid = next(slot_ids)
+            with slots_lock:
+                slots[sid] = batch
+            if lib.rb_push(rb, sid, 0) != 0:
+                with slots_lock:
+                    slots.pop(sid, None)
+                return
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(num_workers)]
+    for t in threads:
+        t.start()
+
+    def closer():
+        for t in threads:
+            t.join()
+        lib.rb_close(rb)
+
+    threading.Thread(target=closer, daemon=True).start()
+
+    out = ctypes.c_uint64()
+    try:
+        while True:
+            rc = lib.rb_pop(rb, ctypes.byref(out),
+                            int(timeout * 1000) if timeout else 0)
+            if rc == -2:
+                raise TimeoutError("DataLoader worker timed out")
+            if rc != 0:
+                break
+            with slots_lock:
+                batch = slots.pop(out.value)
+            if batch is _SENTINEL:
+                raise errors.pop(0)
+            yield batch
+        if errors:
+            raise errors.pop(0)
+    finally:
+        lib.rb_close(rb)
+        lib.rb_destroy(rb)
+
+
+def _py_prefetch(index_iter, make_batch, num_workers, capacity,
+                 worker_init_fn):
+    q = queue.Queue(maxsize=capacity)
+    index_lock = threading.Lock()
+    done = threading.Event()
+
+    def worker(wid):
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        while True:
+            with index_lock:
+                try:
+                    indices = next(index_iter)
+                except StopIteration:
+                    break
+            try:
+                q.put(make_batch(indices))
+            except Exception as e:
+                q.put(e)
+                break
+        q.put(_SENTINEL)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(num_workers)]
+    for t in threads:
+        t.start()
+    finished = 0
+    while finished < num_workers:
+        item = q.get()
+        if item is _SENTINEL:
+            finished += 1
+            continue
+        if isinstance(item, Exception):
+            raise item
+        yield item
+
+
+def fast_collate_numpy(arrays, n_threads=4):
+    """Stack same-shape numpy arrays with the native parallel memcpy."""
+    from . import get_lib
+    lib = get_lib()
+    sample = np.ascontiguousarray(arrays[0])
+    n = len(arrays)
+    if lib is None or sample.nbytes * n < (1 << 20):
+        return np.stack(arrays)
+    out = np.empty((n,) + sample.shape, dtype=sample.dtype)
+    srcs = (ctypes.c_void_p * n)()
+    keep = []
+    for i, a in enumerate(arrays):
+        a = np.ascontiguousarray(a, dtype=sample.dtype)
+        keep.append(a)
+        srcs[i] = a.ctypes.data
+    lib.fast_stack(srcs, n, sample.nbytes, out.ctypes.data, n_threads)
+    return out

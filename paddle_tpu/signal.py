@@ -1,0 +1,109 @@
+"""paddle.signal. Parity: python/paddle/signal.py (frame/overlap_add/stft/istft)."""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .framework.core import Tensor, apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[:, None] +
+               hop_length * jnp.arange(num)[None, :])
+        out = jnp.take(a, idx.reshape(-1), axis=axis)
+        shp = list(a.shape)
+        if axis == -1 or axis == a.ndim - 1:
+            shp = shp[:-1] + [frame_length, num]
+        else:
+            shp = [frame_length, num] + shp[1:]
+        return out.reshape(shp)
+    return apply_op(fn, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(a):
+        if axis in (-1, a.ndim - 1):
+            fl, num = a.shape[-2], a.shape[-1]
+            n = (num - 1) * hop_length + fl
+            out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+            for i in range(num):
+                out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                    a[..., :, i])
+            return out
+        fl, num = a.shape[0], a.shape[1]
+        n = (num - 1) * hop_length + fl
+        out = jnp.zeros((n,) + a.shape[2:], a.dtype)
+        for i in range(num):
+            out = out.at[i * hop_length:i * hop_length + fl].add(a[:, i])
+        return out
+    return apply_op(fn, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window.value if isinstance(window, Tensor) else (
+        jnp.asarray(window) if window is not None
+        else jnp.ones(win_length))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (pad, n_fft - win_length - pad))
+
+    def fn(a):
+        if center:
+            widths = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, widths, mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None] +
+               hop_length * jnp.arange(num)[None, :])
+        frames = a[..., idx]                       # [..., n_fft, num]
+        frames = frames * wv[:, None]
+        spec = jnp.fft.rfft(frames, axis=-2) if onesided \
+            else jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / math.sqrt(n_fft)
+        return spec
+    return apply_op(fn, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wv = window.value if isinstance(window, Tensor) else (
+        jnp.asarray(window) if window is not None
+        else jnp.ones(win_length))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (pad, n_fft - win_length - pad))
+
+    def fn(spec):
+        if normalized:
+            spec = spec * math.sqrt(n_fft)
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-2) if onesided \
+            else jnp.fft.ifft(spec, axis=-2).real
+        frames = frames * wv[:, None]
+        num = frames.shape[-1]
+        n = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros(n, frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., :, i])
+            wsum = wsum.at[sl].add(wv * wv)
+        out = out / jnp.maximum(wsum, 1e-8)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply_op(fn, x)

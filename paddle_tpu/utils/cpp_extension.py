@@ -1,0 +1,63 @@
+"""paddle.utils.cpp_extension. Parity: python/paddle/utils/cpp_extension/.
+
+The reference JIT-compiles CUDA/C++ custom operators against the paddle
+runtime. TPU-native equivalent: custom *host* ops compile to a shared
+library bound via ctypes (see paddle_tpu/runtime for the in-tree example);
+custom *device* ops should be written as Pallas kernels (paddle_tpu/ops) —
+there is no stable TPU ISA to hand-compile against.
+"""
+import ctypes
+import os
+import subprocess
+import tempfile
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension",
+           "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """Compile C++ sources into a shared lib and return a ctypes handle."""
+    build_dir = build_directory or get_build_directory()
+    so_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [s for s in sources if s.endswith((".cc", ".cpp", ".cxx"))]
+    if not srcs:
+        raise ValueError("cpp_extension.load needs C++ sources "
+                         "(CUDA sources are not applicable on TPU)")
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not os.path.exists(so_path) or os.path.getmtime(so_path) < newest_src:
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", inc]
+        cmd += (extra_cxx_cflags or [])
+        cmd += srcs + ["-o", so_path] + (extra_ldflags or [])
+        if verbose:
+            print("+", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so_path)
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension has no TPU analogue; write device code as Pallas "
+        "kernels (paddle_tpu.ops) and host code via CppExtension")
+
+
+class BuildExtension:
+    @staticmethod
+    def with_options(**options):
+        return BuildExtension

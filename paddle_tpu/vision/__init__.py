@@ -1,0 +1,17 @@
+"""paddle.vision. Parity: python/paddle/vision/__init__.py."""
+from . import models
+from . import transforms
+from . import datasets
+from . import ops
+from .models import *  # noqa: F401,F403
+
+image_backend = "cv2"
+
+
+def set_image_backend(backend):
+    global image_backend
+    image_backend = backend
+
+
+def get_image_backend():
+    return image_backend

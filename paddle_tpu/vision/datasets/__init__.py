@@ -1,0 +1,201 @@
+"""paddle.vision.datasets. Parity: python/paddle/vision/datasets/.
+
+Zero-egress environment: datasets read from local files placed under
+~/.cache/paddle/dataset (the reference's DATA_HOME) and raise a clear
+error otherwise. Formats match the canonical distributions (MNIST
+idx-gzip, CIFAR pickle-tar). `FakeData` generates synthetic samples for
+pipelines/tests.
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "ImageFolder",
+           "DatasetFolder", "FakeData", "DATA_HOME"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def _require(path, name):
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{name} data not found at {path}; this environment has no "
+            "network access — place the official files there manually")
+    return path
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+    IMG = {"train": "train-images-idx3-ubyte.gz",
+           "test": "t10k-images-idx3-ubyte.gz"}
+    LAB = {"train": "train-labels-idx1-ubyte.gz",
+           "test": "t10k-labels-idx1-ubyte.gz"}
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.transform = transform
+        base = os.path.join(DATA_HOME, self.NAME)
+        image_path = image_path or _require(
+            os.path.join(base, self.IMG[mode]), self.NAME)
+        label_path = label_path or _require(
+            os.path.join(base, self.LAB[mode]), self.NAME)
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    NAME = "cifar-10-python.tar.gz"
+    N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        data_file = data_file or _require(
+            os.path.join(DATA_HOME, "cifar", self.NAME), "cifar")
+        imgs, labels = [], []
+        with tarfile.open(data_file) as tf:
+            names = [m for m in tf.getmembers()
+                     if (("data_batch" in m.name or "train" in m.name)
+                         if mode == "train"
+                         else ("test" in m.name))
+                     and m.isfile() and "html" not in m.name]
+            for m in names:
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                if b"data" not in d:
+                    continue
+                imgs.append(np.asarray(d[b"data"]))
+                key = b"labels" if b"labels" in d else b"fine_labels"
+                labels.extend(d[key])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NAME = "cifar-100-python.tar.gz"
+    N_CLASSES = 100
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(
+            "loading encoded images requires PIL; store .npy arrays "
+            "instead in this environment") from e
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(extensions)
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.loader = loader or _load_image
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(extensions)
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class FakeData(Dataset):
+    """Synthetic dataset (shape-compatible stand-in for image corpora)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224),
+                 num_classes=10, transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = rng.randint(0, self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return self.size

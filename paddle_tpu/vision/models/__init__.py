@@ -1,0 +1,7 @@
+"""Parity: python/paddle/vision/models/__init__.py."""
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, wide_resnet50_2, wide_resnet101_2)
+from .small_nets import (LeNet, AlexNet, alexnet, VGG, vgg11, vgg13, vgg16,
+                         vgg19, SqueezeNet, squeezenet1_0, squeezenet1_1)
+from .mobilenet import (MobileNetV1, mobilenet_v1, MobileNetV2,
+                        mobilenet_v2, ShuffleNetV2, shufflenet_v2_x1_0)

@@ -1,0 +1,363 @@
+"""Detection ops. Parity: python/paddle/vision/ops.py (CUDA kernels in the
+reference, e.g. paddle/fluid/operators/detection/). Implemented as pure
+jnp compositions — gather/where formulations that XLA vectorizes; nms runs
+as a host-side numpy routine (dynamic output size, like the reference's
+CPU kernel)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "prior_box", "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg", "psroi_pool"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    b = boxes.numpy()
+    s = scores.numpy() if scores is not None else np.ones(len(b))
+    cats = category_idxs.numpy() if category_idxs is not None else \
+        np.zeros(len(b), np.int64)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or suppressed[j] or cats[j] != cats[i]:
+                continue
+            xx1 = max(b[i, 0], b[j, 0])
+            yy1 = max(b[i, 1], b[j, 1])
+            xx2 = min(b[i, 2], b[j, 2])
+            yy2 = min(b[i, 3], b[j, 3])
+            inter = max(0.0, xx2 - xx1) * max(0.0, yy2 - yy1)
+            iou = inter / (areas[i] + areas[j] - inter + 1e-10)
+            if iou > iou_threshold:
+                suppressed[j] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def fn(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        # assign each roi to its batch image (host-side counts)
+        if isinstance(rois_num, jax.core.Tracer):
+            img_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+        else:
+            img_idx = jnp.concatenate([
+                jnp.full((int(n),), i, jnp.int32)
+                for i, n in enumerate(np.asarray(rois_num))])
+
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-5)
+        rh = jnp.maximum(y2 - y1, 1e-5)
+        bw = rw / ow
+        bh = rh / oh
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+
+        ys = y1[:, None, None] + (jnp.arange(oh)[None, :, None] +
+                                  (jnp.arange(sr)[None, None, :] + 0.5)
+                                  / sr) * bh[:, None, None]
+        xs = x1[:, None, None] + (jnp.arange(ow)[None, :, None] +
+                                  (jnp.arange(sr)[None, None, :] + 0.5)
+                                  / sr) * bw[:, None, None]
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+                 img[:, y1_, x0] * wy * (1 - wx) +
+                 img[:, y0, x1_] * (1 - wy) * wx +
+                 img[:, y1_, x1_] * wy * wx)
+            return v
+
+        def one_roi(ridx):
+            img = feat[img_idx[ridx]]
+            yy = ys[ridx]      # [oh, sr]
+            xx = xs[ridx]      # [ow, sr]
+            gy = jnp.broadcast_to(yy[:, None, :, None], (oh, ow, sr, sr))
+            gx = jnp.broadcast_to(xx[None, :, None, :], (oh, ow, sr, sr))
+            vals = bilinear(img, gy.reshape(-1), gx.reshape(-1))
+            vals = vals.reshape(C, oh, ow, sr * sr)
+            return vals.mean(-1)
+
+        return jax.vmap(one_roi)(jnp.arange(rois.shape[0]))
+    return apply_op(fn, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=2, aligned=False)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    def fn(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tcx = tb[:, 0] + tw / 2
+            tcy = tb[:, 1] + th / 2
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], -1)
+            return out / pbv
+        # decode
+        d = tb * pbv
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                          ocx + ow / 2, ocy + oh / 2], -1)
+    return apply_op(fn, prior_box, prior_box_var, target_box)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    na = len(anchors) // 2
+
+    def fn(feat, imsz):
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, 5 + class_num, H, W)
+        gx = jnp.arange(W).reshape(1, 1, 1, W)
+        gy = jnp.arange(H).reshape(1, 1, H, 1)
+        aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+        ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+        sig = jax.nn.sigmoid
+        bx = (sig(feat[:, :, 0]) * scale_x_y -
+              (scale_x_y - 1) / 2 + gx) / W
+        by = (sig(feat[:, :, 1]) * scale_x_y -
+              (scale_x_y - 1) / 2 + gy) / H
+        bw = jnp.exp(feat[:, :, 2]) * aw / (W * downsample_ratio)
+        bh = jnp.exp(feat[:, :, 3]) * ah / (H * downsample_ratio)
+        conf = sig(feat[:, :, 4])
+        probs = sig(feat[:, :, 5:]) * conf[:, :, None]
+        imh = imsz[:, 0].reshape(N, 1, 1, 1).astype(jnp.float32)
+        imw = imsz[:, 1].reshape(N, 1, 1, 1).astype(jnp.float32)
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+        mask = (conf > conf_thresh).reshape(N, -1, 1)
+        boxes = boxes * mask
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        return boxes, scores
+    return apply_op(fn, x, img_size)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0., 0.), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    def fn(feat, img):
+        H, W = feat.shape[2], feat.shape[3]
+        IH, IW = img.shape[2], img.shape[3]
+        sh = steps[1] or IH / H
+        sw = steps[0] or IW / W
+        ars = list(aspect_ratios)
+        if flip:
+            ars = ars + [1.0 / a for a in ars if a != 1.0]
+        boxes = []
+        for ms in min_sizes:
+            for ar in ars:
+                bw = ms * np.sqrt(ar) / 2
+                bh = ms / np.sqrt(ar) / 2
+                boxes.append((bw, bh))
+            if max_sizes:
+                for mx in max_sizes:
+                    s = np.sqrt(ms * mx) / 2
+                    boxes.append((s, s))
+        nb = len(boxes)
+        cx = (jnp.arange(W) + offset) * sw
+        cy = (jnp.arange(H) + offset) * sh
+        gcx, gcy = jnp.meshgrid(cx, cy, indexing="xy")
+        out = []
+        for bw, bh in boxes:
+            b = jnp.stack([(gcx - bw) / IW, (gcy - bh) / IH,
+                           (gcx + bw) / IW, (gcy + bh) / IH], -1)
+            out.append(b)
+        pri = jnp.stack(out, 2)  # H,W,nb,4
+        if clip:
+            pri = jnp.clip(pri, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               pri.shape)
+        return pri, var
+    return apply_op(fn, input, image)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 as gather + matmul (reference:
+    paddle/fluid/operators/deformable_conv_op.cu)."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def fn(a, off, w, *rest):
+        N, C, H, W = a.shape
+        OC, ICg, KH, KW = w.shape
+        OH = (H + 2 * pd[0] - dl[0] * (KH - 1) - 1) // st[0] + 1
+        OW = (W + 2 * pd[1] - dl[1] * (KW - 1) - 1) // st[1] + 1
+        base_y = (jnp.arange(OH) * st[0] - pd[0])[:, None, None]
+        base_x = (jnp.arange(OW) * st[1] - pd[1])[None, :, None]
+        ky = (jnp.arange(KH) * dl[0])[None, None, :, None]
+        kx = (jnp.arange(KW) * dl[1])[None, None, None, :]
+        off = off.reshape(N, deformable_groups, 2, KH, KW, OH, OW)
+        m = None
+        idx_r = 0
+        if mask is not None:
+            m = rest[idx_r].reshape(N, deformable_groups, KH, KW, OH, OW)
+            idx_r += 1
+        # sampling positions: [N, dg, KH, KW, OH, OW]
+        pos_y = (jnp.arange(OH) * st[0] - pd[0]).reshape(1, 1, 1, 1, OH, 1) \
+            + (jnp.arange(KH) * dl[0]).reshape(1, 1, KH, 1, 1, 1) \
+            + off[:, :, 0]
+        pos_x = (jnp.arange(OW) * st[1] - pd[1]).reshape(1, 1, 1, 1, 1, OW) \
+            + (jnp.arange(KW) * dl[1]).reshape(1, 1, 1, KW, 1, 1) \
+            + off[:, :, 1]
+
+        y0 = jnp.floor(pos_y)
+        x0 = jnp.floor(pos_x)
+        wy = pos_y - y0
+        wx = pos_x - x0
+
+        def gather(img_dg, yy, xx):
+            # img_dg: [Cg, H, W]; yy/xx: [...]
+            yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+            valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) &
+                     (xx <= W - 1))
+            v = img_dg[:, yi, xi]
+            return jnp.where(valid[None], v, 0.0)
+
+        Cg = C // deformable_groups
+
+        def per_n(a_n, py, px, m_n):
+            outs = []
+            for g in range(deformable_groups):
+                img = a_n[g * Cg:(g + 1) * Cg]
+                yy, xx = py[g], px[g]
+                y0g = jnp.floor(yy)
+                x0g = jnp.floor(xx)
+                wyg = yy - y0g
+                wxg = xx - x0g
+                val = (gather(img, y0g, x0g) * (1 - wyg) * (1 - wxg) +
+                       gather(img, y0g + 1, x0g) * wyg * (1 - wxg) +
+                       gather(img, y0g, x0g + 1) * (1 - wyg) * wxg +
+                       gather(img, y0g + 1, x0g + 1) * wyg * wxg)
+                if m_n is not None:
+                    val = val * m_n[g][None]
+                outs.append(val)
+            return jnp.concatenate(outs, 0)  # [C, KH, KW, OH, OW]
+
+        cols = jax.vmap(per_n)(a, pos_y, pos_x,
+                               m if m is not None else
+                               jnp.ones((N, deformable_groups, KH, KW, OH,
+                                         OW), a.dtype))
+        # cols: [N, C, KH, KW, OH, OW] → matmul with weight
+        cols = cols.reshape(N, groups, C // groups * KH * KW, OH * OW)
+        wg = w.reshape(groups, OC // groups, -1)
+        out = jnp.einsum("ngkp,gok->ngop", cols, wg).reshape(N, OC, OH, OW)
+        if bias is not None:
+            out = out + rest[idx_r].reshape(1, OC, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(fn, *args)
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "use paddle_tpu.vision.ops.deform_conv2d functional form")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    rois = fpn_rois.numpy()
+    ws = rois[:, 2] - rois[:, 0]
+    hs = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == l)[0]
+        outs.append(Tensor(rois[sel]))
+        idxs.append(sel)
+    order = np.argsort(np.concatenate(idxs)) if idxs else np.array([])
+    return outs, [Tensor(np.asarray([len(i)], np.int32)) for i in idxs], \
+        Tensor(order.astype(np.int32))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    raise NotImplementedError(
+        "generate_proposals: detection-RPN pipeline lands with the "
+        "detection model family")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    raise NotImplementedError("psroi_pool lands with detection models")
+
+
+def read_file(path, name=None):
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    try:
+        from PIL import Image
+        import io
+        img = Image.open(io.BytesIO(x.numpy().tobytes()))
+        return Tensor(np.asarray(img).transpose(2, 0, 1))
+    except ImportError as e:
+        raise RuntimeError("decode_jpeg requires PIL in this image") from e

@@ -1,0 +1,425 @@
+"""paddle.vision.transforms. Parity: python/paddle/vision/transforms/.
+Numpy/HWC-based functional + class transforms (CHW output via ToTensor)."""
+import numbers
+import random
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "RandomRotation", "RandomResizedCrop", "ColorJitter",
+           "Normalize", "Pad", "Grayscale", "BrightnessTransform",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "Transpose", "to_tensor", "resize", "center_crop", "crop",
+           "hflip", "vflip", "normalize", "pad", "rotate", "to_grayscale",
+           "adjust_brightness", "adjust_contrast", "adjust_hue", "erase"]
+
+
+def _hwc(img):
+    if isinstance(img, Tensor):
+        img = img.numpy()
+    arr = np.asarray(img)
+    return arr
+
+
+# ---------------- functional ----------------
+def to_tensor(pic, data_format="CHW"):
+    arr = _hwc(pic).astype(np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _hwc(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    # separable linear resize in numpy (no PIL dependency)
+    def interp_axis(a, out_len, axis):
+        in_len = a.shape[axis]
+        if in_len == out_len:
+            return a
+        pos = np.linspace(0, in_len - 1, out_len)
+        lo = np.floor(pos).astype(np.int64)
+        hi = np.minimum(lo + 1, in_len - 1)
+        w = (pos - lo).reshape([-1 if i == axis else 1
+                                for i in range(a.ndim)])
+        return np.take(a, lo, axis=axis) * (1 - w) + \
+            np.take(a, hi, axis=axis) * w
+    out = interp_axis(arr.astype(np.float32), oh, 0)
+    out = interp_axis(out, ow, 1)
+    return out.astype(arr.dtype)
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    h, w = arr.shape[:2]
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return crop(arr, i, j, th, tw)
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _hwc(img)
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    if len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
+    l, t, r, b = padding
+    widths = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, widths, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, widths, mode=mode)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _hwc(img).astype(np.float32)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None \
+        else (center[1], center[0])
+    rad = -np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ys = cos * (yy - cy) - sin * (xx - cx) + cy
+    xs = sin * (yy - cy) + cos * (xx - cx) + cx
+    yi = np.round(ys).astype(np.int64)
+    xi = np.round(xs).astype(np.int64)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out.astype(_hwc(img).dtype)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img.numpy() if isinstance(img, Tensor) else img,
+                     dtype=np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _hwc(img).astype(np.float32)
+    gray = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return out.astype(_hwc(img).dtype)
+
+
+def adjust_brightness(img, factor):
+    arr = _hwc(img).astype(np.float32) * factor
+    return np.clip(arr, 0, 255).astype(_hwc(img).dtype)
+
+
+def adjust_contrast(img, factor):
+    arr = _hwc(img).astype(np.float32)
+    mean = to_grayscale(arr).mean()
+    out = (arr - mean) * factor + mean
+    return np.clip(out, 0, 255).astype(_hwc(img).dtype)
+
+
+def adjust_hue(img, factor):
+    arr = _hwc(img).astype(np.float32) / 255.0
+    # quick RGB→HSV hue shift
+    maxc = arr.max(-1)
+    minc = arr.min(-1)
+    v = maxc
+    delta = maxc - minc + 1e-8
+    s = delta / (maxc + 1e-8)
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    h = np.where(maxc == r, (g - b) / delta % 6,
+                 np.where(maxc == g, (b - r) / delta + 2,
+                          (r - g) / delta + 4)) / 6.0
+    h = (h + factor) % 1.0
+    i = (h * 6).astype(np.int64) % 6
+    f = h * 6 - np.floor(h * 6)
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    lut = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+           np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+           np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
+    out = np.select([i == k for k in range(6)], lut)
+    return np.clip(out * 255, 0, 255).astype(_hwc(img).dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    if isinstance(img, Tensor):
+        arr = np.array(img.numpy())
+        arr[..., i:i + h, j:j + w] = v
+        out = Tensor(arr)
+        if inplace:
+            img._bind(out._slot)
+            return img
+        return out
+    arr = np.array(img)
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+# ---------------- class transforms ----------------
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = pad(arr, [max(tw - w, 0), max(th - h, 0)], self.fill,
+                      self.padding_mode)
+            h, w = arr.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return crop(arr, i, j, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _hwc(img)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, **self.kw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if cw <= w and ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return resize(crop(arr, i, j, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = to_grayscale(img, 3).astype(np.float32)
+        arr = _hwc(img).astype(np.float32)
+        out = arr * f + gray * (1 - f)
+        return np.clip(out, 0, 255).astype(_hwc(img).dtype)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _hwc(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _hwc(img).transpose(self.order)

@@ -1,0 +1,383 @@
+"""Feature tests: io, amp, jit/static/inference, vision, hapi, metric,
+distribution, fft/signal, runtime (SURVEY.md §2.5–2.13)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+class TestIO:
+    def test_dataloader_order_and_coverage(self):
+        from paddle_tpu.io import Dataset, DataLoader
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i)
+
+            def __len__(self):
+                return 25
+
+        dl = DataLoader(DS(), batch_size=4, num_workers=2)
+        seen = sorted(int(v) for b in dl for v in b[1].numpy())
+        assert seen == list(range(25))
+
+    def test_samplers(self):
+        from paddle_tpu.io import (BatchSampler, RandomSampler,
+                                   WeightedRandomSampler,
+                                   DistributedBatchSampler, TensorDataset)
+        ds = TensorDataset([paddle.arange(10)])
+        bs = BatchSampler(ds, batch_size=3, drop_last=True)
+        assert len(bs) == 3
+        ws = WeightedRandomSampler([0.0, 1.0, 0.0], 10)
+        assert all(i == 1 for i in ws)
+        dbs = DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                      rank=0)
+        batches = list(dbs)
+        assert all(len(b) <= 2 for b in batches)
+
+    def test_save_load_roundtrip(self):
+        m = nn.Linear(3, 2)
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        sd = paddle.load(path)
+        m2 = nn.Linear(3, 2)
+        m2.set_state_dict(sd)
+        np.testing.assert_array_equal(m2.weight.numpy(), m.weight.numpy())
+
+    def test_random_split_concat(self):
+        from paddle_tpu.io import TensorDataset, random_split, ConcatDataset
+        ds = TensorDataset([paddle.arange(10)])
+        a, b = random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+        cc = ConcatDataset([a, b])
+        assert len(cc) == 10
+
+
+class TestAMP:
+    def test_autocast_state(self):
+        from paddle_tpu.amp import auto_cast, is_auto_cast_enabled, amp_cast
+        import jax.numpy as jnp
+        assert not is_auto_cast_enabled()
+        with auto_cast(level="O2"):
+            assert is_auto_cast_enabled()
+            x = paddle.ones([2, 2])
+            y = amp_cast(x, "matmul")
+            assert y.value.dtype == jnp.bfloat16
+            z = amp_cast(x, "softmax")
+            assert z.value.dtype == jnp.float32
+        assert not is_auto_cast_enabled()
+
+    def test_grad_scaler_fp16_skip(self):
+        from paddle_tpu.amp import GradScaler
+        p = paddle.framework.Parameter(np.array([1.0], np.float32))
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=4.0,
+                            decr_every_n_nan_or_inf=1)
+        loss = (p * 2).sum()
+        scaler.scale(loss).backward()
+        scaler.step(o)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2], rtol=1e-6)
+        # inf grad → step skipped, scale halved
+        o.clear_grad()
+        p.grad = paddle.to_tensor(np.array([np.inf], np.float32))
+        before = p.numpy().copy()
+        scaler._found_inf = False
+        scaler.unscale_(o)
+        assert scaler._found_inf
+        scaler._unscaled = True
+        scaler.step(o)
+        scaler.update()
+        np.testing.assert_array_equal(p.numpy(), before)
+        assert scaler.get_loss_scaling() == 2.0
+
+    def test_decorate_o2(self):
+        import jax.numpy as jnp
+        m = nn.Linear(2, 2)
+        m2, o2 = paddle.amp.decorate(
+            m, opt.SGD(parameters=m.parameters()), level="O2")
+        assert m2.weight.value.dtype == jnp.bfloat16
+
+
+class TestJitStaticInference:
+    def test_jit_save_load_predictor(self):
+        from paddle_tpu import jit, inference
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        d = tempfile.mkdtemp()
+        prefix = os.path.join(d, "inf")
+        jit.save(m, prefix, input_spec=[jit.InputSpec([None, 4],
+                                                      "float32")])
+        cfg = inference.Config(prefix)
+        pred = inference.create_predictor(cfg)
+        x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+        out = pred.run([x])
+        np.testing.assert_allclose(
+            out[0], m(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+    def test_predictor_handles(self):
+        from paddle_tpu import jit, inference
+        m = nn.Linear(3, 2)
+        d = tempfile.mkdtemp()
+        prefix = os.path.join(d, "h")
+        jit.save(m, prefix, input_spec=[jit.InputSpec([None, 3],
+                                                      "float32")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        x = np.ones((2, 3), np.float32)
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(),
+                                   m(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5)
+
+    def test_static_program(self):
+        import paddle_tpu.static as static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+
+        def builder(x):
+            return paddle.matmul(x, paddle.ones([4, 2]))
+        prog.set_builder(builder)
+        exe = static.Executor()
+        out = exe.run(prog, feed={"x": np.ones((3, 4), np.float32)},
+                      fetch_list=None)
+        np.testing.assert_allclose(out[0], np.full((3, 2), 4.0))
+
+    def test_to_static_consistency(self):
+        from paddle_tpu import jit
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(4, 4), nn.Tanh())
+        sm = jit.to_static(m)
+        x = paddle.randn([3, 4])
+        np.testing.assert_allclose(sm(x).numpy(), m(x).numpy(), rtol=1e-5)
+
+
+class TestVision:
+    @pytest.mark.parametrize("factory,shape", [
+        ("resnet18", (1, 3, 64, 64)),
+        ("mobilenet_v2", (1, 3, 64, 64)),
+        ("vgg11", (1, 3, 224, 224)),
+    ])
+    def test_models_forward(self, factory, shape):
+        import paddle_tpu.vision.models as vm
+        m = getattr(vm, factory)(num_classes=7)
+        m.eval()
+        out = m(paddle.randn(list(shape)))
+        assert out.shape == [1, 7]
+
+    def test_lenet(self):
+        from paddle_tpu.vision.models import LeNet
+        m = LeNet()
+        assert m(paddle.randn([2, 1, 28, 28])).shape == [2, 10]
+
+    def test_transforms(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(32, 48, 3) * 255).astype(
+            np.uint8)
+        out = T.Compose([T.Resize(16), T.CenterCrop(12), T.ToTensor()])(img)
+        assert list(out.shape) == [3, 12, 12]
+        norm = T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)(out)
+        assert abs(float(norm.numpy().mean())) < 5
+        flipped = T.functional_hflip if False else T.hflip(img)
+        np.testing.assert_array_equal(flipped, img[:, ::-1])
+
+    def test_fake_data_pipeline(self):
+        from paddle_tpu.vision.datasets import FakeData
+        from paddle_tpu.io import DataLoader
+        ds = FakeData(size=8, image_shape=(3, 8, 8), num_classes=3)
+        dl = DataLoader(ds, batch_size=4)
+        xb, yb = next(iter(dl))
+        assert xb.shape == [4, 3, 8, 8]
+
+    def test_nms(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+            np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = nms(boxes, 0.5, scores)
+        assert list(keep.numpy()) == [0, 2]
+
+    def test_roi_align_shape(self):
+        from paddle_tpu.vision.ops import roi_align
+        x = paddle.randn([1, 4, 16, 16])
+        rois = paddle.to_tensor(np.array([[0, 0, 8, 8], [4, 4, 12, 12]],
+                                         np.float32))
+        num = paddle.to_tensor(np.array([2], np.int32))
+        out = roi_align(x, rois, num, output_size=4)
+        assert out.shape == [2, 4, 4, 4]
+
+
+class TestHapi:
+    def test_model_fit_eval_predict(self):
+        from paddle_tpu.io import Dataset
+        from paddle_tpu.metric import Accuracy
+
+        class DS(Dataset):
+            def __init__(self, n=32):
+                rng = np.random.RandomState(0)
+                self.x = rng.rand(n, 4).astype(np.float32)
+                self.y = (self.x.sum(1) > 2).astype(np.int64)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(opt.Adam(learning_rate=0.05,
+                               parameters=net.parameters()),
+                      nn.CrossEntropyLoss(), Accuracy())
+        model.fit(DS(), epochs=12, batch_size=16, verbose=0)
+        res = model.evaluate(DS(), batch_size=16, verbose=0)
+        assert res["acc"] > 0.8
+        preds = model.predict(DS(), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (32, 2)
+
+    def test_summary_and_flops(self):
+        net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+        info = paddle.summary(net, (1, 8))
+        assert info["total_params"] == 8 * 4 + 4 + 4 * 2 + 2
+        fl = paddle.flops(net, (1, 8))
+        assert fl > 0
+
+
+class TestMetric:
+    def test_accuracy(self):
+        from paddle_tpu.metric import Accuracy
+        m = Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                         np.float32))
+        lab = paddle.to_tensor(np.array([[1], [1]]))
+        m.update(m.compute(pred, lab))
+        assert abs(m.accumulate() - 0.5) < 1e-6
+
+    def test_precision_recall_auc(self):
+        from paddle_tpu.metric import Precision, Recall, Auc
+        p, r, a = Precision(), Recall(), Auc()
+        preds = np.array([0.9, 0.8, 0.2, 0.1], np.float32)
+        labels = np.array([1, 0, 1, 0])
+        for m in (p, r, a):
+            m.update(preds, labels)
+        assert abs(p.accumulate() - 0.5) < 1e-6
+        assert abs(r.accumulate() - 0.5) < 1e-6
+        assert 0 <= a.accumulate() <= 1
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        from scipy import stats
+        d = Normal(0.0, 1.0)
+        lp = d.log_prob(paddle.to_tensor([0.5])).numpy()
+        np.testing.assert_allclose(lp, stats.norm.logpdf([0.5]), rtol=1e-5)
+        paddle.seed(0)
+        s = d.sample([5000])
+        assert abs(float(s.numpy().mean())) < 0.1
+        kl = kl_divergence(Normal(0.0, 1.0), Normal(1.0, 2.0))
+        ref = np.log(2) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl.numpy(), ref, rtol=1e-5)
+
+    def test_categorical_uniform(self):
+        from paddle_tpu.distribution import Categorical, Uniform
+        c = Categorical(logits=paddle.to_tensor([0.0, 0.0]))
+        np.testing.assert_allclose(c.entropy().numpy(), np.log(2),
+                                   rtol=1e-5)
+        u = Uniform(0.0, 2.0)
+        np.testing.assert_allclose(
+            u.log_prob(paddle.to_tensor([1.0])).numpy(), [-np.log(2)],
+            rtol=1e-5)
+
+
+class TestFFTSignal:
+    def test_fft_roundtrip(self):
+        x = paddle.randn([8])
+        y = paddle.fft.ifft(paddle.fft.fft(x))
+        np.testing.assert_allclose(y.numpy().real, x.numpy(), atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        a = np.random.RandomState(0).rand(16).astype(np.float32)
+        got = paddle.fft.rfft(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(got, np.fft.rfft(a), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        sig = np.sin(np.linspace(0, 20 * np.pi, 512)).astype(np.float32)
+        x = paddle.to_tensor(sig)
+        spec = paddle.signal.stft(x, n_fft=64, hop_length=16)
+        rec = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                  length=512)
+        np.testing.assert_allclose(rec.numpy(), sig, atol=1e-3)
+
+    def test_frame_overlap_add(self):
+        x = paddle.arange(0, 16).astype("float32")
+        f = paddle.signal.frame(x, 4, 2)
+        assert f.shape == [4, 7]
+
+
+class TestRuntime:
+    def test_native_ring_buffer(self):
+        from paddle_tpu.runtime import get_lib
+        import ctypes
+        lib = get_lib()
+        assert lib is not None, "native runtime must build in this image"
+        rb = lib.rb_create(4)
+        assert lib.rb_push(rb, 42, 0) == 0
+        out = ctypes.c_uint64()
+        assert lib.rb_pop(rb, ctypes.byref(out), 0) == 0
+        assert out.value == 42
+        lib.rb_close(rb)
+        assert lib.rb_pop(rb, ctypes.byref(out), 0) == -1
+        lib.rb_destroy(rb)
+
+    def test_fast_collate(self):
+        from paddle_tpu.runtime import fast_collate_numpy
+        arrs = [np.random.rand(128, 128).astype(np.float32)
+                for _ in range(16)]
+        np.testing.assert_array_equal(fast_collate_numpy(arrs),
+                                      np.stack(arrs))
+
+
+class TestText:
+    def test_viterbi(self):
+        from paddle_tpu.text import viterbi_decode
+        emis = paddle.to_tensor(np.array(
+            [[[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]]], np.float32))
+        trans = paddle.to_tensor(np.array([[0.5, 0.0], [0.0, 0.5]],
+                                          np.float32))
+        score, path = viterbi_decode(emis, trans)
+        assert path.shape == [1, 3]
+
+
+class TestIncubate:
+    def test_segment_ops(self):
+        from paddle_tpu.incubate import segment_sum, segment_mean
+        data = paddle.to_tensor(np.array([1., 2., 3., 4.], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(segment_sum(data, ids).numpy(), [3., 7.])
+        np.testing.assert_allclose(segment_mean(data, ids).numpy(),
+                                   [1.5, 3.5])
+
+    def test_lookahead(self):
+        from paddle_tpu.incubate import optimizer as iopt
+        p = paddle.framework.Parameter(np.array([1.0], np.float32))
+        inner = opt.SGD(learning_rate=0.1, parameters=[p])
+        la = iopt.LookAhead(inner, alpha=0.5, k=2)
+        for _ in range(4):
+            (p * p).sum().backward()
+            la.step()
+            la.clear_grad()
+        assert p.numpy()[0] < 1.0
