@@ -1,0 +1,157 @@
+"""BERT / ERNIE model family — covers the BASELINE.json configs
+"BERT-base MLM pretraining" and "ERNIE-3.0 base finetune". Structure
+follows PaddleNLP's BertModel/ErnieModel (the reference trains these via
+fleet); attention runs through the Pallas flash kernel path.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "ErnieModel",
+           "ErnieForSequenceClassification", "bert_base", "ernie_base"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 task_type_vocab_size=0, hidden_dropout=0.1,
+                 attention_dropout=0.1, layer_norm_eps=1e-12,
+                 initializer_range=0.02, pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.task_type_vocab_size = task_type_vocab_size
+        self.hidden_dropout = hidden_dropout
+        self.attention_dropout = attention_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=init)
+        self.task_type_embeddings = None
+        if cfg.task_type_vocab_size:  # ERNIE 3.0 task embedding
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        B, T = input_ids.shape
+        if position_ids is None:
+            from ..tensor.creation import arange
+            position_ids = arange(T, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            from ..tensor.creation import zeros
+            token_type_ids = zeros([B, T], dtype="int64")
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids) + \
+            self.token_type_embeddings(token_type_ids)
+        if self.task_type_embeddings is not None and task_type_ids is not None:
+            emb = emb + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout, activation="gelu",
+            attn_dropout=cfg.attention_dropout, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # [B, T] 1/0 → additive [B, 1, 1, T]
+            m = attention_mask
+            mask = ((1.0 - m.astype("float32")) * -1e4
+                    ).unsqueeze(1).unsqueeze(1)
+        seq = self.encoder(x, mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        from ..tensor.linalg import matmul
+        logits = matmul(h, self.bert.embeddings.word_embeddings.weight,
+                        transpose_y=True) + self.decoder_bias
+        return logits
+
+    def loss(self, input_ids, labels, token_type_ids=None,
+             attention_mask=None, ignore_index=-100):
+        logits = self(input_ids, token_type_ids, attention_mask)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]),
+                               labels.reshape([-1]),
+                               ignore_index=ignore_index)
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg, num_classes=2, dropout=None):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask,
+                              task_type_ids=task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+
+# ERNIE is the same trunk with task-type embeddings enabled
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification
+
+
+def bert_base(vocab_size=30522):
+    return BertConfig(vocab_size=vocab_size)
+
+
+def ernie_base(vocab_size=40000):
+    return BertConfig(vocab_size=vocab_size, task_type_vocab_size=3)

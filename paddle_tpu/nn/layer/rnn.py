@@ -1,0 +1,359 @@
+"""Recurrent layers. Parity: python/paddle/nn/layer/rnn.py.
+
+The reference dispatches to cuDNN RNN kernels; on TPU the recurrence is a
+lax.scan whose per-step cell math is MXU matmuls — XLA pipelines the scan,
+and multi-layer/bidirectional stacks compose functionally.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+from .container import LayerList
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        B = batch_ref.shape[batch_dim_idx]
+        from ...tensor.creation import full
+        state_shape = shape or self.state_shape
+        if isinstance(state_shape, tuple):
+            return tuple(full([B] + list(s), init_value,
+                              dtype or "float32") for s in state_shape)
+        return full([B] + list(state_shape), init_value,
+                    dtype or "float32")
+
+
+def _uniform_init(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wih, whh, bih, bhh):
+            out = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return out
+        h = apply_op(fn, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ([self.hidden_size], [self.hidden_size])
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(
+                inputs, shape=self.state_shape)
+        h, c = states
+        H = self.hidden_size
+
+        def fn(x, hh, cc, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + hh @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply_op(fn, inputs, h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+        h = apply_op(fn, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+def _cell_scan(cell, xs, init_states, reverse=False):
+    """Run a cell over [T, B, I] with lax.scan on raw arrays."""
+    wih, whh = cell.weight_ih.value, cell.weight_hh.value
+    bih, bhh = cell.bias_ih.value, cell.bias_hh.value
+    is_lstm = isinstance(cell, LSTMCell)
+    is_gru = isinstance(cell, GRUCell)
+    act = jnp.tanh if getattr(cell, "activation", "tanh") == "tanh" \
+        else jax.nn.relu
+
+    def step(carry, x):
+        if is_lstm:
+            h, c = carry
+            gates = x @ wih.T + bih + h @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        if is_gru:
+            h = carry
+            gi = x @ wih.T + bih
+            gh = h @ whh.T + bhh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            h_new = (1 - z) * n + z * h
+            return h_new, h_new
+        h = carry
+        h_new = act(x @ wih.T + bih + h @ whh.T + bhh)
+        return h_new, h_new
+
+    final, ys = jax.lax.scan(step, init_states, xs, reverse=reverse)
+    return final, ys
+
+
+class RNN(Layer):
+    """Wraps a cell into a full sequence loop (lax.scan)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        is_lstm = isinstance(cell, LSTMCell)
+        tm = self.time_major
+        rev = self.is_reverse
+
+        tensors = [inputs, cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                   cell.bias_hh]
+        init_given = initial_states is not None
+        if init_given:
+            if is_lstm:
+                tensors += [initial_states[0], initial_states[1]]
+            else:
+                tensors += [initial_states]
+
+        def fn(x, wih, whh, bih, bhh, *init):
+            xs = x if tm else jnp.swapaxes(x, 0, 1)   # [T,B,I]
+            B = xs.shape[1]
+            H = cell.hidden_size
+            if init:
+                carry = (init[0], init[1]) if is_lstm else init[0]
+            else:
+                z = jnp.zeros((B, H), xs.dtype)
+                carry = (z, z) if is_lstm else z
+            final, ys = _cell_scan(cell, xs, carry, reverse=rev)
+            out = ys if tm else jnp.swapaxes(ys, 0, 1)
+            if is_lstm:
+                return out, final[0], final[1]
+            return out, final
+
+        res = apply_op(fn, *tensors)
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw = st_bw = None
+        if initial_states is not None:
+            st_fw, st_bw = initial_states
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+
+        def make_cell(in_size):
+            if self.CELL is SimpleRNNCell:
+                return SimpleRNNCell(in_size, hidden_size,
+                                     activation or "tanh", weight_ih_attr,
+                                     weight_hh_attr, bias_ih_attr,
+                                     bias_hh_attr)
+            return self.CELL(in_size, hidden_size, weight_ih_attr,
+                             weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+        self.layers_fw = LayerList()
+        self.layers_bw = LayerList() if self.bidirect else None
+        for l in range(num_layers):
+            in_size = input_size if l == 0 else hidden_size * num_dir
+            self.layers_fw.append(make_cell(in_size))
+            if self.bidirect:
+                self.layers_bw.append(make_cell(in_size))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat, stack
+        is_lstm = self.CELL is LSTMCell
+        x = inputs
+        finals_h, finals_c = [], []
+        for l in range(self.num_layers):
+            fw = RNN(self.layers_fw[l], time_major=self.time_major)
+            states_l = None
+            if initial_states is not None:
+                states_l = self._slice_states(initial_states, l, 0, is_lstm)
+            out_fw, s_fw = fw(x, states_l)
+            if self.bidirect:
+                bw = RNN(self.layers_bw[l], is_reverse=True,
+                         time_major=self.time_major)
+                states_lb = None
+                if initial_states is not None:
+                    states_lb = self._slice_states(initial_states, l, 1,
+                                                   is_lstm)
+                out_bw, s_bw = bw(x, states_lb)
+                x = concat([out_fw, out_bw], axis=-1)
+                if is_lstm:
+                    finals_h += [s_fw[0], s_bw[0]]
+                    finals_c += [s_fw[1], s_bw[1]]
+                else:
+                    finals_h += [s_fw, s_bw]
+            else:
+                x = out_fw
+                if is_lstm:
+                    finals_h.append(s_fw[0])
+                    finals_c.append(s_fw[1])
+                else:
+                    finals_h.append(s_fw)
+            if self.dropout and l < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        h = stack(finals_h, axis=0)
+        if is_lstm:
+            c = stack(finals_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+    def _slice_states(self, initial_states, layer, direction, is_lstm):
+        num_dir = 2 if self.bidirect else 1
+        idx = layer * num_dir + direction
+        if is_lstm:
+            h, c = initial_states
+            return h[idx], c[idx]
+        return initial_states[idx]
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

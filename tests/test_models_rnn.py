@@ -1,0 +1,274 @@
+"""Model zoo + RNN family + ring attention tests."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestRNN:
+    def test_lstm_vs_torch(self):
+        import torch
+        paddle.seed(0)
+        B, T, I, H = 2, 5, 3, 4
+        lstm = nn.LSTM(I, H, num_layers=2, direction="bidirect")
+        tl = torch.nn.LSTM(I, H, num_layers=2, bidirectional=True,
+                           batch_first=True)
+        sd = {}
+        for l in range(2):
+            for d in range(2):
+                sfx = "_reverse" if d else ""
+                cell = (lstm.layers_bw if d else lstm.layers_fw)[l]
+                sd[f"weight_ih_l{l}{sfx}"] = torch.tensor(
+                    cell.weight_ih.numpy())
+                sd[f"weight_hh_l{l}{sfx}"] = torch.tensor(
+                    cell.weight_hh.numpy())
+                sd[f"bias_ih_l{l}{sfx}"] = torch.tensor(
+                    cell.bias_ih.numpy())
+                sd[f"bias_hh_l{l}{sfx}"] = torch.tensor(
+                    cell.bias_hh.numpy())
+        tl.load_state_dict(sd)
+        x = np.random.RandomState(0).rand(B, T, I).astype(np.float32)
+        out_p, (h_p, c_p) = lstm(paddle.to_tensor(x))
+        with torch.no_grad():
+            out_t, (h_t, c_t) = tl(torch.tensor(x))
+        np.testing.assert_allclose(out_p.numpy(), out_t.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c_p.numpy(), c_t.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gru_simple_rnn(self):
+        import torch
+        paddle.seed(1)
+        B, T, I, H = 2, 6, 4, 5
+        x = np.random.RandomState(1).rand(B, T, I).astype(np.float32)
+        gru = nn.GRU(I, H)
+        tg = torch.nn.GRU(I, H, batch_first=True)
+        cell = gru.layers_fw[0]
+        tg.load_state_dict({
+            "weight_ih_l0": torch.tensor(cell.weight_ih.numpy()),
+            "weight_hh_l0": torch.tensor(cell.weight_hh.numpy()),
+            "bias_ih_l0": torch.tensor(cell.bias_ih.numpy()),
+            "bias_hh_l0": torch.tensor(cell.bias_hh.numpy())})
+        out_p, _ = gru(paddle.to_tensor(x))
+        with torch.no_grad():
+            out_t, _ = tg(torch.tensor(x))
+        np.testing.assert_allclose(out_p.numpy(), out_t.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        srnn = nn.SimpleRNN(I, H)
+        out, h = srnn(paddle.to_tensor(x))
+        assert out.shape == [B, T, H] and h.shape == [1, B, H]
+
+    def test_cells(self):
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.randn([2, 4])
+        h, (h2, c2) = cell(x)
+        assert h.shape == [2, 8] and c2.shape == [2, 8]
+        g = nn.GRUCell(4, 8)
+        h, _ = g(x)
+        assert h.shape == [2, 8]
+
+    def test_rnn_trainable(self):
+        paddle.seed(0)
+        lstm = nn.LSTM(4, 8)
+        head = nn.Linear(8, 1)
+        from paddle_tpu import optimizer as opt
+        params = lstm.parameters() + head.parameters()
+        o = opt.Adam(learning_rate=0.01, parameters=params)
+        x = paddle.randn([4, 10, 4])
+        y = paddle.randn([4, 1])
+        for i in range(30):
+            out, (h, c) = lstm(x)
+            loss = ((head(out[:, -1]) - y) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            if i == 0:
+                l0 = loss.item()
+        assert loss.item() < l0
+
+
+class TestBert:
+    def test_forward_and_mlm_loss(self):
+        from paddle_tpu.models import BertForMaskedLM, BertConfig
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=100, hidden_size=32, num_layers=2,
+                         num_heads=4, intermediate_size=64,
+                         max_position_embeddings=32)
+        m = BertForMaskedLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 100, size=(2, 16)))
+        logits = m(ids)
+        assert logits.shape == [2, 16, 100]
+        loss = m.loss(ids, ids)
+        assert np.isfinite(loss.item())
+
+    def test_ernie_classifier_trains(self):
+        from paddle_tpu.models import (ErnieForSequenceClassification,
+                                       ernie_base, BertConfig)
+        from paddle_tpu import optimizer as opt
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=4, intermediate_size=64,
+                         max_position_embeddings=32, task_type_vocab_size=3,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+        m = ErnieForSequenceClassification(cfg, num_classes=2)
+        o = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 64, size=(4, 12)))
+        y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        ce = nn.CrossEntropyLoss()
+        l0 = None
+        for _ in range(8):
+            loss = ce(m(ids), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            l0 = l0 or loss.item()
+        assert loss.item() < l0
+
+    def test_attention_mask(self):
+        from paddle_tpu.models import BertModel, BertConfig
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=50, hidden_size=16, num_layers=1,
+                         num_heads=2, intermediate_size=32,
+                         max_position_embeddings=16, hidden_dropout=0.0,
+                         attention_dropout=0.0)
+        m = BertModel(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.array([[1, 2, 3, 4]]))
+        mask_full = paddle.to_tensor(np.array([[1, 1, 1, 1]]))
+        mask_part = paddle.to_tensor(np.array([[1, 1, 0, 0]]))
+        s1, _ = m(ids, attention_mask=mask_full)
+        s2, _ = m(ids, attention_mask=mask_part)
+        assert not np.allclose(s1.numpy(), s2.numpy())
+
+
+class TestRingAttention:
+    def test_matches_full_attention(self):
+        import math
+        from paddle_tpu.distributed.env import build_mesh
+        from paddle_tpu.ops.ring_attention import ring_attention_arrays
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = build_mesh(dp=1, sp=4, mp=1, devices=jax.devices()[:4])
+        rng = np.random.RandomState(0)
+        B, T, H, D = 2, 32, 2, 8
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        sh = NamedSharding(mesh, P(None, "sp"))
+        qd, kd, vd = [jax.device_put(a, sh) for a in (q, k, v)]
+
+        def ref(causal):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+            if causal:
+                s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+            return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+        for causal in (True, False):
+            out = ring_attention_arrays(qd, kd, vd, mesh, causal=causal)
+            err = float(jnp.abs(jnp.asarray(out) - ref(causal)).max())
+            assert err < 1e-4, f"causal={causal} err={err}"
+
+    def test_differentiable(self):
+        from paddle_tpu.distributed.env import build_mesh
+        from paddle_tpu.ops.ring_attention import ring_attention_arrays
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = build_mesh(dp=1, sp=2, mp=1, devices=jax.devices()[:2])
+        rng = np.random.RandomState(0)
+        B, T, H, D = 1, 16, 2, 4
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        sh = NamedSharding(mesh, P(None, "sp"))
+        qd = jax.device_put(q, sh)
+
+        def f(qq):
+            return ring_attention_arrays(qq, qq, qq, mesh,
+                                         causal=True).sum()
+        g = jax.grad(f)(qd)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestFlashAttention:
+    def test_interpret_matches_reference(self):
+        import math
+        from paddle_tpu.ops.pallas.flash_attention import \
+            flash_attention_arrays
+        rng = np.random.RandomState(0)
+        B, T, H, D = 2, 128, 4, 32
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+        def ref(causal):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+            if causal:
+                s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+            return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+        for causal in (False, True):
+            out = flash_attention_arrays(q, k, v, causal=causal,
+                                         interpret=True)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(ref(causal)), atol=2e-5)
+
+    def test_backward_matches(self):
+        from paddle_tpu.ops.pallas.flash_attention import \
+            flash_attention_arrays
+        import math
+        rng = np.random.RandomState(1)
+        B, T, H, D = 1, 64, 2, 16
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+        def flash_loss(q, k, v):
+            return flash_attention_arrays(q, k, v, causal=True,
+                                          interpret=True).sum()
+
+        def ref_loss(q, k, v):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+            s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+            return jnp.einsum("bhqk,bkhd->bqhd",
+                              jax.nn.softmax(s, -1), v).sum()
+
+        g1 = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+
+class TestGPTModels:
+    def test_gpt_generate_shapes(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(2, 8)))
+        out = m.generate(ids, max_new_tokens=3)
+        assert out.shape == [2, 11]
+
+    def test_gpt_kv_cache_consistency(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        import jax.numpy as jnp
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(1, 8)))
+        full_logits = m(ids)
+        # incremental: feed first 7, then token 8 with cache
+        from paddle_tpu.framework.core import Tensor
+        cfg = m.cfg
+        caches = [(Tensor(jnp.zeros((1, 0, cfg.num_heads,
+                                     cfg.hidden_size // cfg.num_heads),
+                                    jnp.float32)),) * 2
+                  for _ in range(cfg.num_layers)]
+        _, caches = m(ids[:, :7], caches=caches)
+        last, _ = m(ids[:, 7:8], caches=caches)
+        np.testing.assert_allclose(last.numpy()[:, 0],
+                                   full_logits.numpy()[:, 7], rtol=1e-3,
+                                   atol=1e-4)
