@@ -15,6 +15,8 @@ from . import auto_parallel
 from .auto_parallel import shard_tensor, shard_op, ProcessMesh
 from . import meta_parallel
 from .fleet.utils.recompute import recompute
+from . import checkpoint
+from .checkpoint import save_sharded, load_sharded
 from . import launch as launch_module
 
 

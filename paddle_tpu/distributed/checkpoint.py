@@ -1,0 +1,80 @@
+"""Distributed (sharded/async) checkpointing.
+
+Parity: the reference's large-model checkpoint paths
+(distributed/fleet/meta_parallel/sharding state dict save +
+fleet/utils/fs.py). TPU-native: orbax-checkpoint writes each shard from
+the device holding it (multi-host safe, async option), restoring directly
+into the sharded layout — no gather-to-host-0 bottleneck.
+"""
+import os
+
+import numpy as np
+import jax
+
+__all__ = ["save_sharded", "load_sharded", "save_train_state",
+           "load_train_state"]
+
+
+def _checkpointer(use_async=False):
+    import orbax.checkpoint as ocp
+    if use_async:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return ocp.Checkpointer(ocp.StandardCheckpointHandler())
+
+
+def save_sharded(tree, path, use_async=False):
+    """Save a pytree of (possibly sharded) jax arrays."""
+    path = os.path.abspath(path)
+    ckptr = _checkpointer(use_async)
+    ckptr.save(path, tree, force=True)
+    if use_async:
+        return ckptr  # caller may .wait_until_finished()
+    return None
+
+
+def load_sharded(path, target_tree=None, shardings=None):
+    """Restore; when `shardings` (matching pytree of NamedSharding) is
+    given, arrays land directly in their distributed placement."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = _checkpointer()
+    if target_tree is None and shardings is None:
+        return ckptr.restore(path)
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda arr, sh: jax.ShapeDtypeStruct(arr.shape, arr.dtype,
+                                                 sharding=sh),
+            target_tree, shardings)
+        return ckptr.restore(path, args=ocp.args.StandardRestore(abstract))
+    return ckptr.restore(path, args=ocp.args.StandardRestore(target_tree))
+
+
+def save_train_state(step_obj, path, use_async=False):
+    """Checkpoint a HybridTrainStep / TrainStep (params + opt state)."""
+    tree = {"params": step_obj.params,
+            "opt_state": jax.tree.map(
+                lambda x: x, step_obj.opt_state,
+                is_leaf=lambda x: hasattr(x, "dtype")),
+            "step": np.asarray(step_obj._step_i)}
+    return save_sharded(tree, path, use_async)
+
+
+def load_train_state(step_obj, path):
+    shardings = None
+    if hasattr(step_obj, "param_shardings"):
+        shardings = {
+            "params": step_obj.param_shardings,
+            "opt_state": jax.tree.map(
+                lambda arr: arr.sharding, step_obj.opt_state,
+                is_leaf=lambda x: hasattr(x, "dtype")),
+            "step": None,
+        }
+    target = {"params": step_obj.params, "opt_state": step_obj.opt_state,
+              "step": np.asarray(step_obj._step_i)}
+    restored = load_sharded(path, target, None)
+    step_obj.params = restored["params"]
+    step_obj.opt_state = jax.tree.map(
+        lambda cur, new: new, step_obj.opt_state, restored["opt_state"],
+        is_leaf=lambda x: hasattr(x, "dtype"))
+    step_obj._step_i = int(restored["step"])
+    return step_obj

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from . import dtype as dtype_mod
 from .dtype import convert_dtype, get_default_dtype
+from .debug import nan_check_enabled, check_numerics
 
 __all__ = ["Tensor", "Parameter", "apply_op", "no_grad", "enable_grad",
            "set_grad_enabled", "is_grad_enabled", "to_tensor"]
@@ -353,6 +354,10 @@ def apply_op(fn, *tensors, n_outputs=None):
     out = fn(*arrays)
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+
+    if nan_check_enabled():
+        for o in outs:
+            check_numerics(o, getattr(fn, "__qualname__", "op"))
 
     record = _grad_state.enabled and any(
         _requires_grad(t) and _is_diff_dtype(t.value)

