@@ -1,0 +1,88 @@
+"""Elastic / fault-tolerant training. Parity:
+python/paddle/distributed/elastic/ (+ fleet elastic agent).
+
+The reference's agent watches etcd for scale events and restarts ranks.
+TPU-native failure model: a preempted/evicted host kills the whole SPMD
+program; recovery = restart the job and resume from the latest sharded
+checkpoint. ElasticController packages that contract: periodic async
+checkpoints + automatic resume + a watchdog that detects a wedged device
+(no step progress) and raises for the scheduler to restart.
+"""
+import os
+import threading
+import time
+
+__all__ = ["ElasticController"]
+
+
+class ElasticController:
+    def __init__(self, train_step, ckpt_dir, save_every_steps=500,
+                 watchdog_timeout_s=1800):
+        self.step_obj = train_step
+        self.ckpt_dir = ckpt_dir
+        self.save_every = save_every_steps
+        self.timeout = watchdog_timeout_s
+        self._last_progress = time.time()
+        self._watchdog = None
+        self._stop = threading.Event()
+        self._async_handle = None
+
+    # -- resume --------------------------------------------------------
+    def maybe_resume(self):
+        """Restore the newest checkpoint if one exists; returns step."""
+        from .checkpoint import load_train_state
+        latest = self._latest()
+        if latest is not None:
+            load_train_state(self.step_obj, latest)
+            self._last_progress = time.time()
+            return self.step_obj._step_i
+        return 0
+
+    def _latest(self):
+        if not os.path.isdir(self.ckpt_dir):
+            return None
+        cands = [d for d in os.listdir(self.ckpt_dir)
+                 if d.startswith("step_")]
+        if not cands:
+            return None
+        best = max(cands, key=lambda d: int(d.split("_")[1]))
+        return os.path.join(self.ckpt_dir, best)
+
+    # -- per-step hook -------------------------------------------------
+    def on_step(self):
+        """Call after each train step: checkpoints + feeds the watchdog."""
+        self._last_progress = time.time()
+        s = self.step_obj._step_i
+        if s % self.save_every == 0:
+            self._save(s)
+
+    def _save(self, step):
+        from .checkpoint import save_train_state
+        if self._async_handle is not None:
+            try:
+                self._async_handle.wait_until_finished()
+            except Exception:
+                pass
+        path = os.path.join(self.ckpt_dir, f"step_{step}")
+        self._async_handle = save_train_state(self.step_obj, path,
+                                              use_async=True)
+
+    # -- watchdog ------------------------------------------------------
+    def start_watchdog(self):
+        def run():
+            while not self._stop.wait(min(self.timeout / 4, 60)):
+                if time.time() - self._last_progress > self.timeout:
+                    # surface to the main thread via os-level signal
+                    import signal
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+        self._watchdog = threading.Thread(target=run, daemon=True)
+        self._watchdog.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._async_handle is not None:
+            try:
+                self._async_handle.wait_until_finished()
+            except Exception:
+                pass

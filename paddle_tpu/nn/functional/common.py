@@ -9,10 +9,22 @@ from ...framework.random import split_key
 
 
 def linear(x, weight, bias=None, name=None):
-    """y = x @ W + b, W shaped [in, out] (paddle layout). Pure MXU work."""
+    """y = x @ W + b, W shaped [in, out] (paddle layout). Pure MXU work;
+    under amp.auto_cast the matmul runs in the policy dtype (bf16)."""
+    from ...amp import get_amp_dtype
+
+    def fn(a, w, *rest):
+        dt = get_amp_dtype()
+        if dt is not None and jnp.issubdtype(a.dtype, jnp.floating):
+            out = a.astype(dt) @ w.astype(dt)
+        else:
+            out = a @ w
+        if rest:
+            out = out + rest[0].astype(out.dtype)
+        return out
     if bias is None:
-        return apply_op(lambda a, w: a @ w, x, weight)
-    return apply_op(lambda a, w, b: a @ w + b, x, weight, bias)
+        return apply_op(fn, x, weight)
+    return apply_op(fn, x, weight, bias)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

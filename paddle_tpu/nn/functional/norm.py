@@ -27,6 +27,15 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
         normalized_shape = [normalized_shape]
     n_axes = len(list(normalized_shape))
 
+    # fused Pallas path: last-dim norm with affine params on TPU
+    if n_axes == 1 and weight is not None and bias is not None:
+        from ...ops import fused_layer_norm_available
+        if fused_layer_norm_available():
+            from ...ops.pallas.layer_norm import layer_norm as pallas_ln
+            return apply_op(
+                lambda a, w, b: pallas_ln(a, w, b, eps=epsilon),
+                x, weight, bias)
+
     def fn(a, *rest):
         axes = tuple(range(a.ndim - n_axes, a.ndim))
         dtype = a.dtype
