@@ -59,6 +59,59 @@ try:
 except ImportError:
     pass
 
+# remaining reference top-level exports (python/paddle/__init__.py __all__)
+bool = bool_  # noqa: A001 — paddle exposes `paddle.bool`
+from .tensor.manipulation import flip as reverse  # noqa: E402
+from .distributed import DataParallel  # noqa: E402
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def get_cuda_rng_state():
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    if state:
+        set_rng_state(state[0])
+
+
+def disable_signal_handler():
+    pass
+
+
+def check_shape(*args, **kwargs):
+    pass
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace:
+    """Maps onto the TPU device in this backend (there is no CUDA)."""
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(tpu:{self.device_id})"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class NPUPlace(CUDAPlace):
+    pass
+
+
+class TPUPlace(CUDAPlace):
+    pass
+
 # paddle.disable_static / enable_static (dygraph is the default, like 2.x)
 _static_mode = [False]
 

@@ -18,14 +18,15 @@ from .layer.pooling import (AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D,
                             MaxPool2D, MaxPool3D, AdaptiveAvgPool1D,
                             AdaptiveAvgPool2D, AdaptiveAvgPool3D,
                             AdaptiveMaxPool1D, AdaptiveMaxPool2D,
-                            AdaptiveMaxPool3D, MaxUnPool2D)
+                            AdaptiveMaxPool3D, MaxUnPool2D, MaxUnPool1D,
+                            MaxUnPool3D)
 from .layer.activation import (ReLU, ReLU6, GELU, SELU, ELU, CELU, Sigmoid,
                                LogSigmoid, Hardshrink, Hardsigmoid,
                                Hardswish, Hardtanh, LeakyReLU, PReLU, RReLU,
                                Softmax, LogSoftmax, Softplus, Softshrink,
                                Softsign, Swish, SiLU, Mish, Tanh,
                                Tanhshrink, ThresholdedReLU, Maxout, GLU)
-from .layer.loss import (CrossEntropyLoss, NLLLoss, BCELoss,
+from .layer.loss import (HSigmoidLoss, CrossEntropyLoss, NLLLoss, BCELoss,
                          BCEWithLogitsLoss, MSELoss, L1Loss, SmoothL1Loss,
                          HuberLoss, KLDivLoss, MarginRankingLoss, CTCLoss,
                          HingeEmbeddingLoss, CosineEmbeddingLoss,
@@ -50,3 +51,6 @@ try:
                                     TransformerDecoder, Transformer)
 except ImportError:
     pass
+
+Silu = SiLU  # reference exposes both spellings
+from .layer.decode import BeamSearchDecoder, dynamic_decode

@@ -26,3 +26,7 @@ from .vision import (pixel_shuffle, pixel_unshuffle, channel_shuffle,
                      affine_grid, grid_sample)
 from .extension import sequence_mask, temporal_shift, diag_embed
 from .attention import scaled_dot_product_attention, sparse_attention
+from .misc_gap import (elu_, tanh_, max_unpool1d, max_unpool3d,
+                       dice_loss, hsigmoid_loss, log_loss,
+                       margin_cross_entropy, gather_tree,
+                       class_center_sample)

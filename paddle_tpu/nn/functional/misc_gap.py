@@ -1,0 +1,185 @@
+"""Remaining functional-surface ops: unpooling variants, niche losses,
+beam-search utilities. Parity anchors noted per function."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+from ...framework.random import split_key
+
+__all__ = ["elu_", "tanh_", "max_unpool1d", "max_unpool3d", "dice_loss",
+           "hsigmoid_loss", "log_loss", "margin_cross_entropy",
+           "gather_tree", "class_center_sample"]
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    out = elu(x, alpha)
+    x._bind(out._slot)
+    return x
+
+
+def tanh_(x, name=None):
+    from .activation import tanh
+    out = tanh(x)
+    x._bind(out._slot)
+    return x
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """1-D unpool via the 2-D path (reference: unpooling op family)."""
+    from .pooling import max_unpool2d
+    from ...tensor.manipulation import unsqueeze, squeeze
+    out = max_unpool2d(unsqueeze(x, 2), unsqueeze(indices, 2),
+                       (1, kernel_size),
+                       (1, stride if stride is not None else kernel_size),
+                       (0, padding) if padding else 0,
+                       output_size=([1] + list(output_size[-1:]))
+                       if output_size else None)
+    return squeeze(out, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else [kernel_size] * 3
+    st = stride if stride is not None else ks
+    st = st if isinstance(st, (list, tuple)) else [st] * 3
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+
+    def fn(a, idx):
+        N, C, D, H, W = a.shape
+        if output_size is not None:
+            od, oh, ow = [int(v) for v in output_size[-3:]]
+        else:
+            od = (D - 1) * st[0] + ks[0] - 2 * pd[0]
+            oh = (H - 1) * st[1] + ks[1] - 2 * pd[1]
+            ow = (W - 1) * st[2] + ks[2] - 2 * pd[2]
+        out = jnp.zeros((N, C, od * oh * ow), a.dtype)
+        flat = a.reshape(N, C, -1)
+        fidx = idx.reshape(N, C, -1)
+        out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+            out, fidx, flat)
+        return out.reshape(N, C, od, oh, ow)
+    return apply_op(fn, x, indices)
+
+
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    """Parity: nn/functional/loss.py:dice_loss (segmentation overlap)."""
+    def fn(p, y):
+        yh = jax.nn.one_hot(y[..., 0].astype(jnp.int32), p.shape[-1])
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op(fn, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) -
+        (1 - y) * jnp.log(1 - p + epsilon), input, label)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid (reference: hierarchical_sigmoid_op). Default
+    complete-binary-tree coding when no custom paths are given."""
+    depth = int(math.ceil(math.log2(max(num_classes, 2))))
+
+    def fn(x, lab, w, *rest):
+        b = rest[0] if bias is not None else None
+        lab = lab.reshape(-1).astype(jnp.int32)
+        B = x.shape[0]
+        # complete binary tree: internal node ids 0..num_classes-2
+        codes = []
+        nodes = []
+        cur = lab + (num_classes - 1)  # leaf position in heap order
+        for _ in range(depth):
+            parent = (cur - 1) // 2
+            is_right = (cur % 2) == 0
+            nodes.append(parent)
+            codes.append(is_right.astype(jnp.float32))
+            cur = parent
+        nodes = jnp.stack(nodes, 1)           # [B, depth]
+        codes = jnp.stack(codes, 1)           # [B, depth]
+        valid = nodes >= 0
+        nodes_safe = jnp.maximum(nodes, 0)
+        wn = w[nodes_safe]                    # [B, depth, dim]
+        logits = jnp.einsum("bd,btd->bt", x, wn)
+        if b is not None:
+            logits = logits + b[nodes_safe].reshape(logits.shape)
+        # bce: label=code
+        loss = jnp.maximum(logits, 0) - logits * codes + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        loss = jnp.where(valid, loss, 0.0)
+        return jnp.sum(loss, axis=1, keepdims=True)
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return apply_op(fn, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference:
+    paddle/fluid/operators/margin_cross_entropy_op.cu)."""
+    def fn(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt_theta = margin1 * theta + margin2
+        tgt_cos = jnp.cos(tgt_theta) - margin3
+        onehot = jax.nn.one_hot(lab, lg.shape[-1], dtype=lg.dtype)
+        adjusted = jnp.where(onehot > 0, tgt_cos, cos) * scale
+        logp = jax.nn.log_softmax(adjusted, -1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], 1)
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss
+        if return_softmax:
+            return loss_out, jnp.exp(logp)
+        return loss_out
+    return apply_op(fn, logits, label)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: gather_tree_op). ids/parents:
+    [max_time, batch, beam]."""
+    def fn(ids_a, par_a):
+        T = ids_a.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [batch, beam] current beam indices
+            tt = T - 1 - t
+            out = jnp.take_along_axis(ids_a[tt], beams, axis=1)
+            new_beams = jnp.take_along_axis(par_a[tt], beams, axis=1)
+            return new_beams, out
+
+        B, K = ids_a.shape[1], ids_a.shape[2]
+        init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, outs = jax.lax.scan(step, init, jnp.arange(T))
+        return jnp.flip(outs, 0)
+    return apply_op(fn, ids, parents)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Partial-FC negative class sampling (reference:
+    class_center_sample_op). Returns remapped labels + sampled centers."""
+    lab = np.asarray(label.numpy()).reshape(-1)
+    pos = np.unique(lab)
+    n_extra = max(num_samples - len(pos), 0)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.RandomState(int(np.sum(pos)) % (2 ** 31))
+    extra = rng.choice(rest, size=min(n_extra, len(rest)), replace=False) \
+        if n_extra else np.empty(0, np.int64)
+    sampled = np.sort(np.concatenate([pos, extra]).astype(np.int64))
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lab = np.asarray([remap[c] for c in lab], np.int64)
+    return Tensor(new_lab), Tensor(sampled)

@@ -1,0 +1,112 @@
+"""Seq2seq decoding. Parity: python/paddle/nn/decode.py
+(BeamSearchDecoder + dynamic_decode over RNNCell/attention decoders)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op, no_grad
+from .layers import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Length-normalized beam search over a cell + embedding + output fn."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        def fn(a):
+            a = jnp.repeat(a[:, None], beam_size, axis=1)
+            return a.reshape((-1,) + a.shape[2:])
+        return apply_op(fn, x)
+
+    def initialize(self, initial_cell_states):
+        B = initial_cell_states[0].shape[0] if isinstance(
+            initial_cell_states, (tuple, list)) \
+            else initial_cell_states.shape[0]
+        from ...tensor.creation import full
+        start = full([B * self.beam_size], self.start_token, dtype="int64")
+        states = jax.tree.map(
+            lambda t: BeamSearchDecoder.tile_beam_merge_with_batch(
+                t, self.beam_size),
+            initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        return start, states
+
+    def step(self, inputs, states):
+        emb = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        out, new_states = self.cell(emb, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        return logits, new_states
+
+
+@no_grad()
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Greedy/beam decode loop (eager; generation is latency-bound host
+    orchestration — the per-step cell math still jits)."""
+    tokens, states = decoder.initialize(inits)
+    beam = decoder.beam_size
+    BK = tokens.shape[0]
+    B = BK // beam
+    neg_inf = -1e9
+
+    scores = np.zeros((B, beam), np.float32)
+    scores[:, 1:] = neg_inf  # all beams start identical: keep one
+    finished = np.zeros((B, beam), bool)
+    outputs = []
+    lengths = np.zeros((B, beam), np.int64)
+
+    cur = tokens
+    for t in range(max_step_num):
+        logits, states = decoder.step(cur, states)
+        logp = jax.nn.log_softmax(logits.value.astype(jnp.float32), -1)
+        V = logp.shape[-1]
+        logp = np.asarray(logp).reshape(B, beam, V)
+        # frozen finished beams only extend with end_token
+        logp[finished] = neg_inf
+        logp[finished, :] = neg_inf
+        logp[finished, decoder.end_token] = 0.0
+        total = scores[:, :, None] + logp
+        flat = total.reshape(B, beam * V)
+        top_idx = np.argpartition(-flat, beam, 1)[:, :beam]
+        top_val = np.take_along_axis(flat, top_idx, 1)
+        order = np.argsort(-top_val, 1)
+        top_idx = np.take_along_axis(top_idx, order, 1)
+        scores = np.take_along_axis(top_val, order, 1)
+        parent = top_idx // V
+        word = top_idx % V
+        finished = np.take_along_axis(finished, parent, 1) | \
+            (word == decoder.end_token)
+        lengths = np.take_along_axis(lengths, parent, 1) + (~finished)
+        outputs.append((word.copy(), parent.copy()))
+        # reorder states along the merged batch*beam axis
+        gather = (parent + np.arange(B)[:, None] * beam).reshape(-1)
+        states = jax.tree.map(
+            lambda s: Tensor(s.value[gather]) if isinstance(s, Tensor)
+            else s, states, is_leaf=lambda s: isinstance(s, Tensor))
+        cur = Tensor(jnp.asarray(word.reshape(-1), jnp.int64))
+        if finished.all():
+            break
+
+    # backtrace
+    T = len(outputs)
+    ids = np.stack([w for w, _ in outputs])       # [T, B, beam]
+    parents = np.stack([p for _, p in outputs])
+    from ..functional.misc_gap import gather_tree
+    seqs = gather_tree(Tensor(ids), Tensor(parents))
+    out = seqs if output_time_major else Tensor(
+        np.transpose(seqs.numpy(), (1, 2, 0)))
+    if return_length:
+        return out, Tensor(lengths)
+    return out, Tensor(scores)

@@ -105,6 +105,13 @@ nan_to_num = _wrap_unary(jnp.nan_to_num)
 exp2 = _wrap_unary(jnp.exp2)
 
 
+def logit(x, eps=None, name=None):
+    def fn(a):
+        v = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(v) - jnp.log1p(-v)
+    return apply_op(fn, x)
+
+
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     sv = scale.value if isinstance(scale, Tensor) else scale
     if bias_after_scale:

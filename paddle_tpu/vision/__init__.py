@@ -15,3 +15,9 @@ def set_image_backend(backend):
 
 def get_image_backend():
     return image_backend
+
+
+def image_load(path, backend=None):
+    """Parity: paddle.vision.image_load."""
+    from .datasets import _load_image
+    return _load_image(path)
