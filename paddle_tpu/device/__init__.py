@@ -164,3 +164,61 @@ class cuda:
     @staticmethod
     def empty_cache():
         pass
+
+
+# ------------------------------------------------- extra device-type API
+# Parity: python/paddle/device/__init__.py (XPU/IPU/MLU places exist as
+# types so user code can isinstance-check; all map onto the single TPU
+# place — there is no per-op placement under XLA).
+
+def get_cudnn_version():
+    return None
+
+
+class _AltPlace:
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.dev_id})"
+
+    def get_device_id(self):
+        return self.dev_id
+
+
+class XPUPlace(_AltPlace):
+    pass
+
+
+class IPUPlace(_AltPlace):
+    def __init__(self):
+        super().__init__(0)
+
+
+class MLUPlace(_AltPlace):
+    pass
+
+
+def get_all_device_type():
+    return sorted({("tpu" if d.platform in ("tpu", "axon") else d.platform)
+                   for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    return [f"{('tpu' if d.platform in ('tpu', 'axon') else d.platform)}"
+            f":{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+__all__ += ["get_cudnn_version", "XPUPlace", "IPUPlace", "MLUPlace",
+            "get_all_device_type", "get_all_custom_device_type",
+            "get_available_device", "get_available_custom_device",
+            "is_compiled_with_cinn", "is_compiled_with_ipu",
+            "is_compiled_with_mlu"]

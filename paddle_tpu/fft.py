@@ -5,6 +5,7 @@ from .framework.core import Tensor, apply_op
 
 __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft",
            "irfft", "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "hfft2", "ihfft2", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
@@ -48,6 +49,36 @@ fftn = _mkn(jnp.fft.fftn)
 ifftn = _mkn(jnp.fft.ifftn)
 rfftn = _mkn(jnp.fft.rfftn)
 irfftn = _mkn(jnp.fft.irfftn)
+
+
+# Hermitian nd transforms (parity: python/paddle/fft.py hfft2/hfftn/ihfft2/
+# ihfftn). Uses the identity hfftn(x) = irfftn(conj(x)) under the swapped
+# norm convention, and ihfftn(x) = conj(rfftn(x)) likewise.
+_SWAP_NORM = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def _mk_hfwd(axes_default):
+    def op(x, s=None, axes=axes_default, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply_op(
+            lambda a: jnp.fft.irfftn(jnp.conj(a), s=s, axes=ax,
+                                     norm=_SWAP_NORM[norm]), x)
+    return op
+
+
+def _mk_hinv(axes_default):
+    def op(x, s=None, axes=axes_default, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return apply_op(
+            lambda a: jnp.conj(jnp.fft.rfftn(a, s=s, axes=ax,
+                                             norm=_SWAP_NORM[norm])), x)
+    return op
+
+
+hfft2 = _mk_hfwd((-2, -1))
+ihfft2 = _mk_hinv((-2, -1))
+hfftn = _mk_hfwd(None)
+ihfftn = _mk_hinv(None)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -5,9 +5,67 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor, apply_op
 
+# fresh randomness per sampler call, reseedable via numpy's global seed
+import numpy as _np
+_khop_rng = _np.random.default_rng()
+
 __all__ = ["softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
-           "graph_send_recv", "segment_sum", "segment_mean", "segment_max",
-           "segment_min", "optimizer", "nn"]
+           "graph_send_recv", "graph_khop_sampler", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "optimizer", "nn",
+           "LookAhead", "ModelAverage"]
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbourhood sampling over a CSC graph.
+    Parity: python/paddle/incubate/operators/graph_khop_sampler.py.
+    Host-side (numpy) sampling — graph walks are data-dependent/ragged and
+    belong on CPU; the sampled dense subgraph then feeds TPU compute."""
+    import numpy as np
+    rowv = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    colv = np.asarray(colptr.numpy() if isinstance(colptr, Tensor)
+                      else colptr)
+    nodes = np.asarray(input_nodes.numpy() if isinstance(input_nodes, Tensor)
+                       else input_nodes).reshape(-1)
+    eids = np.asarray(sorted_eids.numpy() if isinstance(sorted_eids, Tensor)
+                      else sorted_eids) if sorted_eids is not None else None
+    rng = _khop_rng
+    edge_src, edge_dst, edge_ids = [], [], []
+    frontier = nodes
+    seen = {int(n): i for i, n in enumerate(nodes)}
+    order = list(nodes)
+    for k in sample_sizes:
+        nxt = []
+        for dst in frontier:
+            s, e = int(colv[dst]), int(colv[dst + 1])
+            neigh = rowv[s:e]
+            ids = np.arange(s, e)
+            if k >= 0 and len(neigh) > k:
+                pick = rng.choice(len(neigh), size=k, replace=False)
+                neigh, ids = neigh[pick], ids[pick]
+            for u, ei in zip(neigh, ids):
+                u = int(u)
+                if u not in seen:
+                    seen[u] = len(order)
+                    order.append(u)
+                edge_src.append(u)
+                edge_dst.append(int(dst))
+                edge_ids.append(int(eids[ei]) if eids is not None else int(ei))
+            nxt.extend(int(u) for u in neigh)
+        frontier = np.unique(np.asarray(nxt, dtype=rowv.dtype)) \
+            if nxt else np.array([], dtype=rowv.dtype)
+    reindex = {n: i for i, n in enumerate(order)}
+    src_l = jnp.asarray([reindex[u] for u in edge_src], jnp.int64)
+    dst_l = jnp.asarray([reindex[v] for v in edge_dst], jnp.int64)
+    out_nodes = jnp.asarray(order, jnp.int64)
+    # positions of the seed input_nodes in the sampled-subgraph index
+    # space (they seed `order`, so this is their reindexed location)
+    reindex_x = jnp.asarray([reindex[int(n)] for n in nodes], jnp.int64)
+    outs = (Tensor(src_l), Tensor(dst_l), Tensor(out_nodes),
+            Tensor(reindex_x))
+    if return_eids:
+        return outs + (Tensor(jnp.asarray(edge_ids, jnp.int64)),)
+    return outs
 
 
 def softmax_mask_fuse(x, mask, name=None):
@@ -153,3 +211,7 @@ class nn:
     def fused_multi_head_attention(*a, **k):
         raise NotImplementedError(
             "use nn.functional.scaled_dot_product_attention")
+
+
+LookAhead = optimizer.LookAhead
+ModelAverage = optimizer.ModelAverage

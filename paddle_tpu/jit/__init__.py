@@ -2,5 +2,7 @@
 from .api import to_static, not_to_static, TrainStep, functional_call, \
     StaticFunction
 from .save_load import save, load, TranslatedLayer, InputSpec
+from .debug import TracedLayer, ProgramTranslator, set_code_level, \
+    set_verbosity, get_code_level, get_verbosity
 
 declarative = to_static

