@@ -48,7 +48,8 @@ from .tensor.search import where, nonzero, argmax, argmin  # noqa
 for _mod in ("nn", "optimizer", "amp", "io", "metric", "static", "jit",
              "vision", "distribution", "fft", "signal", "regularizer",
              "utils", "incubate", "distributed", "inference", "hapi",
-             "profiler", "ops", "models", "text", "sparse"):
+             "profiler", "ops", "models", "text", "sparse", "hub",
+             "sysconfig", "onnx"):
     try:
         __import__(f"{__name__}.{_mod}")
     except ImportError:

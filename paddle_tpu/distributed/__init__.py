@@ -5,9 +5,12 @@ from .env import (init_parallel_env, get_rank, get_world_size, barrier,
                   is_initialized)
 from .collective import (ReduceOp, all_reduce, all_gather, broadcast,
                          reduce, scatter, alltoall, send, recv,
-                         reduce_scatter, split, new_group, wait,
-                         psum, pmean, pmax, all_gather_axis, ppermute,
-                         all_to_all_axis, axis_index)
+                         reduce_scatter, split, new_group, get_group,
+                         wait, psum, pmean, pmax, all_gather_axis,
+                         ppermute, all_to_all_axis, axis_index)
+from .entry_attr import (ProbabilityEntry, CountFilterEntry,
+                         ShowClickEntry)
+from .ps_dataset import InMemoryDataset, QueueDataset
 from .parallel import DataParallel
 from .spawn import spawn
 from . import fleet
@@ -23,3 +26,32 @@ from . import launch as launch_module
 def launch():
     from .launch import main
     main()
+
+
+# gloo_* — the reference's CPU-side gloo barrier API
+# (python/paddle/distributed/parallel.py gloo_init_parallel_env /
+# gloo_barrier / gloo_release). The TPU runtime is single-controller
+# SPMD, so process-group bootstrap reduces to the mesh env; the gloo
+# names map onto it for script compatibility.
+_gloo_state = {"initialized": False}
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    _gloo_state.update(initialized=True, rank=rank_id, world=rank_num,
+                       endpoint=server_endpoint)
+
+
+def gloo_barrier():
+    if not _gloo_state["initialized"]:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    import jax
+    if jax.process_count() > 1:
+        # real cross-process rendezvous; env.barrier() is local-only
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu:gloo_barrier")
+    else:
+        barrier()
+
+
+def gloo_release():
+    _gloo_state["initialized"] = False

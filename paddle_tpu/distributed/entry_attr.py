@@ -1,0 +1,69 @@
+"""Sparse-table entry configs. Parity:
+python/paddle/distributed/entry_attr.py (ProbabilityEntry,
+CountFilterEntry, ShowClickEntry).
+
+Parameter-server sparse tables are out of scope on TPU (SURVEY.md §3) —
+these are kept as validated config descriptors so model code that
+constructs them keeps working; the attr string matches the reference's
+``_to_attr`` wire format.
+"""
+__all__ = ["ProbabilityEntry", "CountFilterEntry", "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError("EntryAttr is base class")
+
+
+class ProbabilityEntry(EntryAttr):
+    """Keep a sparse feature with the given probability."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not isinstance(probability, float):
+            raise ValueError("probability must be a float in (0,1)")
+        if probability <= 0 or probability >= 1:
+            raise ValueError("probability must be a float in (0,1)")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._probability)])
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a sparse feature once seen at least ``count_filter`` times."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if not isinstance(count_filter, int):
+            raise ValueError(
+                "count_filter must be a valid integer greater than 0")
+        if count_filter < 0:
+            raise ValueError(
+                "count_filter must be a valid integer greater or equal "
+                "than 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return ":".join([self._name, str(self._count_filter)])
+
+
+class ShowClickEntry(EntryAttr):
+    """Track show/click vars for a sparse table."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name,
+                                                            str):
+            raise ValueError("show_name/click_name must be strings")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return ":".join([self._name, self._show_name, self._click_name])

@@ -7,16 +7,24 @@ HybridTrainStep SPMD program (hybrid_train.py).
 """
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from .base.role_maker import (Role, PaddleCloudRoleMaker,
+                              UserDefinedRoleMaker)
+from .base.util_factory import UtilBase
+from .data_generator import (MultiSlotDataGenerator,
+                             MultiSlotStringDataGenerator)
 from .hybrid_train import HybridTrainStep, default_param_rules
 from .utils.recompute import (recompute, recompute_sequential,
                               recompute_hybrid)
 
-_state = {"strategy": None, "hcg": None, "initialized": False}
+_state = {"strategy": None, "hcg": None, "initialized": False,
+          "role_maker": None}
 
-__all__ = ["init", "DistributedStrategy", "distributed_model",
+__all__ = ["init", "Fleet", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "HybridTrainStep", "worker_index", "worker_num", "is_worker",
-           "barrier_worker", "recompute", "utils"]
+           "barrier_worker", "recompute", "utils", "UtilBase", "Role",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
 
 
 def init(role_maker=None, is_collective=True, strategy=None):
@@ -30,6 +38,9 @@ def init(role_maker=None, is_collective=True, strategy=None):
          hc.get("pp_degree", 1), hc.get("mp_degree", 1),
          hc.get("sep_degree", 1)))
     _state["hcg"] = HybridCommunicateGroup(topo)
+    _state["role_maker"] = role_maker
+    if role_maker is not None:
+        util._role_maker = role_maker
     _state["initialized"] = True
     return None
 
@@ -126,3 +137,46 @@ class utils:  # namespace parity: fleet.utils.recompute
     recompute = staticmethod(recompute)
     recompute_sequential = staticmethod(recompute_sequential)
     recompute_hybrid = staticmethod(recompute_hybrid)
+
+
+util = UtilBase()
+
+
+class Fleet:
+    """Object-style facade over this module (ref: base/fleet_base.py —
+    there `fleet` is a singleton instance of Fleet; here the module IS
+    the singleton, and Fleet instances delegate to it)."""
+
+    def __init__(self):
+        self.util = util
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        init(role_maker, is_collective, strategy)
+        return self
+
+    def is_initialized(self):
+        return is_initialized()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return is_worker()
+
+    def is_server(self):
+        return is_server()
+
+    def is_first_worker(self):
+        return worker_index() == 0
+
+    def barrier_worker(self):
+        barrier_worker()

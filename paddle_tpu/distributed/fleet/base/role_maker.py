@@ -1,0 +1,99 @@
+"""Role makers. Parity: python/paddle/distributed/fleet/base/role_maker.py
+(Role, PaddleCloudRoleMaker, UserDefinedRoleMaker).
+
+On TPU every process is a collective worker over the jax mesh — there is
+no parameter-server role split — so role makers reduce to rank/world
+bookkeeping: PaddleCloudRoleMaker reads the launcher's env vars,
+UserDefinedRoleMaker takes explicit kwargs.
+"""
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def is_worker(self):
+        return self._role in (Role.WORKER, Role.ALL)
+
+    def is_server(self):
+        return self._role in (Role.SERVER, Role.ALL)
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def role_id(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def _barrier(self, comm_world=None):
+        from ... import env
+        env.barrier()
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Collective role maker driven by the launch env
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS,
+    as exported by paddle.distributed.launch)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._worker_num = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        self._role = Role.WORKER
+
+    def _is_collective_mode(self):
+        return self._is_collective
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role maker: ranks/endpoints passed as kwargs instead of
+    read from the environment."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._current_id = kwargs.get(
+            "current_id", self._current_id)
+        self._role = kwargs.get("role", Role.WORKER)
+        if "worker_num" in kwargs:
+            self._worker_num = kwargs["worker_num"]
+        if "worker_endpoints" in kwargs:
+            self._worker_endpoints = list(kwargs["worker_endpoints"])
+            self._worker_num = len(self._worker_endpoints)
+        if "server_endpoints" in kwargs:
+            self._server_endpoints = list(kwargs["server_endpoints"])

@@ -1,0 +1,78 @@
+"""fleet.util. Parity: python/paddle/distributed/fleet/base/util_factory.py
+(UtilBase: small cross-worker helpers used by training scripts).
+
+Collective ops ride the jax mesh (distributed/collective.py); file-shard
+and print helpers are plain Python.
+"""
+import os
+
+import numpy as np
+
+__all__ = ["UtilBase", "UtilFactory"]
+
+
+class UtilBase:
+    def __init__(self, role_maker=None):
+        self._role_maker = role_maker
+
+    def _rank_world(self):
+        # process-level topology: in single-controller SPMD one process
+        # feeds all its local devices, so IO sharding splits by process
+        import jax
+        return jax.process_index(), jax.process_count()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        """Reduce a numpy value across workers. Single-process worlds
+        (the TPU SPMD model: one process, many chips) return the input."""
+        rank, world = self._rank_world()
+        arr = np.asarray(input)
+        if world <= 1:
+            return arr
+        from ...collective import all_reduce as _ar, ReduceOp
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(arr)
+        _ar(t, op=op)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from ... import env
+        env.barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        rank, world = self._rank_world()
+        if world <= 1:
+            return [input]
+        from ...collective import all_gather as _ag
+        import paddle_tpu as paddle
+        out = []
+        _ag(out, paddle.to_tensor(np.asarray(input)))
+        return [np.asarray(t.numpy()) for t in out]
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers
+        (ref behavior: first `len(files) % world` workers get one extra)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        rank, world = self._rank_world()
+        if self._role_maker is not None:
+            rank = self._role_maker.worker_index()
+            world = self._role_maker.worker_num()
+        base, extra = divmod(len(files), world)
+        counts = [base + (1 if i < extra else 0) for i in range(world)]
+        start = sum(counts[:rank])
+        return files[start:start + counts[rank]]
+
+    def print_on_rank(self, message, rank_id):
+        rank, _ = self._rank_world()
+        if self._role_maker is not None:
+            rank = self._role_maker.worker_index()
+        if rank == rank_id:
+            print(message)
+
+
+class UtilFactory:
+    def _create_util(self, context=None):
+        return UtilBase(None if context is None
+                        else context.get("role_maker"))

@@ -1,0 +1,89 @@
+"""Data generators for the MultiSlot text feed. Parity:
+python/paddle/distributed/fleet/data_generator/data_generator.py.
+
+Pure-Python text protocol: user overrides ``generate_sample`` (and
+optionally ``generate_batch``); ``run_from_stdin`` / ``run_from_files``
+stream lines through it and emit the MultiSlot wire format
+``<ids_num> id1 id2 ... per slot`` consumable by dataset readers
+(io/ps_dataset.py).
+"""
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self.batch_size_ = 1
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: return a no-arg iterator yielding
+        [(slot_name, [feasign, ...]), ...] per sample."""
+        raise NotImplementedError(
+            "generate_sample() must be overridden by the user")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator")
+
+    def _run_lines(self, lines, out):
+        batch = []
+        for line in lines:
+            for parsed in self.generate_sample(line)():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    for sample in self.generate_batch(batch)():
+                        out.write(self._gen_str(sample))
+                    batch = []
+        if batch:
+            for sample in self.generate_batch(batch)():
+                out.write(self._gen_str(sample))
+
+    def run_from_stdin(self):
+        self._run_lines(sys.stdin, sys.stdout)
+
+    def run_from_files(self, filelist, output=None):
+        out = output or sys.stdout
+        for fname in filelist:
+            with open(fname) as f:
+                self._run_lines(f, out)
+
+
+def _format_slots(line, stringify):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample() must be a list or tuple, "
+            "e.g. [('words', [1926, 8, 17]), ('label', [1])]")
+    parts = []
+    for name, elements in line:
+        vals = [str(e) for e in elements] if stringify else list(elements)
+        parts.append(" ".join([str(len(vals))] + [str(v) for v in vals]))
+    return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Slots carry int/float feasigns."""
+
+    def _gen_str(self, line):
+        return _format_slots(line, stringify=True)
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Slots carry pre-stringified feasigns (no type coercion)."""
+
+    def _gen_str(self, line):
+        return _format_slots(line, stringify=False)

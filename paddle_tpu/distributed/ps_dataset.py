@@ -1,0 +1,146 @@
+"""MultiSlot datasets. Parity:
+python/paddle/distributed/fleet/dataset/dataset.py (InMemoryDataset,
+QueueDataset).
+
+The reference backs these with C++ data feeds for parameter-server
+training. The TPU build keeps the user-facing API (init / set_filelist /
+load_into_memory / local_shuffle / batch iteration) as a pure-Python
+MultiSlot text reader whose batches are numpy arrays ready for
+``jax.device_put`` — PS-specific pieces (global_shuffle over trainers,
+pipe commands as subprocess filters) degrade gracefully to their local
+equivalents.
+"""
+import random
+import subprocess
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+def _parse_multislot_line(line, slot_names):
+    """'<n> v1..vn <m> u1..um' -> {slot: np.array}, slots in order."""
+    toks = line.split()
+    out = {}
+    i = 0
+    for name in slot_names:
+        n = int(toks[i])
+        vals = toks[i + 1:i + 1 + n]
+        i += 1 + n
+        try:
+            arr = np.asarray([int(v) for v in vals], dtype=np.int64)
+        except ValueError:
+            arr = np.asarray([float(v) for v in vals], dtype=np.float32)
+        out[name] = arr
+    return out
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._filelist = []
+        self._use_var = []
+        self._pipe_command = None
+        self._input_type = 0
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+        self._input_type = input_type
+        return self
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _slot_names(self):
+        names = []
+        for v in self._use_var:
+            names.append(getattr(v, "name", v if isinstance(v, str)
+                                 else str(v)))
+        return names
+
+    def _read_lines(self, fname):
+        if self._pipe_command:
+            proc = subprocess.run(
+                f"cat {fname} | {self._pipe_command}", shell=True,
+                capture_output=True, text=True, check=True)
+            return proc.stdout.splitlines()
+        with open(fname) as f:
+            return [ln.rstrip("\n") for ln in f if ln.strip()]
+
+    def _iter_samples(self):
+        names = self._slot_names()
+        for fname in self._filelist:
+            for line in self._read_lines(fname):
+                yield _parse_multislot_line(line, names)
+
+    def _batches_from(self, sample_iter):
+        """Group samples into batches: each batch is {slot: [arr, ...]};
+        fixed-length slots stack into a dense [B, L] array."""
+        batch = []
+        for s in sample_iter:
+            batch.append(s)
+            if len(batch) == self._batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)
+
+    def _collate(self, samples):
+        names = self._slot_names()
+        out = {}
+        for name in names:
+            arrs = [s[name] for s in samples]
+            lens = {a.shape[0] for a in arrs}
+            out[name] = (np.stack(arrs) if len(lens) == 1
+                         else arrs)
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: batches read lazily from the filelist
+    (ref: fleet/dataset/dataset.py:1240)."""
+
+    def __iter__(self):
+        return self._batches_from(self._iter_samples())
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-shuffle dataset (ref: fleet/dataset/dataset.py:341)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_samples())
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-process world: global == local
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        return self._batches_from(iter(self._samples))

@@ -1,0 +1,81 @@
+"""paddle.hub — hubconf.py entrypoint discovery/loading.
+Parity: python/paddle/hapi/hub.py (list/help/load over a repo that ships
+a ``hubconf.py``).
+
+The ``local`` source is fully supported (import hubconf from a
+directory). ``github``/``gitee`` sources need network access, which this
+environment does not have, so they raise a clear error instead of
+half-working.
+"""
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+
+def _import_module(name, repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _resolve_repo(repo_dir, source, force_reload):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: '
+            '"github" | "gitee" | "local".')
+    if source != "local":
+        raise NotImplementedError(
+            f'hub source "{source}" requires network access, which is '
+            'unavailable; clone the repo manually and use source="local"')
+    return repo_dir
+
+
+def _check_dependencies(m):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if deps:
+        missing = [p for p in deps
+                   if importlib.util.find_spec(p) is None]
+        if missing:
+            raise RuntimeError(
+                f"Missing dependencies: {', '.join(missing)}")
+
+
+def _load_entry(m, name):
+    fn = getattr(m, name, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable entrypoint {name} "
+                           f"in {MODULE_HUBCONF}")
+    return fn
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """List callable entrypoints exposed by the repo's hubconf.py."""
+    repo_dir = _resolve_repo(repo_dir, source, force_reload)
+    m = _import_module(MODULE_HUBCONF[:-3], repo_dir)
+    return [f for f in dir(m)
+            if callable(getattr(m, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Return the docstring of entrypoint ``model``."""
+    repo_dir = _resolve_repo(repo_dir, source, force_reload)
+    m = _import_module(MODULE_HUBCONF[:-3], repo_dir)
+    return _load_entry(m, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call entrypoint ``model`` (after checking hubconf dependencies)."""
+    repo_dir = _resolve_repo(repo_dir, source, force_reload)
+    m = _import_module(MODULE_HUBCONF[:-3], repo_dir)
+    _check_dependencies(m)
+    return _load_entry(m, model)(**kwargs)

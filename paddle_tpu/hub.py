@@ -1,0 +1,4 @@
+"""Parity: python/paddle/hub.py — re-export of hapi.hub entrypoints."""
+from .hapi.hub import list, help, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

@@ -15,7 +15,7 @@ from ...framework.random import split_key
 
 __all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-           "Assign", "Dirac", "Orthogonal", "calculate_gain",
+           "Assign", "Bilinear", "Dirac", "Orthogonal", "calculate_gain",
            "set_global_initializer"]
 
 _global_weight_init = None
@@ -182,6 +182,34 @@ class Dirac(Initializer):
         for g in range(self.groups):
             for i in range(min(per_group, in_c)):
                 arr[(g * per_group + i, i) + centers] = 1.0
+        self._set(param, jnp.asarray(arr))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs
+    (ref: python/paddle/fluid/initializer.py:767 BilinearInitializer).
+    Every (out, in) channel pair gets the same separable triangle
+    kernel, so a Conv2DTranspose initialised with it performs bilinear
+    interpolation."""
+
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, param, block=None):
+        shape = tuple(param.shape)
+        if len(shape) != 4:
+            raise ValueError(
+                "Bilinear init expects a 4-D Conv2DTranspose weight, "
+                f"got shape {shape}")
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        i = np.arange(kh)[:, None]
+        j = np.arange(kw)[None, :]
+        k2d = ((1 - np.abs(i / f_h - c_h)) *
+               (1 - np.abs(j / f_w - c_w))).astype(np.float32)
+        arr = np.broadcast_to(k2d, shape).copy()
         self._set(param, jnp.asarray(arr))
 
 

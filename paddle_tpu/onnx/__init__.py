@@ -1,0 +1,16 @@
+"""Parity: python/paddle/onnx/__init__.py.
+
+ONNX export is explicitly out of scope for the TPU build (SURVEY.md §3):
+the deployment format here is StableHLO via ``paddle.jit.save`` /
+``jax.export``, which XLA consumes directly. ``export`` is kept as a
+documented stub so code probing the API gets a clear, actionable error.
+"""
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference: python/paddle/onnx/export.py:21 (paddle2onnx bridge)."""
+    raise NotImplementedError(
+        "ONNX export is not supported by the TPU build; use "
+        "paddle.jit.save(layer, path) to produce a portable StableHLO "
+        "artifact and paddle.inference to run it.")

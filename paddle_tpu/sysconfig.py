@@ -1,0 +1,21 @@
+"""Parity: python/paddle/sysconfig.py (get_include/get_lib).
+
+The reference points at its bundled C++ headers/libs; ours points at the
+package's native runtime pieces (paddle_tpu/runtime) so
+``utils.cpp_extension`` builds can -I/-L against them.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the framework's C/C++ header files."""
+    return os.path.join(_PKG_DIR, "runtime", "cpp")
+
+
+def get_lib():
+    """Directory containing the framework's built native libraries."""
+    return os.path.join(_PKG_DIR, "runtime", "build")

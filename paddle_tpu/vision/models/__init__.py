@@ -1,7 +1,16 @@
 """Parity: python/paddle/vision/models/__init__.py."""
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, wide_resnet50_2, wide_resnet101_2)
+from .resnext import (ResNeXt, resnext50_32x4d, resnext50_64x4d,
+                      resnext101_32x4d, resnext101_64x4d,
+                      resnext152_32x4d, resnext152_64x4d)
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .inception import GoogLeNet, googlenet, InceptionV3, inception_v3
 from .small_nets import (LeNet, AlexNet, alexnet, VGG, vgg11, vgg13, vgg16,
                          vgg19, SqueezeNet, squeezenet1_0, squeezenet1_1)
 from .mobilenet import (MobileNetV1, mobilenet_v1, MobileNetV2,
-                        mobilenet_v2, ShuffleNetV2, shufflenet_v2_x1_0)
+                        mobilenet_v2, ShuffleNetV2, shufflenet_v2_x0_25,
+                        shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+                        shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                        shufflenet_v2_x2_0, shufflenet_v2_swish)

@@ -1,0 +1,131 @@
+"""DenseNet family. Parity: python/paddle/vision/models/densenet.py
+(DenseNet 121/161/169/201/264).
+
+Pre-activation dense layers (BN-ReLU-1x1 -> BN-ReLU-3x3, channel concat)
+with half-compression transitions. Concats are pure layout ops under XLA;
+the 1x1 bottlenecks dominate FLOPs and land on the MXU.
+"""
+from ... import nn
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+# layers -> (init_features, growth_rate, block config)
+_DENSENET_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        inter = bn_size * growth_rate
+        self.bn1 = nn.BatchNorm2D(num_channels)
+        self.conv1 = nn.Conv2D(num_channels, inter, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(inter)
+        self.conv2 = nn.Conv2D(inter, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_channels, num_out):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(num_channels)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(num_channels, num_out, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    """DenseNet model (ref: vision/models/densenet.py:187).
+
+    Args mirror the reference: ``layers`` in {121, 161, 169, 201, 264},
+    ``bn_size`` bottleneck multiplier, ``dropout`` inside dense layers.
+    """
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        assert layers in _DENSENET_CFG, (
+            f"supported layers are {sorted(_DENSENET_CFG)} but input "
+            f"layer is {layers}")
+        num_init, growth, block_cfg = _DENSENET_CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = nn.Conv2D(3, num_init, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(num_init)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks = []
+        channels = num_init
+        for i, num_layers in enumerate(block_cfg):
+            for _ in range(num_layers):
+                blocks.append(_DenseLayer(channels, growth, bn_size,
+                                          dropout))
+                channels += growth
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(channels, channels // 2))
+                channels //= 2
+        self.features = nn.Sequential(*blocks)
+        self.bn_last = nn.BatchNorm2D(channels)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(channels, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.relu(self.bn_last(self.features(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "state_dict via model.set_state_dict instead")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

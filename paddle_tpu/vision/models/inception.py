@@ -1,0 +1,259 @@
+"""GoogLeNet (Inception v1) + InceptionV3. Parity:
+python/paddle/vision/models/{googlenet,inceptionv3}.py.
+
+Multi-branch inception blocks: each branch is conv+BN+ReLU; branch
+outputs concat on channels. GoogLeNet keeps the reference's 3-output
+contract (main logits + two aux heads).
+"""
+from ... import nn
+from ...tensor.manipulation import concat, flatten
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+# ---------------------------------------------------------------- GoogLeNet
+class _Inception(nn.Layer):
+    """v1 inception block (ref: vision/models/googlenet.py:66)."""
+
+    def __init__(self, in_c, f1, f3r, f3, f5r, f5, proj):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, f1, 1)
+        self.b3 = nn.Sequential(_ConvBN(in_c, f3r, 1),
+                                _ConvBN(f3r, f3, 3, padding=1))
+        self.b5 = nn.Sequential(_ConvBN(in_c, f5r, 1),
+                                _ConvBN(f5r, f5, 5, padding=2))
+        self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.proj = _ConvBN(in_c, proj, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x),
+                       self.proj(self.pool(x))], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """GoogLeNet (ref: vision/models/googlenet.py:97). forward returns
+    (main_logits, aux1_logits, aux2_logits) like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _ConvBN(64, 64, 1),
+            _ConvBN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux classifiers (active in train and eval, as in reference)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        a1 = x
+        x = self.inc4c(self.inc4b(x))
+        x = self.inc4d(x)
+        a2 = x
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes <= 0:
+            return x
+        out = self.fc(self.dropout(flatten(x, 1)))
+        return out, self.aux1(a1), self.aux2(a2)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((4, 4))
+        self.conv = _ConvBN(in_c, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = flatten(self.conv(self.pool(x)), 1)
+        return self.fc2(self.dropout(self.relu(self.fc1(x))))
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "state_dict via model.set_state_dict instead")
+    return GoogLeNet(**kwargs)
+
+
+# -------------------------------------------------------------- InceptionV3
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(in_c, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(in_c, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.proj = _ConvBN(in_c, pool_features, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x),
+                       self.proj(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """grid reduction 35 -> 17"""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.b3dbl = nn.Sequential(_ConvBN(in_c, 64, 1),
+                                   _ConvBN(64, 96, 3, padding=1),
+                                   _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3dbl(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(in_c, c7, 1),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7dbl = nn.Sequential(
+            _ConvBN(in_c, c7, 1),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.proj = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7dbl(x),
+                       self.proj(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """grid reduction 17 -> 8"""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(in_c, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7x3 = nn.Sequential(
+            _ConvBN(in_c, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_stem = _ConvBN(in_c, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3dbl_stem = nn.Sequential(_ConvBN(in_c, 448, 1),
+                                        _ConvBN(448, 384, 3, padding=1))
+        self.b3dbl_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3dbl_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.proj = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_stem(x)
+        b3 = concat([self.b3_a(b3), self.b3_b(b3)], axis=1)
+        d = self.b3dbl_stem(x)
+        d = concat([self.b3dbl_a(d), self.b3dbl_b(d)], axis=1)
+        return concat([self.b1(x), b3, d, self.proj(self.pool(x))],
+                      axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Inception v3 (ref: vision/models/inceptionv3.py:433)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2),
+            _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1),
+            _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "state_dict via model.set_state_dict instead")
+    return InceptionV3(**kwargs)

@@ -4,7 +4,10 @@ from ... import nn
 from ...tensor.manipulation import flatten, concat, split
 
 __all__ = ["MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
-           "ShuffleNetV2", "shufflenet_v2_x1_0"]
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
 
 
 def _conv_bn(in_c, out_c, k, stride=1, padding=0, groups=1):
@@ -90,29 +93,30 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = out_c // 2
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
         if stride > 1:
             self.branch1 = nn.Sequential(
                 nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
                           groups=in_c, bias_attr=False),
                 nn.BatchNorm2D(in_c),
                 nn.Conv2D(in_c, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU())
+                nn.BatchNorm2D(branch), act_layer())
             b2_in = in_c
         else:
             self.branch1 = None
             b2_in = in_c // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.BatchNorm2D(branch), act_layer(),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch, bias_attr=False),
             nn.BatchNorm2D(branch),
             nn.Conv2D(branch, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU())
+            nn.BatchNorm2D(branch), act_layer())
         self.shuffle = nn.ChannelShuffle(2)
 
     def forward(self, x):
@@ -128,7 +132,8 @@ class ShuffleNetV2(nn.Layer):
     def __init__(self, scale=1.0, act="relu", num_classes=1000,
                  with_pool=True):
         super().__init__()
-        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+        stage_out = {0.25: [24, 48, 96, 512], 0.33: [32, 64, 128, 512],
+                     0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
                      1.5: [176, 352, 704, 1024],
                      2.0: [244, 488, 976, 2048]}[scale]
         self.conv1 = _conv_bn(3, 24, 3, stride=2, padding=1)
@@ -137,9 +142,9 @@ class ShuffleNetV2(nn.Layer):
         stages = []
         for i, repeats in enumerate([4, 8, 4]):
             out_c = stage_out[i]
-            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units = [_ShuffleUnit(in_c, out_c, 2, act=act)]
             for _ in range(repeats - 1):
-                units.append(_ShuffleUnit(out_c, out_c, 1))
+                units.append(_ShuffleUnit(out_c, out_c, 1, act=act))
             stages.append(nn.Sequential(*units))
             in_c = out_c
         self.stages = nn.Sequential(*stages)
@@ -153,5 +158,37 @@ class ShuffleNetV2(nn.Layer):
         return self.fc(flatten(self.pool(x), 1))
 
 
+def _shufflenet(scale, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "state_dict via model.set_state_dict instead")
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained, **kwargs)
+
+
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.0, **kwargs)
+    return _shufflenet(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, act="swish", **kwargs)

@@ -1,0 +1,60 @@
+"""ResNeXt family. Parity: python/paddle/vision/models/resnext.py
+(ResNeXt 50/101/152 at cardinality 32/64).
+
+Reuses the ResNet trunk with grouped bottlenecks: width-per-group 4 and
+``groups=cardinality`` reproduces the reference's channel plan
+(e.g. 32x4d stage-1 width 128, 64x4d stage-1 width 256) — grouped convs
+lower to batched MXU matmuls under XLA.
+"""
+from .resnet import BottleneckBlock, ResNet
+
+__all__ = ["ResNeXt", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d"]
+
+
+class ResNeXt(ResNet):
+    """ResNeXt model (ref: vision/models/resnext.py:129).
+
+    Args mirror the reference: depth in {50, 101, 152}, cardinality in
+    {32, 64}.
+    """
+
+    def __init__(self, depth=50, cardinality=32, num_classes=1000,
+                 with_pool=True):
+        self.cardinality = cardinality
+        super().__init__(BottleneckBlock, depth=depth, width=4,
+                         num_classes=num_classes, with_pool=with_pool,
+                         groups=cardinality)
+
+
+def _resnext(depth, cardinality, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network access; load a local "
+            "state_dict via model.set_state_dict instead")
+    return ResNeXt(depth=depth, cardinality=cardinality, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext(50, 32, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext(50, 64, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext(101, 32, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext(101, 64, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext(152, 32, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext(152, 64, pretrained, **kwargs)

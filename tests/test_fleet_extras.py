@@ -1,0 +1,105 @@
+"""fleet role makers / util / data generators + PS-side dataset and
+entry configs added for distributed namespace parity."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+def test_role_makers(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "a:1,b:2,c:3,d:4")
+    rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+    assert rm.worker_index() == 2
+    assert rm.worker_num() == 4
+    assert rm.is_worker() and not rm.is_server()
+    assert len(rm.get_trainer_endpoints()) == 4
+
+    urm = fleet.UserDefinedRoleMaker(
+        current_id=1, role=fleet.Role.WORKER,
+        worker_endpoints=["x:1", "y:2"])
+    assert urm.worker_index() == 1
+    assert urm.worker_num() == 2
+    assert not urm.is_first_worker()
+
+
+def test_fleet_class_and_util():
+    f = fleet.Fleet().init()
+    assert f.is_initialized()
+    assert f.is_worker() and not f.is_server()
+    assert f.worker_index() == 0 and f.is_first_worker()
+    files = [f"part-{i}" for i in range(5)]
+    assert fleet.util.get_file_shard(files) == files  # world size 1
+    assert fleet.util.all_reduce(np.array([3.0])) == 3.0
+    assert fleet.util.all_gather(7) == [7]
+    fleet.util.barrier()
+
+
+def test_multislot_data_generator():
+    class G(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                vals = [int(x) for x in line.split()]
+                yield [("words", vals[:-1]), ("label", [vals[-1]])]
+            return it
+
+    g = G()
+    g.set_batch(2)
+    out = io.StringIO()
+    g._run_lines(["1 2 3 1", "4 5 6 0"], out)
+    lines = out.getvalue().splitlines()
+    assert lines == ["3 1 2 3 1 1", "3 4 5 6 1 0"]
+
+    sg = fleet.MultiSlotStringDataGenerator()
+    assert sg._gen_str([("w", ["a", "b"]), ("l", ["1"])]) == "2 a b 1 1\n"
+
+
+def test_ps_datasets(tmp_path):
+    data = tmp_path / "part-0"
+    data.write_text("2 10 20 1 1\n2 30 40 1 0\n2 50 60 1 1\n")
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=2, use_var=["ids", "label"])
+    ds.set_filelist([str(data)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    ds.local_shuffle()
+    batches = list(ds)
+    assert len(batches) == 2
+    assert batches[0]["ids"].shape == (2, 2)
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+    qs = dist.QueueDataset()
+    qs.init(batch_size=3, use_var=["ids", "label"])
+    qs.set_filelist([str(data)])
+    (batch,) = list(qs)
+    assert batch["label"].shape == (3, 1)
+    assert batch["label"].dtype == np.int64
+
+
+def test_entry_attrs():
+    assert dist.ProbabilityEntry(0.5)._to_attr() == "probability_entry:0.5"
+    assert dist.CountFilterEntry(10)._to_attr() == "count_filter_entry:10"
+    assert dist.ShowClickEntry("show", "click")._to_attr() == \
+        "show_click_entry:show:click"
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(2.0)
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(1.5)
+
+
+def test_gloo_api_and_get_group():
+    dist.gloo_init_parallel_env(0, 1, "127.0.0.1:6170")
+    dist.gloo_barrier()
+    dist.gloo_release()
+    with pytest.raises(RuntimeError):
+        dist.gloo_barrier()
+    g = dist.new_group(ranks=[0])
+    assert dist.get_group(g.id) is g

@@ -1,0 +1,96 @@
+"""Model families added for full vision parity: DenseNet, ResNeXt,
+GoogLeNet, InceptionV3, ShuffleNetV2 scale variants — plus hub/sysconfig/
+onnx and the Bilinear initializer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _img(n=1, c=3, hw=64):
+    rng = np.random.RandomState(0)
+    return paddle.to_tensor(
+        rng.randn(n, c, hw, hw).astype(np.float32))
+
+
+def test_resnext_forward_and_width():
+    m = models.resnext50_32x4d(num_classes=10)
+    m.eval()
+    out = m(_img())
+    assert tuple(out.shape) == (1, 10)
+    # 32x4d channel plan: stage-1 grouped conv width 128
+    assert m.layer1[0].conv2.weight.shape[0] == 128
+    m64 = models.ResNeXt(depth=50, cardinality=64, num_classes=10)
+    assert m64.layer1[0].conv2.weight.shape[0] == 256
+
+
+def test_densenet_forward():
+    m = models.densenet121(num_classes=10)
+    m.eval()
+    out = m(_img())
+    assert tuple(out.shape) == (1, 10)
+    # growth plan: 121 ends at 1024 features
+    assert m.fc.weight.shape[0] == 1024
+    assert models.DenseNet(layers=161, num_classes=10).fc.weight.shape[0] \
+        == 2208
+
+
+def test_googlenet_three_outputs():
+    m = models.googlenet(num_classes=10)
+    m.eval()
+    out, aux1, aux2 = m(_img())
+    assert tuple(out.shape) == (1, 10)
+    assert tuple(aux1.shape) == (1, 10)
+    assert tuple(aux2.shape) == (1, 10)
+
+
+def test_inception_v3_forward():
+    m = models.inception_v3(num_classes=10)
+    m.eval()
+    out = m(_img(hw=96))
+    assert tuple(out.shape) == (1, 10)
+
+
+def test_shufflenet_variants():
+    for fn, last in [(models.shufflenet_v2_x0_25, 512),
+                     (models.shufflenet_v2_swish, 1024)]:
+        m = fn(num_classes=10)
+        m.eval()
+        assert tuple(m(_img(hw=64)).shape) == (1, 10)
+        assert m.fc.weight.shape[0] == last
+
+
+def test_bilinear_initializer():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.initializer import Bilinear
+    conv = nn.Conv2DTranspose(2, 2, 4, stride=2,
+                              weight_attr=paddle.ParamAttr(
+                                  initializer=Bilinear()))
+    w = np.asarray(conv.weight.numpy())
+    k1d = np.array([0.25, 0.75, 0.75, 0.25], dtype=np.float32)
+    expect = np.outer(k1d, k1d)
+    np.testing.assert_allclose(w[0, 0], expect, atol=1e-6)
+    np.testing.assert_allclose(w[1, 1], expect, atol=1e-6)
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_model(scale=2):\n"
+        "    '''doc of tiny_model'''\n"
+        "    return scale * 21\n")
+    assert paddle.hub.list(str(tmp_path), source="local") == ["tiny_model"]
+    assert "doc of tiny_model" in paddle.hub.help(
+        str(tmp_path), "tiny_model", source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny_model",
+                           source="local", scale=2) == 42
+    with pytest.raises(NotImplementedError):
+        paddle.hub.load("owner/repo", "m", source="github")
+
+
+def test_sysconfig_and_onnx():
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(None, "model")
