@@ -3,13 +3,17 @@ python/paddle/distributed/fleet/dataset/dataset.py (InMemoryDataset,
 QueueDataset).
 
 The reference backs these with C++ data feeds for parameter-server
-training. The TPU build keeps the user-facing API (init / set_filelist /
-load_into_memory / local_shuffle / batch iteration) as a pure-Python
-MultiSlot text reader whose batches are numpy arrays ready for
-``jax.device_put`` — PS-specific pieces (global_shuffle over trainers,
-pipe commands as subprocess filters) degrade gracefully to their local
-equivalents.
+training (paddle/fluid/framework/data_feed.cc MultiSlotInMemoryDataFeed).
+The TPU build keeps the user-facing API (init / set_filelist /
+load_into_memory / local_shuffle / batch iteration); InMemoryDataset
+parses and shuffles in the native runtime (runtime_core.cpp ms_* engine:
+multithreaded from_chars parsing into per-slot CSR arrays) with a
+pure-Python MultiSlot reader as fallback. Batches are numpy arrays ready
+for ``jax.device_put`` — PS-specific pieces (global_shuffle over
+trainers, pipe commands as subprocess filters) degrade gracefully to
+their local equivalents.
 """
+import ctypes
 import random
 import subprocess
 
@@ -110,15 +114,124 @@ class QueueDataset(DatasetBase):
         return self._batches_from(self._iter_samples())
 
 
+class _NativeMultiSlot:
+    """ctypes facade over the ms_* MultiSlot engine in runtime_core.cpp."""
+
+    def __init__(self, lib, slot_names, slot_types):
+        self._lib = lib
+        self._names = slot_names
+        self._types = slot_types  # 0=float32, 1=int64 per slot
+        arr = (ctypes.c_int * len(slot_types))(*slot_types)
+        self._h = lib.ms_create(len(slot_types), arr)
+
+    def load_file(self, path, n_threads):
+        return self._lib.ms_load_file(self._h, path.encode(),
+                                      int(n_threads))
+
+    def shuffle(self, seed):
+        self._lib.ms_shuffle(self._h, seed & (2**64 - 1))
+
+    def __len__(self):
+        return int(self._lib.ms_num_records(self._h))
+
+    def batch(self, start, count):
+        """{slot: np.ndarray [count, L]} (or list of ragged arrays)."""
+        out = {}
+        for s, name in enumerate(self._names):
+            lens = np.empty(count, np.uint64)
+            total = self._lib.ms_batch_lens(
+                self._h, start, count, s,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+            if self._types[s] == 1:
+                vals = np.empty(int(total), np.int64)
+                self._lib.ms_fill_batch_i64(
+                    self._h, start, count, s,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+            else:
+                vals = np.empty(int(total), np.float32)
+                self._lib.ms_fill_batch_f32(
+                    self._h, start, count, s,
+                    vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if len(set(lens.tolist())) == 1 and count:
+                out[name] = vals.reshape(count, -1)
+            else:
+                out[name] = np.split(vals, np.cumsum(lens)[:-1].astype(
+                    np.int64))
+        return out
+
+    def release(self):
+        self._lib.ms_release(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.ms_destroy(self._h)
+        except Exception:
+            pass
+
+
 class InMemoryDataset(DatasetBase):
-    """Load-then-shuffle dataset (ref: fleet/dataset/dataset.py:341)."""
+    """Load-then-shuffle dataset (ref: fleet/dataset/dataset.py:341).
+
+    Parsing/shuffling run in the native runtime when available; the
+    pipe_command path (arbitrary subprocess filters) stays in Python.
+    """
 
     def __init__(self):
         super().__init__()
         self._samples = []
+        self._native = None
+
+    def _detect_types(self):
+        """Slot dtypes: declared dtype on the use_var Variables when
+        available (the reference declares slot types up front in the
+        data-feed proto), else sniffed from the first 100 data lines —
+        a slot is int64 only if every sampled value parses as int."""
+        names = self._slot_names()
+        declared = []
+        for v in self._use_var:
+            dt = str(getattr(v, "dtype", "") or "")
+            if "int" in dt:
+                declared.append(1)
+            elif "float" in dt or "double" in dt:
+                declared.append(0)
+            else:
+                declared.append(None)
+        if all(d is not None for d in declared) and declared:
+            return declared
+        sampled = [1] * len(names)
+        seen = 0
+        for fname in self._filelist:
+            with open(fname) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    parsed = _parse_multislot_line(line, names)
+                    for i, n in enumerate(names):
+                        if parsed[n].dtype != np.int64:
+                            sampled[i] = 0
+                    seen += 1
+                    if seen >= 100:
+                        break
+            if seen >= 100:
+                break
+        return [d if d is not None else s
+                for d, s in zip(declared, sampled)] if declared else sampled
 
     def load_into_memory(self):
-        self._samples = list(self._iter_samples())
+        from ..runtime import get_lib
+        lib = get_lib()
+        if lib is None or self._pipe_command or not self._use_var:
+            self._native = None
+            self._samples = list(self._iter_samples())
+            return
+        self._native = _NativeMultiSlot(lib, self._slot_names(),
+                                        self._detect_types())
+        for fname in self._filelist:
+            if self._native.load_file(fname, self._thread_num) < 0:
+                # malformed for the fast parser — python fallback
+                self._native = None
+                self._samples = list(self._iter_samples())
+                return
 
     def preload_into_memory(self, thread_num=None):
         self.load_into_memory()
@@ -127,20 +240,34 @@ class InMemoryDataset(DatasetBase):
         pass
 
     def local_shuffle(self):
-        random.shuffle(self._samples)
+        if self._native is not None:
+            self._native.shuffle(random.getrandbits(63))
+        else:
+            random.shuffle(self._samples)
 
     def global_shuffle(self, fleet=None, thread_num=12):
         # single-process world: global == local
         self.local_shuffle()
 
     def get_memory_data_size(self, fleet=None):
-        return len(self._samples)
+        return len(self._native) if self._native is not None \
+            else len(self._samples)
 
     def get_shuffle_data_size(self, fleet=None):
-        return len(self._samples)
+        return self.get_memory_data_size(fleet)
 
     def release_memory(self):
+        if self._native is not None:
+            self._native.release()
         self._samples = []
 
     def __iter__(self):
-        return self._batches_from(iter(self._samples))
+        if self._native is None:
+            return self._batches_from(iter(self._samples))
+        return self._native_batches()
+
+    def _native_batches(self):
+        n = len(self._native)
+        bs = self._batch_size
+        for start in range(0, n, bs):
+            yield self._native.batch(start, min(bs, n - start))

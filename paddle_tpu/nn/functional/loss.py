@@ -22,6 +22,32 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
     def fn(logits, lab, *rest):
+        # Fused Pallas softmax-xent path: hard labels over a large vocab on
+        # TPU (GPT loss). Streams logits through VMEM with an online
+        # logsumexp instead of materializing log-probs in HBM.
+        if (use_softmax and not soft_label and not rest
+                and label_smoothing == 0.0 and logits.ndim >= 2
+                and axis in (-1, logits.ndim - 1)
+                and jax.default_backend() == "tpu"):
+            from ...ops.pallas.softmax_xent import (softmax_xent_arrays,
+                                                    supported)
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logits.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            n_rows = int(np.prod(logits.shape[:-1]))
+            v = logits.shape[-1]
+            if (lab_i.shape == logits.shape[:-1] and supported(n_rows, v)
+                    and n_rows * v >= (1 << 22)):
+                valid = lab_i != ignore_index
+                # -1 never matches a vocab column: masked rows get a
+                # zeroed loss here and a zeroed gradient via the mask
+                loss = softmax_xent_arrays(
+                    logits, jnp.where(valid, lab_i, -1))
+                loss = jnp.where(valid, loss, 0.0)
+                if reduction == "mean":
+                    n = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                    return jnp.sum(loss) / n
+                return _reduce(loss, reduction)
         if use_softmax:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
         else:

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+from .common import I0, NEG_INF  # noqa: F401
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
@@ -30,7 +30,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
     @pl.when(ik == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(NEG_INF))
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -40,13 +40,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         v = v_ref[0].astype(jnp.float32)          # [bk, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        s = s * scale                              # [bq, bk]
+        s = s * jnp.float32(scale)                 # [bq, bk]
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
 
         m_prev = m_ref[:]                          # [bq]
         m_cur = jnp.max(s, axis=1)
@@ -69,9 +69,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:], 1e-30)
+        l = jnp.maximum(l_ref[:], jnp.float32(1e-30))
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:] + jnp.log(l)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -89,20 +89,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -136,23 +136,23 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * jnp.float32(scale)
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale               # [bq, bk]
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)               # [bq, bk]
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bk, d]
@@ -196,17 +196,19 @@ def _flash_fwd_impl(q, k, v, causal, scale, interpret):
                           block_q=bq, block_k=bk, seq_k=Tk),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, I0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, I0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, I0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+            # lse kept [BH, 1, Tq]: trailing block dims (1, bq) satisfy the
+            # TPU (8, 128) tiling rule, which a [BH, Tq] layout cannot
+            jax.ShapeDtypeStruct((BH, 1, Tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -229,21 +231,21 @@ def _flash_bwd(causal, scale, interpret, res, dout):
     Tk = k.shape[1]
     bq, bk = _choose_blocks(Tq, Tk, D)
     delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32),
-                    axis=-1)  # [BH, Tq]
+                    axis=-1)[:, None, :]  # [BH, 1, Tq]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),
         grid=(BH, Tq // bq, Tk // bk),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, I0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, I0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, I0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, I0, i)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, I0)),
         out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
@@ -254,16 +256,16 @@ def _flash_bwd(causal, scale, interpret, res, dout):
                           block_q=bq, block_k=bk),
         grid=(BH, Tk // bk, Tq // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, I0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, I0)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, I0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, I0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, I0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Tk, D), k.dtype),

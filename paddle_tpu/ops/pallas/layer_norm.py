@@ -14,18 +14,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .common import I0  # noqa: F401
+
 
 def _fwd_kernel(x_ref, w_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     mu = jnp.mean(x, axis=-1, keepdims=True)
     xc = x - mu
     var = jnp.mean(xc * xc, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(var + eps)
+    rstd = jax.lax.rsqrt(var + jnp.float32(eps))
     y = xc * rstd
     o_ref[:] = (y * w_ref[:].astype(jnp.float32) +
                 b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    mu_ref[:] = mu[:, 0]
-    rstd_ref[:] = rstd[:, 0]
+    mu_ref[:] = mu
+    rstd_ref[:] = rstd
 
 
 def _bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, do_ref, dx_ref, dw_ref,
@@ -34,8 +36,8 @@ def _bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, do_ref, dx_ref, dw_ref,
     x = x_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    mu = mu_ref[:][:, None]
-    rstd = rstd_ref[:][:, None]
+    mu = mu_ref[:]
+    rstd = rstd_ref[:]
     xhat = (x - mu) * rstd
     wdy = do * w
     c1 = jnp.mean(xhat * wdy, axis=-1, keepdims=True)
@@ -71,19 +73,21 @@ def _ln_fwd_impl(x2d, w, b, eps, interpret):
         functools.partial(_fwd_kernel, eps=eps),
         grid=(R // br,),
         in_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
-            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((br, C), lambda i: (i, I0)),
+            pl.BlockSpec((C,), lambda i: (I0,)),
+            pl.BlockSpec((C,), lambda i: (I0,)),
         ],
         out_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
+            pl.BlockSpec((br, C), lambda i: (i, I0)),
+            # stats kept [R, 1]: 1D partial blocks trip XLA/Mosaic layout
+            # disagreements on TPU; a trailing unit dim satisfies tiling
+            pl.BlockSpec((br, 1), lambda i: (i, I0)),
+            pl.BlockSpec((br, 1), lambda i: (i, I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, C), x2d.dtype),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
-            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2d, w, b)
@@ -103,16 +107,16 @@ def _ln_bwd(eps, interpret, res, dout):
         _bwd_kernel,
         grid=(R // br,),
         in_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br,), lambda i: (i,)),
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
+            pl.BlockSpec((br, C), lambda i: (i, I0)),
+            pl.BlockSpec((C,), lambda i: (I0,)),
+            pl.BlockSpec((br, 1), lambda i: (i, I0)),
+            pl.BlockSpec((br, 1), lambda i: (i, I0)),
+            pl.BlockSpec((br, C), lambda i: (i, I0)),
         ],
         out_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
-            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((br, C), lambda i: (i, I0)),
+            pl.BlockSpec((C,), lambda i: (I0,)),
+            pl.BlockSpec((C,), lambda i: (I0,)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, C), x2d.dtype),

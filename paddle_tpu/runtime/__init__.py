@@ -48,6 +48,27 @@ def get_lib():
             ctypes.c_size_t, ctypes.c_void_p, ctypes.c_int]
         lib.parallel_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                       ctypes.c_size_t, ctypes.c_int]
+        lib.ms_create.restype = ctypes.c_void_p
+        lib.ms_create.argtypes = [ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_int)]
+        lib.ms_load_file.restype = ctypes.c_int64
+        lib.ms_load_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.ms_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ms_num_records.restype = ctypes.c_uint64
+        lib.ms_num_records.argtypes = [ctypes.c_void_p]
+        lib.ms_batch_lens.restype = ctypes.c_uint64
+        lib.ms_batch_lens.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.ms_fill_batch_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.ms_fill_batch_i64.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.ms_release.argtypes = [ctypes.c_void_p]
+        lib.ms_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
     except Exception:
         _lib = None

@@ -1,0 +1,78 @@
+"""Fused softmax-cross-entropy Pallas kernel (interpret mode on CPU).
+
+Mirrors the reference's softmax_with_cross_entropy op tests
+(python/paddle/fluid/tests/unittests/test_softmax_with_cross_entropy_op.py):
+forward vs a numpy/XLA logsumexp formula, gradient vs jax.grad of the
+reference composition, ignore_index masking at the functional layer.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.pallas.softmax_xent import (softmax_xent_arrays,
+                                                supported, _choose_block)
+
+
+def _ref_loss(x, lab):
+    return (jax.nn.logsumexp(x.astype(jnp.float32), axis=-1) -
+            jnp.take_along_axis(x.astype(jnp.float32),
+                                lab[..., None].astype(jnp.int64),
+                                -1)[..., 0])
+
+
+class TestSoftmaxXentKernel:
+    def test_forward_matches_logsumexp(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(64, 512) * 3, jnp.float32)
+        lab = jnp.asarray(rng.randint(0, 512, 64), jnp.int32)
+        loss = softmax_xent_arrays(x, lab, interpret=True)
+        np.testing.assert_allclose(np.asarray(loss),
+                                   np.asarray(_ref_loss(x, lab)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_forward_3d_batch(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 16, 256), jnp.float32)
+        lab = jnp.asarray(rng.randint(0, 256, (4, 16)), jnp.int32)
+        loss = softmax_xent_arrays(x, lab, interpret=True)
+        assert loss.shape == (4, 16)
+        np.testing.assert_allclose(np.asarray(loss),
+                                   np.asarray(_ref_loss(x, lab)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_matches_reference(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(32, 384), jnp.float32)
+        lab = jnp.asarray(rng.randint(0, 384, 32), jnp.int32)
+        g_kernel = jax.grad(
+            lambda x: jnp.mean(softmax_xent_arrays(x, lab,
+                                                   interpret=True)))(x)
+        g_ref = jax.grad(lambda x: jnp.mean(_ref_loss(x, lab)))(x)
+        np.testing.assert_allclose(np.asarray(g_kernel), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_out_of_range_label_is_pure_lse(self):
+        # label -1 never matches a column: loss = lse, grad = softmax
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(8, 128), jnp.float32)
+        lab = jnp.full((8,), -1, jnp.int32)
+        loss = softmax_xent_arrays(x, lab, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(loss), np.asarray(jax.nn.logsumexp(x, axis=-1)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_block_chooser(self):
+        assert _choose_block(50304, 4096, 128) > 0
+        assert 50304 % _choose_block(50304, 4096, 128) == 0
+        assert _choose_block(8192, 4096, 128) == 4096
+        assert _choose_block(1000, 4096, 128) == 1000  # fits whole
+        assert supported(8192, 50304)
+
+    def test_bf16_logits(self):
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(16, 256), jnp.bfloat16)
+        lab = jnp.asarray(rng.randint(0, 256, 16), jnp.int32)
+        loss = softmax_xent_arrays(x, lab, interpret=True)
+        np.testing.assert_allclose(np.asarray(loss),
+                                   np.asarray(_ref_loss(x, lab)),
+                                   rtol=1e-2, atol=1e-2)
