@@ -1,0 +1,64 @@
+"""GPT scan-over-blocks path: lax.scan over stacked per-layer params must
+be numerically identical to the unrolled python loop (fwd + grads), and
+the eager tape path must keep working (scan is gated to traced contexts).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.api import functional_call, state_arrays
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+
+def _setup():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=3,
+                    num_heads=2, max_position_embeddings=32, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    params, _ = state_arrays(m)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 16)), jnp.int32)
+    return cfg, m, params, ids
+
+
+class TestGPTScanBlocks:
+    def test_forward_matches_unrolled(self):
+        cfg, m, params, ids = _setup()
+
+        def fwd(params, ids):
+            return functional_call(m, params, {}, (ids,), training=False)
+
+        cfg.scan_layers = True
+        out_scan = jax.jit(fwd)(params, ids)
+        cfg.scan_layers = False
+        out_unroll = jax.jit(fwd)(params, ids)
+        np.testing.assert_allclose(np.asarray(out_scan),
+                                   np.asarray(out_unroll),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_grads_match_unrolled_and_remat(self):
+        cfg, m, params, ids = _setup()
+
+        def loss(params, scan, remat=False):
+            cfg.scan_layers, cfg.scan_remat = scan, remat
+            logits = functional_call(m, params, {}, (ids,), training=True)
+            return jnp.mean(jax.nn.logsumexp(
+                logits.astype(jnp.float32), -1))
+
+        g_un = jax.grad(lambda p: loss(p, False))(params)
+        for remat in (False, True):
+            g_scan = jax.grad(lambda p: loss(p, True, remat))(params)
+            for k in g_un:
+                np.testing.assert_allclose(
+                    np.asarray(g_scan[k]), np.asarray(g_un[k]),
+                    rtol=1e-5, atol=1e-6, err_msg=f"{k} remat={remat}")
+
+    def test_eager_tape_still_works(self):
+        cfg, m, params, ids = _setup()
+        cfg.scan_layers = True  # gated off outside traces
+        t = paddle.to_tensor(np.asarray(ids))
+        l = m.loss(t, t)
+        l.backward()
+        assert m.parameters()[0].grad is not None
+        assert np.isfinite(float(l.item()))
