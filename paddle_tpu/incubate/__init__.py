@@ -193,24 +193,106 @@ class optimizer:
             return ctx()
 
 
+def _fused_layers():
+    """paddle.incubate.nn fused transformer layers. Parity:
+    python/paddle/incubate/nn/layer/fused_transformer.py. On TPU the
+    'fusion' is flash attention (Pallas) + Pallas layer_norm + XLA
+    elementwise fusion — same single-layer semantics: attention/FFN with
+    the residual add and layer norm folded into the layer."""
+    from .. import nn as _nn
+    from ..nn import functional as _F
+
+    class FusedMultiHeadAttention(_nn.Layer):
+        def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                     attn_dropout_rate=0.5, kdim=None, vdim=None,
+                     normalize_before=False, need_weights=False,
+                     weight_attr=None, bias_attr=None, epsilon=1e-5,
+                     name=None):
+            super().__init__()
+            assert not need_weights, "need_weights not supported"
+            self.embed_dim = embed_dim
+            self.num_heads = num_heads
+            self.normalize_before = normalize_before
+            self.qkv_proj = _nn.Linear(embed_dim, 3 * embed_dim,
+                                       weight_attr=weight_attr,
+                                       bias_attr=bias_attr)
+            self.out_proj = _nn.Linear(embed_dim, embed_dim,
+                                       weight_attr=weight_attr,
+                                       bias_attr=bias_attr)
+            self.ln = _nn.LayerNorm(embed_dim, epsilon=epsilon)
+            self.attn_dropout = _nn.Dropout(attn_dropout_rate)
+            self.dropout = _nn.Dropout(dropout_rate)
+
+        def forward(self, query, key=None, value=None, attn_mask=None,
+                    cache=None):
+            """cache: optional (k_hist, v_hist) in [B, T, H, D] for
+            incremental decode; returns (out, (k, v)) when given, like
+            the reference's Cache path."""
+            residual = query
+            x = self.ln(query) if self.normalize_before else query
+            B, T, E = x.shape
+            H = self.num_heads
+            qkv = self.qkv_proj(x).reshape([B, T, 3, H, E // H])
+            q, k, v = qkv.unbind(axis=2)
+            if cache is not None:
+                from ..tensor.manipulation import concat
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
+            out = _F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.attn_dropout.p if self.training else 0.0)
+            out = self.out_proj(out.reshape([B, T, E]))
+            out = residual + self.dropout(out)
+            if not self.normalize_before:
+                out = self.ln(out)
+            return out if cache is None else (out, (k, v))
+
+    class FusedFeedForward(_nn.Layer):
+        def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                     epsilon=1e-5, activation="relu",
+                     act_dropout_rate=None, normalize_before=False,
+                     linear1_weight_attr=None, linear1_bias_attr=None,
+                     linear2_weight_attr=None, linear2_bias_attr=None,
+                     ln1_scale_attr=None, ln1_bias_attr=None,
+                     ln2_scale_attr=None, ln2_bias_attr=None, name=None):
+            super().__init__()
+            self.normalize_before = normalize_before
+            self.linear1 = _nn.Linear(d_model, dim_feedforward,
+                                      weight_attr=linear1_weight_attr,
+                                      bias_attr=linear1_bias_attr)
+            self.linear2 = _nn.Linear(dim_feedforward, d_model,
+                                     weight_attr=linear2_weight_attr,
+                                     bias_attr=linear2_bias_attr)
+            self.ln = _nn.LayerNorm(d_model, epsilon=epsilon)
+            self.dropout = _nn.Dropout(dropout_rate)
+            self.act_dropout = _nn.Dropout(
+                dropout_rate if act_dropout_rate is None
+                else act_dropout_rate)
+            self.activation = getattr(_F, activation)
+
+        def forward(self, src, cache=None):
+            residual = src
+            x = self.ln(src) if self.normalize_before else src
+            x = self.act_dropout(self.activation(self.linear1(x)))
+            x = self.dropout(self.linear2(x))
+            out = residual + x
+            if not self.normalize_before:
+                out = self.ln(out)
+            return out
+
+    return FusedMultiHeadAttention, FusedFeedForward
+
+
 class nn:
-    """paddle.incubate.nn — fused layer entry points map onto Pallas."""
-
-    class FusedMultiHeadAttention:
-        def __init__(self, *a, **k):
-            raise NotImplementedError(
-                "use paddle_tpu.nn.MultiHeadAttention — it already "
-                "dispatches to the fused Pallas flash-attention kernel")
-
-    class FusedFeedForward:
-        def __init__(self, *a, **k):
-            raise NotImplementedError(
-                "XLA fuses the FFN (matmul+gelu+matmul) automatically")
+    """paddle.incubate.nn — fused layers over the Pallas kernel paths."""
 
     @staticmethod
     def fused_multi_head_attention(*a, **k):
         raise NotImplementedError(
             "use nn.functional.scaled_dot_product_attention")
+
+
+nn.FusedMultiHeadAttention, nn.FusedFeedForward = _fused_layers()
 
 
 LookAhead = optimizer.LookAhead

@@ -115,12 +115,24 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
             a.shape, (w.shape[1] * groups, w.shape[0] // groups)
             + w.shape[2:], (lhs_spec, "OI" + sp, lhs_spec))
         # gradient-of-conv formulation: transpose conv = lhs-dilated conv
-        if isinstance(pad_arg, str):
-            pads = pad_arg.upper()
-            raise NotImplementedError(
-                "string padding for conv_transpose unsupported")
-        p = _padding(pad_arg, n)
         k = [(w.shape[2 + i] - 1) * dil[i] + 1 for i in range(n)]
+        if isinstance(pad_arg, str):
+            mode = pad_arg.upper()
+            if mode == "VALID":
+                p = [(0, 0)] * n
+            elif mode == "SAME":
+                # paddle's UpdatePaddingAndDilation: pad from input dims,
+                # pad_sum = (ceil(in/stride)-1)*stride + k_eff - in
+                p = []
+                dims = a.shape[2:] if not channel_last else a.shape[1:-1]
+                for i in range(n):
+                    out_i = -(-dims[i] // strides[i])  # ceil div
+                    tot = max((out_i - 1) * strides[i] + k[i] - dims[i], 0)
+                    p.append((tot // 2, tot - tot // 2))
+            else:
+                raise ValueError(f"unknown padding {pad_arg!r}")
+        else:
+            p = _padding(pad_arg, n)
         trans_pads = [(k[i] - 1 - p[i][0], k[i] - 1 - p[i][1] + opad[i])
                       for i in range(n)]
         # weight layout paddle: [in_c, out_c/groups, *k]; flip spatial and

@@ -45,6 +45,14 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(keep)
 
 
+def _roi_image_index(n_rois, rois_num):
+    """Batch-image index per RoI from per-image counts. Works under jit:
+    roi r belongs to the first image whose cumulative count exceeds r."""
+    cum = jnp.cumsum(jnp.asarray(rois_num))
+    return jnp.sum(jnp.arange(n_rois)[:, None] >= cum[None, :],
+                   axis=1).astype(jnp.int32)
+
+
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     if isinstance(output_size, int):
@@ -53,13 +61,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     def fn(feat, rois, rois_num):
         N, C, H, W = feat.shape
-        # assign each roi to its batch image (host-side counts)
-        if isinstance(rois_num, jax.core.Tracer):
-            img_idx = jnp.zeros((rois.shape[0],), jnp.int32)
-        else:
-            img_idx = jnp.concatenate([
-                jnp.full((int(n),), i, jnp.int32)
-                for i, n in enumerate(np.asarray(rois_num))])
+        img_idx = _roi_image_index(rois.shape[0], rois_num)
 
         offset = 0.5 if aligned else 0.0
         x1 = rois[:, 0] * spatial_scale - offset
@@ -307,10 +309,41 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return apply_op(fn, *args)
 
 
-class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "use paddle_tpu.vision.ops.deform_conv2d functional form")
+from ..nn.layer.layers import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Deformable conv v1/v2 layer over the deform_conv2d functional.
+    Parity: python/paddle/vision/ops.py DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        from ..nn import initializer as I
+        # reference init: std = sqrt(2 / (in_channels * kh * kw)),
+        # no groups division (vision/ops.py DeformConv2D)
+        fan_in = in_channels * ks[0] * ks[1]
+        default_init = I.Normal(0.0, float(np.sqrt(2.0 / fan_in)))
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr, default_initializer=default_init)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, bias=self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -332,19 +365,156 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         Tensor(order.astype(np.int32))
 
 
+def _np_greedy_nms(boxes, scores, thresh, eta, pixel_offset):
+    """Greedy NMS with paddle's adaptive eta; returns kept indices in
+    score order."""
+    off = 1.0 if pixel_offset else 0.0
+    areas = (boxes[:, 2] - boxes[:, 0] + off) * \
+            (boxes[:, 3] - boxes[:, 1] + off)
+    order = np.argsort(-scores)
+    keep = []
+    adaptive = thresh
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(boxes[i, 0], boxes[order, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order, 3])
+        inter = np.maximum(0.0, xx2 - xx1 + off) * \
+            np.maximum(0.0, yy2 - yy1 + off)
+        iou = inter / (areas[i] + areas[order] - inter + 1e-10)
+        suppressed[order[iou > adaptive]] = True
+        suppressed[i] = False
+        if eta < 1.0 and adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(keep, np.int64)
+
+
 def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                        pre_nms_top_n=6000, post_nms_top_n=1000,
                        nms_thresh=0.5, min_size=0.1, eta=1.0,
                        pixel_offset=False, return_rois_num=False,
                        name=None):
-    raise NotImplementedError(
-        "generate_proposals: detection-RPN pipeline lands with the "
-        "detection model family")
+    """RPN proposal generation. Host-side numpy (like the reference's CPU
+    generate_proposals_v2 kernel,
+    paddle/fluid/operators/detection/generate_proposals_v2_op.cc): decode
+    anchor deltas, clip to image, filter small boxes, NMS, top-N.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; img_size [N, 2]
+    (h, w); anchors/variances [H, W, A, 4] (or flattened [H*W*A, 4]).
+    Returns (rpn_rois [M, 4], rpn_roi_probs [M, 1][, rois_num])."""
+    sc = scores.numpy()
+    bd = bbox_deltas.numpy()
+    im = img_size.numpy()
+    an = anchors.numpy().reshape(-1, 4).astype(np.float64)
+    va = variances.numpy().reshape(-1, 4).astype(np.float64)
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, rois_num = [], [], []
+    for n in range(sc.shape[0]):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)         # (H,W,A) order
+        d = bd[n].transpose(1, 2, 0).reshape(-1, 4).astype(np.float64)
+        if 0 < pre_nms_top_n < len(s):
+            idx = np.argpartition(-s, pre_nms_top_n)[:pre_nms_top_n]
+        else:
+            idx = np.arange(len(s))
+        idx = idx[np.argsort(-s[idx])]
+        s_k, d_k, a_k, v_k = s[idx], d[idx], an[idx], va[idx]
+
+        # decode (center-size with variances)
+        aw = a_k[:, 2] - a_k[:, 0] + off
+        ah = a_k[:, 3] - a_k[:, 1] + off
+        acx = a_k[:, 0] + aw * 0.5
+        acy = a_k[:, 1] + ah * 0.5
+        cx = d_k[:, 0] * v_k[:, 0] * aw + acx
+        cy = d_k[:, 1] * v_k[:, 1] * ah + acy
+        clip = np.log(1000.0 / 16.0)  # reference kBBoxClipDefault
+        w = np.exp(np.minimum(d_k[:, 2] * v_k[:, 2], clip)) * aw
+        h = np.exp(np.minimum(d_k[:, 3] * v_k[:, 3], clip)) * ah
+        props = np.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], -1)
+
+        imh, imw = float(im[n, 0]), float(im[n, 1])
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, imw - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, imh - off)
+
+        ms = max(float(min_size), 1.0)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            keep &= (props[:, 0] + ws / 2 < imw) & \
+                    (props[:, 1] + hs / 2 < imh)
+        keep = np.nonzero(keep)[0]
+        if len(keep) == 0:
+            props = np.zeros((1, 4), np.float32)
+            s_k = np.zeros((1,), np.float32)
+        else:
+            props, s_k = props[keep], s_k[keep]
+            if nms_thresh > 0:
+                kept = _np_greedy_nms(props, s_k, nms_thresh, eta,
+                                      pixel_offset)
+                if 0 < post_nms_top_n < len(kept):
+                    kept = kept[:post_nms_top_n]
+                props, s_k = props[kept], s_k[kept]
+        all_rois.append(props.astype(np.float32))
+        all_probs.append(s_k.reshape(-1, 1).astype(np.float32))
+        rois_num.append(len(props))
+
+    rois = Tensor(np.concatenate(all_rois, 0))
+    probs = Tensor(np.concatenate(all_probs, 0))
+    if return_rois_num:
+        return rois, probs, Tensor(np.asarray(rois_num, np.int32))
+    return rois, probs
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                name=None):
-    raise NotImplementedError("psroi_pool lands with detection models")
+    """Position-sensitive RoI average pooling (R-FCN). Parity:
+    paddle/fluid/operators/psroi_pool_op.h — output channel c of bin
+    (i, j) averages input channel (c*ph + i)*pw + j over the bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph_, pw_ = output_size
+
+    def fn(feat, rois, rois_num):
+        N, C, H, W = feat.shape
+        oc = C // (ph_ * pw_)
+        img_idx = _roi_image_index(rois.shape[0], rois_num)
+
+        rs_w = jnp.round(rois[:, 0]) * spatial_scale
+        rs_h = jnp.round(rois[:, 1]) * spatial_scale
+        re_w = (jnp.round(rois[:, 2]) + 1.0) * spatial_scale
+        re_h = (jnp.round(rois[:, 3]) + 1.0) * spatial_scale
+        bh = jnp.maximum(re_h - rs_h, 0.1) / ph_
+        bw = jnp.maximum(re_w - rs_w, 0.1) / pw_
+
+        def one_roi(r):
+            img = feat[img_idx[r]].reshape(oc, ph_, pw_, H, W)
+            hstart = jnp.clip(jnp.floor(rs_h[r] + jnp.arange(ph_) * bh[r]),
+                              0, H)
+            hend = jnp.clip(
+                jnp.ceil(rs_h[r] + (jnp.arange(ph_) + 1) * bh[r]), 0, H)
+            wstart = jnp.clip(jnp.floor(rs_w[r] + jnp.arange(pw_) * bw[r]),
+                              0, W)
+            wend = jnp.clip(
+                jnp.ceil(rs_w[r] + (jnp.arange(pw_) + 1) * bw[r]), 0, W)
+            ymask = ((jnp.arange(H)[None, :] >= hstart[:, None]) &
+                     (jnp.arange(H)[None, :] < hend[:, None]))
+            xmask = ((jnp.arange(W)[None, :] >= wstart[:, None]) &
+                     (jnp.arange(W)[None, :] < wend[:, None]))
+            sums = jnp.einsum("cijhw,ih,jw->cij", img,
+                              ymask.astype(feat.dtype),
+                              xmask.astype(feat.dtype))
+            area = ((hend - hstart)[:, None] *
+                    (wend - wstart)[None, :]).astype(feat.dtype)
+            return jnp.where(area > 0, sums / jnp.maximum(area, 1.0), 0.0)
+
+        return jax.vmap(one_roi)(jnp.arange(rois.shape[0]))
+    return apply_op(fn, x, boxes, boxes_num)
 
 
 def read_file(path, name=None):

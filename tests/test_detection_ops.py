@@ -1,0 +1,169 @@
+"""Detection ops: psroi_pool, generate_proposals, DeformConv2D layer,
+conv_transpose string padding.
+
+Numeric oracles: naive python loops (psroi), hand-checked geometry
+(proposals), torch (deform as plain conv when offsets are zero).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.ops as ops
+
+
+class TestPSRoIPool:
+    def test_matches_naive_loop(self):
+        rng = np.random.RandomState(0)
+        ph = pw = 2
+        C = 8  # oc = 2
+        x = rng.randn(1, C, 10, 12).astype(np.float32)
+        boxes = np.array([[0, 0, 6, 8], [2, 3, 9, 9]], np.float32)
+        out = ops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([2], np.int32)),
+                             (ph, pw), spatial_scale=1.0).numpy()
+        assert out.shape == (2, C // (ph * pw), ph, pw)
+
+        # independent naive formulation
+        H, W = x.shape[2:]
+        for r, box in enumerate(boxes):
+            rs_w, rs_h = round(box[0]) * 1.0, round(box[1]) * 1.0
+            re_w, re_h = (round(box[2]) + 1) * 1.0, (round(box[3]) + 1) * 1.0
+            bh = max(re_h - rs_h, 0.1) / ph
+            bw = max(re_w - rs_w, 0.1) / pw
+            for c in range(C // (ph * pw)):
+                for i in range(ph):
+                    for j in range(pw):
+                        hs = int(np.clip(np.floor(rs_h + i * bh), 0, H))
+                        he = int(np.clip(np.ceil(rs_h + (i + 1) * bh), 0, H))
+                        ws = int(np.clip(np.floor(rs_w + j * bw), 0, W))
+                        we = int(np.clip(np.ceil(rs_w + (j + 1) * bw), 0, W))
+                        cin = (c * ph + i) * pw + j
+                        reg = x[0, cin, hs:he, ws:we]
+                        want = reg.mean() if reg.size else 0.0
+                        np.testing.assert_allclose(out[r, c, i, j], want,
+                                                   rtol=1e-5, atol=1e-5)
+
+    def test_layer_wrapper(self):
+        x = paddle.randn([1, 8, 6, 6])
+        boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        out = ops.PSRoIPool(2, 1.0)(x, boxes, bn)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+
+    def test_batch_image_assignment_under_jit(self):
+        # second image's RoI must pool image-1 features, traced or not
+        import jax
+        x = np.zeros((2, 4, 4, 4), np.float32)
+        x[1] = 1.0
+        boxes = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], np.float32)
+        bn = np.array([1, 1], np.int32)
+        out = ops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                             paddle.to_tensor(bn), 2).numpy()
+        assert out[0].max() == 0.0 and out[1].min() == 1.0
+
+
+class TestGenerateProposals:
+    def _inputs(self, N=1, A=2, H=3, W=3):
+        rng = np.random.RandomState(1)
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        img = np.array([[40.0, 40.0]] * N, np.float32)
+        anchors = np.zeros((H, W, A, 4), np.float32)
+        for y in range(H):
+            for x in range(W):
+                for a in range(A):
+                    cx, cy = x * 12 + 6, y * 12 + 6
+                    s = 8 * (a + 1)
+                    anchors[y, x, a] = [cx - s / 2, cy - s / 2,
+                                        cx + s / 2, cy + s / 2]
+        var = np.ones((H, W, A, 4), np.float32)
+        return scores, deltas, img, anchors, var
+
+    def test_shapes_and_clipping(self):
+        scores, deltas, img, anchors, var = self._inputs()
+        rois, probs, num = ops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), pre_nms_top_n=100, post_nms_top_n=10,
+            nms_thresh=0.7, min_size=2.0, return_rois_num=True)
+        r = rois.numpy()
+        assert r.shape[1] == 4 and probs.numpy().shape[1] == 1
+        assert int(num.numpy()[0]) == len(r)
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 40).all()
+        assert (r[:, 1] >= 0).all() and (r[:, 3] <= 40).all()
+        # proposals come back score-sorted
+        p = probs.numpy()[:, 0]
+        assert (np.diff(p) <= 1e-6).all()
+
+    def test_zero_deltas_decode_to_anchors(self):
+        scores, deltas, img, anchors, var = self._inputs(A=1)
+        deltas[:] = 0
+        rois, probs = ops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), nms_thresh=-1.0, min_size=0.1)
+        got = set(map(tuple, np.round(rois.numpy(), 3)))
+        want = np.clip(anchors.reshape(-1, 4), 0, 40)
+        assert got == set(map(tuple, np.round(want, 3)))
+
+    def test_nms_suppresses_duplicates(self):
+        scores, deltas, img, anchors, var = self._inputs(A=2)
+        # make both anchors at each location identical -> NMS halves them
+        anchors[:, :, 1] = anchors[:, :, 0]
+        deltas[:] = 0
+        rois_all, _ = ops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), nms_thresh=-1.0, min_size=0.1)
+        rois_nms, _ = ops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), nms_thresh=0.7, min_size=0.1)
+        assert len(rois_nms.numpy()) == len(rois_all.numpy()) // 2
+
+
+class TestDeformConv2DLayer:
+    def test_zero_offset_equals_plain_conv(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        layer = ops.DeformConv2D(4, 6, 3, padding=1)
+        w = layer.weight.numpy()
+        b = layer.bias.numpy()
+        offset = np.zeros((2, 2 * 3 * 3, 8, 8), np.float32)
+        out = layer(paddle.to_tensor(x), paddle.to_tensor(offset)).numpy()
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w),
+                        torch.tensor(b), padding=1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        layer = ops.DeformConv2D(4, 6, 3, padding=1)
+        sd = layer.state_dict()
+        assert "weight" in sd and "bias" in sd
+        layer.set_state_dict(sd)
+
+
+class TestConvTransposeStringPadding:
+    def test_same_output_size(self):
+        from paddle_tpu.nn import functional as F
+        x = paddle.randn([1, 3, 8, 8])
+        w = paddle.randn([3, 5, 3, 3])
+        out = F.conv2d_transpose(x, w, stride=2, padding="SAME")
+        assert tuple(out.shape)[2:] == (16, 16)
+
+    def test_valid_output_size(self):
+        from paddle_tpu.nn import functional as F
+        x = paddle.randn([1, 3, 8, 8])
+        w = paddle.randn([3, 5, 3, 3])
+        out = F.conv2d_transpose(x, w, stride=2, padding="VALID")
+        assert tuple(out.shape)[2:] == (17, 17)  # (8-1)*2 + 3
+
+    def test_same_non_divisible_input(self):
+        # paddle pads from input dims: in=5, k=3, s=2 ->
+        # pad_sum = (ceil(5/2)-1)*2 + 3 - 5 = 2 -> out = (5-1)*2 - 2 + 3
+        from paddle_tpu.nn import functional as F
+        x = paddle.randn([1, 3, 5, 5])
+        w = paddle.randn([3, 5, 3, 3])
+        out = F.conv2d_transpose(x, w, stride=2, padding="SAME")
+        assert tuple(out.shape)[2:] == (9, 9)
