@@ -3,15 +3,17 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline ratchets against BENCH_BASE.json (first run records the base;
 BASELINE.json carries no published numbers to compare against directly).
+On failure, prints a one-line diagnostic JSON instead of a bare traceback.
 """
 import json
 import os
 import time
+import traceback
 
 import numpy as np
 
 
-def main():
+def _run():
     import jax
     import jax.numpy as jnp
 
@@ -23,10 +25,13 @@ def main():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # scan_remat=True: recompute block activations in backward; without
+        # it the 24-layer lax.scan stacks every layer's residuals (>10 GB
+        # of bf16 temps on a 16 GB chip -> OOM, see BENCH_r02.json).
         batch, seq = 8, 1024
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=seq,
-                        dropout=0.0)
+                        dropout=0.0, scan_remat=True)
     else:  # smoke-size on CPU so the script always runs
         batch, seq = 2, 128
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
@@ -36,6 +41,7 @@ def main():
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model.bfloat16() if on_tpu else None
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
 
     o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
 
@@ -54,7 +60,7 @@ def main():
         loss = step(ids, ids)
     float(loss.item())
 
-    iters = 10 if on_tpu else 3
+    iters = 20 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, ids)
@@ -62,6 +68,13 @@ def main():
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
+    # MFU: train step ~ 6*N flops/token (fwd 2N + bwd 4N), against the
+    # chip generation's bf16 peak.  Context only; headline stays tokens/s.
+    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12, "v6e": 918e12}
+    kind = jax.devices()[0].device_kind.lower() if on_tpu else ""
+    peak = next((v for k, v in peaks.items() if k in kind), 197e12)
+    mfu = 6.0 * n_params * tokens_per_sec / peak if on_tpu else 0.0
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_BASE.json")
     vs = 1.0
@@ -72,13 +85,32 @@ def main():
             vs = tokens_per_sec / base
         else:
             with open(base_path, "w") as f:
-                json.dump({"tokens_per_sec": tokens_per_sec}, f)
+                json.dump({"tokens_per_sec": tokens_per_sec,
+                           "mfu": mfu, "n_params": n_params}, f)
     print(json.dumps({
         "metric": "gpt_medium_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
+        "mfu": round(mfu, 4),
+        "loss": round(float(loss.item()), 4),
     }))
+
+
+def main():
+    try:
+        _run()
+    except Exception as e:  # diagnostic JSON line, never a bare traceback
+        tb = traceback.format_exc()
+        print(json.dumps({
+            "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {str(e)[:400]}",
+            "traceback_tail": tb[-800:],
+        }))
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

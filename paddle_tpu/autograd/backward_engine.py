@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, no_grad
+from ..framework.core import _Slot, _Node
 
 __all__ = ["run_backward", "grad"]
 
@@ -39,37 +40,140 @@ def _accumulate(slot, g):
     slot.grad = g if slot.grad is None else slot.grad + g
 
 
-def _backward_pass(root_slots, seed_grads, retain_graph):
-    """Run VJPs in reverse topological order. Returns every slot touched."""
+def _backward_pass(root_slots, seed_grads, retain_graph,
+                   create_graph=False):
+    """Run VJPs in reverse topological order. Returns (all_slots, gslots).
+
+    With create_graph=True every cotangent is itself a taped _Slot (its
+    producing _Node holds the VJP function), so the returned gradients are
+    differentiable — paddle.grad(create_graph=True) / double-grad parity
+    with the reference engine (fluid/imperative/basic_engine.cc +
+    dygraph/base.py:grad)."""
     nodes = _topo_nodes(root_slots)
     all_slots = set(root_slots)
     for n in nodes:
         all_slots.update(n.in_slots)
         all_slots.update(n.out_slots)
-    for s, g in zip(root_slots, seed_grads):
-        _accumulate(s, g)
 
-    with no_grad():
-        for node in reversed(nodes):
-            if any(o.grad is not None for o in node.out_slots):
-                cots = tuple(
-                    o.grad if o.grad is not None else jnp.zeros_like(o.val)
-                    for o in node.out_slots)
-                if hasattr(node, "run_vjp"):  # PyLayer custom backward
+    # id(slot) -> _Slot carrying that slot's (taped) cotangent
+    gslots = {}
+
+    def acc(slot, g_val, g_slot=None):
+        if create_graph:
+            gs = g_slot if g_slot is not None else _Slot(g_val)
+            cur = gslots.get(id(slot))
+            if cur is None:
+                gslots[id(slot)] = gs
+            else:
+                ns = _Slot(cur.val + gs.val)
+                ns.node = _Node(lambda a, b: a + b, (cur, gs), (ns,),
+                                multi=False)
+                gslots[id(slot)] = ns
+            slot.grad = gslots[id(slot)].val
+        else:
+            _accumulate(slot, g_val)
+
+    hooked = set()
+
+    def run_hooks(slot):
+        """Invoke user hooks once the slot's cotangent is final; a non-None
+        return replaces the upstream gradient (ref
+        varbase_patch_methods.py:register_hook)."""
+        if slot.grad is None or id(slot) in hooked:
+            return
+        hooked.add(id(slot))
+        t = slot.tensor_ref() if slot.tensor_ref else None
+        hooks = getattr(t, "_grad_hooks", None) if t is not None else None
+        if not hooks:
+            return
+        if create_graph:
+            g = Tensor(gslots[id(slot)])
+            g.stop_gradient = False
+            for h in hooks:
+                r = h(g)
+                if r is not None:
+                    g = r if isinstance(r, Tensor) else Tensor(r)
+            gslots[id(slot)] = g._slot
+            slot.grad = g._slot.val
+        else:
+            with no_grad():
+                g = Tensor(slot.grad)
+                for h in hooks:
+                    r = h(g)
+                    if r is not None:
+                        g = r if isinstance(r, Tensor) else Tensor(r)
+                slot.grad = g.value
+
+    for s, g in zip(root_slots, seed_grads):
+        acc(s, g)
+
+    for node in reversed(nodes):
+        # reverse-topo order: by now every consumer of node's outputs has
+        # contributed its cotangent, so out grads are final -> hooks fire
+        for o in node.out_slots:
+            run_hooks(o)
+        if any(o.grad is not None for o in node.out_slots):
+            if hasattr(node, "run_vjp"):  # PyLayer custom backward
+                if create_graph:
+                    raise NotImplementedError(
+                        "create_graph=True through a PyLayer: its custom "
+                        "backward is not taped; compose jax transforms "
+                        "(autograd.vjp/jvp) for higher-order grads instead")
+                with no_grad():
+                    cots = tuple(o.grad if o.grad is not None
+                                 else jnp.zeros_like(o.val)
+                                 for o in node.out_slots)
                     in_cots = node.run_vjp(cots)
-                else:
+                    for s, g in zip(node.in_slots, in_cots):
+                        if g is not None:
+                            acc(s, g)
+            elif create_graph:
+                k = len(node.in_slots)
+                cot_slots = tuple(
+                    gslots[id(o)] if o.grad is not None
+                    else _Slot(jnp.zeros_like(o.val))
+                    for o in node.out_slots)
+
+                def bw_fn(*vals, _fn=node.fn, _k=k, _multi=node.multi):
+                    ins, cots = vals[:_k], vals[_k:]
+                    _, vjp = jax.vjp(_fn, *ins)
+                    return vjp(tuple(cots) if _multi else cots[0])
+
+                with no_grad():
+                    out_grads = bw_fn(*([s.val for s in node.in_slots]
+                                        + [cs.val for cs in cot_slots]))
+                g_slots = tuple(_Slot(g) for g in out_grads)
+                bnode = _Node(bw_fn,
+                              tuple(node.in_slots) + cot_slots,
+                              g_slots, multi=True)
+                for gs in g_slots:
+                    gs.node = bnode
+                for s, gs in zip(node.in_slots, g_slots):
+                    acc(s, gs.val, g_slot=gs)
+            else:
+                with no_grad():
+                    cots = tuple(o.grad if o.grad is not None
+                                 else jnp.zeros_like(o.val)
+                                 for o in node.out_slots)
                     _, vjp_fn = jax.vjp(node.fn,
                                         *[s.val for s in node.in_slots])
                     in_cots = vjp_fn(cots if node.multi else cots[0])
-                for s, g in zip(node.in_slots, in_cots):
-                    if g is not None:
-                        _accumulate(s, g)
-            if not retain_graph:
-                for o in node.out_slots:
-                    o.node = None
-                node.fn = None
-                node.in_slots = ()
-    return all_slots
+                    for s, g in zip(node.in_slots, in_cots):
+                        if g is not None:
+                            acc(s, g)
+        # create_graph implies retain: the taped bnodes reference the
+        # forward nodes' slots, so freeing them here would silently drop
+        # second-order paths through intermediates
+        if not retain_graph and not create_graph:
+            for o in node.out_slots:
+                o.node = None
+            node.fn = None
+            node.in_slots = ()
+    # leaves have no producing node, so their hooks fire here
+    for s in all_slots:
+        if s.node is None:
+            run_hooks(s)
+    return all_slots, gslots
 
 
 def _collect_and_clear(all_slots, into_tensors):
@@ -100,22 +204,19 @@ def run_backward(tensor, grad_tensor=None, retain_graph=False):
     else:
         seed = grad_tensor.value if isinstance(
             grad_tensor, Tensor) else jnp.asarray(grad_tensor)
-    all_slots = _backward_pass([tensor._slot], [seed], retain_graph)
+    all_slots, _ = _backward_pass([tensor._slot], [seed], retain_graph)
     _collect_and_clear(all_slots, into_tensors=True)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
-    """paddle.grad parity (python/paddle/fluid/dygraph/base.py:grad).
+    """paddle.grad parity (python/paddle/fluid/dygraph/base.py:431-466).
 
-    create_graph (double grad) is intentionally unsupported on the eager
-    tape; use paddle_tpu.autograd functional transforms (jax.grad
-    composition) for higher-order derivatives.
+    create_graph=True runs the backward itself on the tape (each cotangent
+    is a taped slot whose node holds the VJP), so returned grads are
+    differentiable — WGAN-GP-style double grad works.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use functional autograd (autograd.vjp/jvp)")
     outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -126,9 +227,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         seeds = [g.value if g is not None else jnp.ones_like(o.value)
                  for o, g in zip(outputs, gos)]
 
-    retain = bool(retain_graph) if retain_graph is not None else False
+    retain = bool(retain_graph) if retain_graph is not None \
+        else bool(create_graph)
     in_slots = [i._slot for i in inputs]
-    all_slots = _backward_pass([o._slot for o in outputs], seeds, retain)
+    all_slots, gslots = _backward_pass([o._slot for o in outputs], seeds,
+                                       retain, create_graph=create_graph)
     results = []
     for i, s in zip(inputs, in_slots):
         if s.grad is None:
@@ -137,6 +240,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     f"an input tensor is unused in the graph "
                     "(pass allow_unused=True)")
             results.append(None)
+        elif create_graph:
+            g = Tensor(gslots[id(s)])
+            g.stop_gradient = False
+            results.append(g)
         else:
             results.append(Tensor(s.grad))
     _collect_and_clear(all_slots, into_tensors=False)

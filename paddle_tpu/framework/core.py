@@ -107,6 +107,25 @@ class _Node:
         self.multi = multi
 
 
+class TensorHookRemoveHelper:
+    """Handle returned by Tensor.register_hook (parity with the reference's
+    TensorHookRemoveHelper, varbase_patch_methods.py)."""
+
+    def __init__(self, tensor, hook):
+        self._tensor = weakref.ref(tensor)
+        self._hook = hook
+
+    def remove(self):
+        t = self._tensor()
+        if t is None:
+            return False
+        hooks = getattr(t, "_grad_hooks", [])
+        if self._hook in hooks:
+            hooks.remove(self._hook)
+            return True
+        return False
+
+
 def _is_diff_dtype(arr):
     return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(
         arr.dtype, jnp.complexfloating)
@@ -246,10 +265,18 @@ class Tensor:
         return None if self.grad is None else self.grad.numpy()
 
     def register_hook(self, hook):
+        """Register a gradient hook, invoked by the backward engine when
+        this tensor's gradient is finalized; a non-None return replaces the
+        gradient flowing upstream (parity:
+        python/paddle/fluid/dygraph/varbase_patch_methods.py:register_hook).
+        Returns a removable handle."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "register_hook on a tensor with stop_gradient=True")
         if not hasattr(self, "_grad_hooks"):
             self._grad_hooks = []
         self._grad_hooks.append(hook)
-        return hook
+        return TensorHookRemoveHelper(self, hook)
 
     # -- mutation (functional under the hood) ---------------------------
     def set_value(self, value):

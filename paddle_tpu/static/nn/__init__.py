@@ -402,14 +402,64 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 
 
 # ----------------------------------------------------- control flow / misc
+# Traced-predicate dispatch: when the predicate (or a loop var) is a jax
+# Tracer — i.e. we are inside jit / to_static — these lower to
+# lax.cond/lax.switch/lax.while_loop so the control flow compiles into the
+# XLA program (reference converts Python control flow the same way:
+# fluid/dygraph/dygraph_to_static/convert_operators.py:26,191). With
+# concrete values they stay plain Python (eager parity).
+
+def _cf_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _cf_arr(tree):
+    """Tensor -> jnp array through nested lists/tuples/dicts."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda t: t.value if isinstance(t, Tensor) else t, tree,
+        is_leaf=_cf_leaf)
+
+
+def _cf_ten(tree):
+    """array -> Tensor through nested lists/tuples/dicts."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if hasattr(a, "dtype") else a, tree)
+
+
+def _cf_traced(x):
+    import jax
+    v = x.value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
+
+
+def _cf_pred(p):
+    v = p.value if isinstance(p, Tensor) else jnp.asarray(p)
+    return jnp.reshape(v, ()).astype(bool)
+
 
 def cond(pred, true_fn=None, false_fn=None, name=None):
+    if _cf_traced(pred):
+        import jax
+        tf = (lambda _: _cf_arr(true_fn())) if true_fn else (lambda _: None)
+        ff = (lambda _: _cf_arr(false_fn())) if false_fn else (lambda _: None)
+        return _cf_ten(jax.lax.cond(_cf_pred(pred), tf, ff, None))
     if bool(pred.item() if isinstance(pred, Tensor) else pred):
         return true_fn() if true_fn else None
     return false_fn() if false_fn else None
 
 
 def case(pred_fn_pairs, default=None, name=None):
+    if any(_cf_traced(p) for p, _ in pred_fn_pairs):
+        import jax
+        preds = jnp.stack([_cf_pred(p) for p, _ in pred_fn_pairs])
+        first = jnp.argmax(preds)  # index of first True
+        branch = jnp.where(jnp.any(preds), first, len(pred_fn_pairs))
+        fns = [fn for _, fn in pred_fn_pairs]
+        fns.append(default if default is not None else pred_fn_pairs[-1][1])
+        return _cf_ten(jax.lax.switch(
+            branch, [lambda _, f=f: _cf_arr(f()) for f in fns], None))
     for pred, fn in pred_fn_pairs:
         if bool(pred.item() if isinstance(pred, Tensor) else pred):
             return fn()
@@ -419,10 +469,22 @@ def case(pred_fn_pairs, default=None, name=None):
 
 
 def switch_case(branch_index, branch_fns, default=None, name=None):
-    idx = int(branch_index.item() if isinstance(branch_index, Tensor)
-              else branch_index)
     table = dict(branch_fns) if not isinstance(branch_fns, dict) \
         else branch_fns
+    if _cf_traced(branch_index):
+        import jax
+        keys = sorted(table)
+        karr = jnp.asarray(keys)
+        idx = jnp.reshape(branch_index.value if isinstance(
+            branch_index, Tensor) else branch_index, ()).astype(karr.dtype)
+        hit = karr == idx
+        branch = jnp.where(jnp.any(hit), jnp.argmax(hit), len(keys))
+        fns = [table[k] for k in keys]
+        fns.append(default if default is not None else table[max(table)])
+        return _cf_ten(jax.lax.switch(
+            branch, [lambda _, f=f: _cf_arr(f()) for f in fns], None))
+    idx = int(branch_index.item() if isinstance(branch_index, Tensor)
+              else branch_index)
     if idx in table:
         return table[idx]()
     if default is not None:
@@ -431,13 +493,25 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    import jax
     vals = list(loop_vars)
-    while True:
-        c = cond(*vals)
-        if not bool(c.item() if isinstance(c, Tensor) else c):
-            break
+    leaves = jax.tree_util.tree_leaves(vals, is_leaf=_cf_leaf)
+    first = cond(*vals)  # evaluated once; reused by the eager path below
+    if any(_cf_traced(v) for v in leaves) or _cf_traced(first):
+        def c(carry):
+            return _cf_pred(cond(*_cf_ten(carry)))
+
+        def b(carry):
+            out = body(*_cf_ten(carry))
+            out = list(out) if isinstance(out, (list, tuple)) else [out]
+            return _cf_arr(out)
+
+        return _cf_ten(jax.lax.while_loop(c, b, _cf_arr(vals)))
+    c = first
+    while bool(c.item() if isinstance(c, Tensor) else c):
         out = body(*vals)
         vals = list(out) if isinstance(out, (list, tuple)) else [out]
+        c = cond(*vals)
     return vals
 
 

@@ -136,3 +136,76 @@ class TestFunctional:
         x = leaf([1.0, 2.0])
         H = hessian(lambda a: (a ** 3).sum(), x)
         np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))
+
+
+class TestGradHooks:
+    """Tensor.register_hook must actually fire during backward and a
+    non-None return must replace the upstream gradient (ref
+    varbase_patch_methods.py:330)."""
+
+    def test_leaf_hook_observes_grad(self):
+        x = leaf([1.0, 2.0])
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0, 3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_hook_replaces_grad_upstream(self):
+        x = leaf([1.0, 2.0])
+        y = x * 2
+        y.register_hook(lambda g: g * 10)
+        y.sum().backward()
+        # d(sum)/dy = 1 -> hook makes it 10 -> dx = 20
+        np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+
+    def test_hook_remove(self):
+        x = leaf([1.0])
+        calls = []
+        h = x.register_hook(lambda g: calls.append(1))
+        (x * 2).backward()
+        assert h.remove() is True
+        (x * 2).backward()
+        assert len(calls) == 1
+
+    def test_hook_on_stop_gradient_raises(self):
+        t = paddle.to_tensor([1.0])  # stop_gradient=True
+        with pytest.raises(RuntimeError):
+            t.register_hook(lambda g: g)
+
+
+class TestDoubleGrad:
+    def test_create_graph_second_order(self):
+        # y = x^3 -> dy/dx = 3x^2 -> d2y/dx2 = 6x
+        x = leaf([2.0])
+        y = (x * x * x).sum()
+        (gx,) = paddle.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        (ggx,) = paddle.grad(gx.sum(), [x])
+        np.testing.assert_allclose(ggx.numpy(), [12.0])
+
+    def test_create_graph_mixed_expression(self):
+        # loss = sum(grad^2) where grad = dy/dx, y = sum(x^2) -> grad=2x,
+        # loss = 4 x^2 -> dloss/dx = 8x
+        x = leaf([1.0, 3.0])
+        y = (x * x).sum()
+        (gx,) = paddle.grad(y, [x], create_graph=True)
+        loss = (gx * gx).sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0, 24.0])
+
+    def test_wgan_gp_style_penalty(self):
+        """Gradient penalty: grads of an interpolation point flow back
+        into discriminator weights (the WGAN-GP training pattern)."""
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        disc = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        x = leaf(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        out = disc(x).sum()
+        (gx,) = paddle.grad(out, [x], create_graph=True)
+        gp = ((gx.square().sum(axis=1).sqrt() - 1.0) ** 2).mean()
+        gp.backward()
+        w = disc[0].weight
+        assert w.grad is not None
+        assert float(np.abs(w.grad.numpy()).sum()) > 0

@@ -1,0 +1,135 @@
+"""Traceable control flow: static.nn.cond/while_loop/case/switch_case must
+lower to lax.cond/lax.while_loop/lax.switch when the predicate is traced
+(reference converts Python control flow for static graph:
+fluid/dygraph/dygraph_to_static/convert_operators.py:26,191)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static.nn as snn
+from paddle_tpu.jit import to_static
+
+
+def test_cond_eager():
+    x = paddle.to_tensor([2.0])
+    out = snn.cond(x.sum() > 1.0, lambda: x * 2, lambda: x / 2)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+
+
+def test_cond_traced():
+    import jax
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        out = snn.cond(t.sum() > 1.0,
+                       lambda: t * 2,
+                       lambda: t / 2)
+        return out.value
+
+    jf = jax.jit(f)
+    np.testing.assert_allclose(jf(np.array([2.0], np.float32)), [4.0])
+    np.testing.assert_allclose(jf(np.array([0.25], np.float32)), [0.125])
+
+
+def test_cond_traced_tuple_output():
+    import jax
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        a, b = snn.cond(t.sum() > 0,
+                        lambda: (t + 1, t - 1),
+                        lambda: (t * 0, t * 0))
+        return a.value, b.value
+
+    a, b = jax.jit(f)(np.array([3.0], np.float32))
+    np.testing.assert_allclose(a, [4.0])
+    np.testing.assert_allclose(b, [2.0])
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(0)
+    out = snn.while_loop(lambda i: i < 5, lambda i: i + 1, [i])
+    assert int(out[0].item()) == 5
+
+
+def test_while_loop_traced():
+    import jax
+
+    def f(n):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        nt = paddle.to_tensor(n)
+        i, s = snn.while_loop(lambda i, s: i < nt,
+                              lambda i, s: (i + 1, s + 2.0),
+                              [i, s])
+        return s.value
+
+    jf = jax.jit(f)
+    assert float(jf(np.int32(5))) == 10.0
+    assert float(jf(np.int32(3))) == 6.0  # same compiled program
+
+
+def test_while_loop_traced_value_composes():
+    """jitted while_loop composes with surrounding traced math (value
+    path only: lax.while_loop is not reverse-differentiable)."""
+    import jax
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        i = paddle.to_tensor(np.int32(0))
+        i, t = snn.while_loop(lambda i, t: i < 3,
+                              lambda i, t: (i + 1, t * 2.0),
+                              [i, t])
+        return t.value.sum()
+
+    out = jax.jit(f)(np.array([1.0, 2.0], np.float32))
+    assert float(out) == 24.0
+
+
+def test_case_traced():
+    import jax
+
+    def f(x):
+        t = paddle.to_tensor(x)
+        out = snn.case([(t.sum() < 0, lambda: t * 10),
+                        (t.sum() < 10, lambda: t + 100)],
+                       default=lambda: t)
+        return out.value
+
+    jf = jax.jit(f)
+    np.testing.assert_allclose(jf(np.array([1.0], np.float32)), [101.0])
+    np.testing.assert_allclose(jf(np.array([-2.0], np.float32)), [-20.0])
+    np.testing.assert_allclose(jf(np.array([50.0], np.float32)), [50.0])
+
+
+def test_switch_case_traced():
+    import jax
+
+    def f(idx, x):
+        t = paddle.to_tensor(x)
+        i = paddle.to_tensor(idx)
+        out = snn.switch_case(i, {1: lambda: t + 1, 3: lambda: t + 3},
+                              default=lambda: t * 0)
+        return out.value
+
+    jf = jax.jit(f)
+    np.testing.assert_allclose(jf(np.int32(1), np.float32(10)), 11.0)
+    np.testing.assert_allclose(jf(np.int32(3), np.float32(10)), 13.0)
+    np.testing.assert_allclose(jf(np.int32(7), np.float32(10)), 0.0)
+
+
+def test_to_static_routes_control_flow():
+    """A to_static function with data-dependent control flow compiles once
+    and follows the right branch for different values."""
+    calls = {"n": 0}
+
+    @to_static
+    def f(x):
+        calls["n"] += 1
+        return snn.cond(x.sum() > 0, lambda: x * 2, lambda: x * -1)
+
+    a = f(paddle.to_tensor([3.0]))
+    b = f(paddle.to_tensor([-4.0]))
+    np.testing.assert_allclose(a.numpy(), [6.0])
+    np.testing.assert_allclose(b.numpy(), [4.0])
+    assert calls["n"] == 1, "same shapes must not retrace"
