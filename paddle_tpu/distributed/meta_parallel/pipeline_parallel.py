@@ -27,9 +27,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ...framework.core import Tensor
-from ...jit.api import functional_call, state_arrays
+from ...jit.api import functional_call, state_arrays, _bind, _restore
 
-__all__ = ["PipelineParallel", "pipeline_apply"]
+__all__ = ["PipelineParallel", "pipeline_apply", "pipeline_1f1b"]
 
 
 def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, n_stages,
@@ -77,23 +77,215 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, n_stages,
         check_vma=False)(stacked_params, x_micro)
 
 
+def pipeline_1f1b(stage_fn, stacked_params, edge_params, pre_fn, post_fn,
+                  loss_arr, x_micro, y_micro, mesh, n_stages, n_micro):
+    """1F1B schedule with a hand-written, recompute-based backward.
+
+    Parity: the 1f1b schedule in the reference's
+    fleet/meta_parallel/pipeline_parallel.py:81,170 — but formulated SPMD:
+    one fori_loop of combined fwd+bwd "cycles"; each stage keeps only a
+    ring buffer of min(n_micro, 2*n_stages-1) saved stage INPUTS and
+    recomputes the stage forward inside jax.vjp at backward time. Peak
+    activation memory is therefore bounded by the pipeline depth, not by
+    n_micro (GPipe-via-AD saves every tick's residuals).
+
+    Schedule algebra (stage s of S, cycle c):
+      forward  micro  fm = c - s            (valid while 0 <= fm < n_micro)
+      backward micro  bm = c - 2(S-1) + s   (last stage: bm == fm, so it
+                                             backwards a micro in the same
+                                             cycle it forwarded it)
+    Cotangents ride the reverse ppermute ring; a micro's backward at stage
+    s+1 lands exactly one cycle before stage s needs it.
+
+    pre_fn/post_fn(edge_params, x) run at the pipeline edges (stage 0 /
+    last stage) inside the loop — SharedLayerDesc tied weights live in
+    `edge_params` once, so d(pre)+d(post) accumulate into one leaf.
+    Returns (loss, trunk_grads [pp-sharded], edge_grads [replicated]).
+    """
+    S, M = n_stages, n_micro
+    R = min(M, 2 * S - 1)
+
+    def spmd(params_local, edge_p, xs, ys):
+        params_here = jax.tree.map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index("pp")
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+        C = M + 2 * (S - 1)
+
+        # probe shapes (abstract eval only — no FLOPs at runtime)
+        x0 = pre_fn(edge_p, xs[0])
+        mb_shape, mb_dtype = x0.shape, x0.dtype
+
+        ring = jnp.zeros((R,) + mb_shape, mb_dtype)
+        fwd_recv = jnp.zeros(mb_shape, mb_dtype)
+        bwd_recv = jnp.zeros(mb_shape, mb_dtype)
+        grads0 = jax.tree.map(jnp.zeros_like, params_here)
+        egrads0 = jax.tree.map(jnp.zeros_like, edge_p)
+        loss0 = jnp.zeros((), jnp.float32)
+
+        def cycle(c, state):
+            ring, fwd_recv, bwd_recv, grads, egrads, loss_acc = state
+
+            # ---------- forward slot ----------
+            fm = c - stage
+            fwd_valid = (fm >= 0) & (fm < M)
+            fm_c = jnp.clip(fm, 0, M - 1)
+            inp = jnp.where(stage == 0, pre_fn(edge_p, xs[fm_c]), fwd_recv)
+            out = stage_fn(params_here, inp)
+            slot = fm_c % R
+            old = jax.lax.dynamic_index_in_dim(ring, slot, 0,
+                                               keepdims=False)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, jnp.where(fwd_valid, inp, old), slot, 0)
+
+            # last stage: per-micro loss + seed cotangent, same cycle
+            def head_loss(ep, o):
+                return loss_arr(post_fn(ep, o), ys[fm_c])
+
+            l_m, head_vjp = jax.vjp(head_loss, edge_p, out)
+            dep_head, seed = head_vjp(jnp.float32(1.0 / M))
+            last = stage == S - 1
+            loss_acc = loss_acc + jnp.where(
+                fwd_valid & last, l_m.astype(jnp.float32) / M, 0.0)
+
+            # ---------- backward slot ----------
+            bm = c - 2 * (S - 1) + stage
+            bwd_valid = (bm >= 0) & (bm < M)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(ring, bm_c % R, 0,
+                                                   keepdims=False)
+            cot_in = jnp.where(last, seed, bwd_recv)
+            _, stage_vjp = jax.vjp(stage_fn, params_here, x_saved)
+            dp, dx = stage_vjp(cot_in)
+
+            bmask = bwd_valid.astype(jnp.float32)
+            grads = jax.tree.map(
+                lambda g, d: g + d.astype(g.dtype) * bmask.astype(g.dtype),
+                grads, dp)
+            # edge grads: head side lands on the last stage at fwd time;
+            # pre side chains dx through pre_fn on stage 0 at bwd time
+            def pre_chain(ep):
+                return pre_fn(ep, xs[bm_c])
+
+            _, pre_vjp = jax.vjp(pre_chain, edge_p)
+            (dep_pre,) = pre_vjp(dx)
+            hmask = (fwd_valid & last).astype(jnp.float32)
+            pmask = (bwd_valid & (stage == 0)).astype(jnp.float32)
+            egrads = jax.tree.map(
+                lambda g, dh, dpr: g + dh.astype(g.dtype) *
+                hmask.astype(g.dtype) + dpr.astype(g.dtype) *
+                pmask.astype(g.dtype),
+                egrads, dep_head, dep_pre)
+
+            fwd_recv = jax.lax.ppermute(out, "pp", fwd_perm)
+            bwd_recv = jax.lax.ppermute(dx, "pp", bwd_perm)
+            return ring, fwd_recv, bwd_recv, grads, egrads, loss_acc
+
+        state = (ring, fwd_recv, bwd_recv, grads0, egrads0, loss0)
+        *_, grads, egrads, loss_acc = jax.lax.fori_loop(0, C, cycle, state)
+        loss = jax.lax.psum(loss_acc, "pp")  # only last stage contributed
+        egrads = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), egrads)
+        grads = jax.tree.map(lambda g: g[None], grads)
+        return loss, grads, egrads
+
+    pp_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    rep_specs = jax.tree.map(lambda _: P(), edge_params)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(pp_specs, rep_specs, P(), P()),
+        out_specs=(P(), pp_specs, rep_specs),
+        check_vma=False)(stacked_params, edge_params, x_micro, y_micro)
+
+
 class PipelineParallel:
     """Engine over a PipelineLayer: builds the stacked-stage params and a
-    jitted train step. Used by fleet and by tests/dryrun."""
+    jitted train step. Used by fleet and by tests/dryrun.
+
+    schedule: "gpipe" (AD through the fill/steady/drain loop) or "1f1b"
+    (hand-written interleaved backward, depth-bounded activation memory —
+    ref fleet/meta_parallel/pipeline_parallel.py:81,170).
+
+    SharedLayerDesc entries at the head/tail of the stack (tied
+    embedding/LM-head) are lifted out of the pipelined trunk into
+    replicated `edge` params applied at stage 0 / last stage; because the
+    tied weight is ONE leaf used by both, its gradient is the sum of both
+    uses (ref parallel_layers/pp_layers.py:49)."""
 
     def __init__(self, pipeline_layer, optimizer, mesh, n_micro=2,
-                 loss_fn=None):
+                 loss_fn=None, schedule="gpipe"):
         self.layer = pipeline_layer
         self.optimizer = optimizer
         self.mesh = mesh
         self.n_micro = n_micro
         self.n_stages = pipeline_layer.num_stages
         self.loss_fn = loss_fn or pipeline_layer._loss_fn
+        self.schedule = schedule.lower().replace("-", "")
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self._step_i = 0
 
-        # build stacked per-stage params; stages must be uniform
+        # ---- split the stack: [pre edge][uniform trunk][post edge] -----
+        shared_ids = {id(l) for l in pipeline_layer._shared.values()}
+        items = list(pipeline_layer.run_function)
+        pre_items, post_items = [], []
+        while items and id(items[0][0]) in shared_ids:
+            pre_items.append(items.pop(0))
+        while items and id(items[-1][0]) in shared_ids:
+            post_items.append(items.pop())
+        post_items.reverse()
+        if len(items) % self.n_stages != 0:
+            raise ValueError(
+                f"trunk of {len(items)} layers does not divide into "
+                f"{self.n_stages} uniform stages")
+        per = len(items) // self.n_stages
+        segments = [items[i * per:(i + 1) * per]
+                    for i in range(self.n_stages)]
+        self._segments = segments
+
+        # ---- edge (replicated, possibly tied) params -------------------
+        key_of = {id(l): name for name, l in pipeline_layer._shared.items()}
+        edge = {}
+
+        def _with_prefix(edge_items, base):
+            out = []
+            for j, (l, tag) in enumerate(edge_items):
+                pref = key_of.get(id(l), f"{base}{j}") \
+                    if hasattr(l, "named_parameters") else None
+                out.append((l, tag, pref))
+                if pref is not None:
+                    for name, p in l.named_parameters():
+                        edge[f"{pref}.{name}"] = p.value  # tied: one key
+            return out
+
+        pre_triples = _with_prefix(pre_items, "pre")
+        post_triples = _with_prefix(post_items, "post")
+        self.edge = edge
+
+        def _edge_fn(triples):
+            def fn(edge_p, x):
+                xt = Tensor(x) if not isinstance(x, Tensor) else x
+                for l, tag, pref in triples:
+                    if pref is not None:
+                        sub = {k[len(pref) + 1:]: v
+                               for k, v in edge_p.items()
+                               if k.startswith(pref + ".")}
+                        saved = _bind(l, sub)
+                        try:
+                            xt = tag(l, xt) if callable(tag) and \
+                                tag != "fn" else l(xt)
+                        finally:
+                            _restore(saved)
+                    else:
+                        xt = l(xt)
+                return xt.value if isinstance(xt, Tensor) else xt
+            return fn
+
+        self._pre_fn = _edge_fn(pre_triples)
+        self._post_fn = _edge_fn(post_triples)
+
+        # ---- stacked per-stage trunk params; stages must be uniform ----
         seg_params = []
-        for seg in pipeline_layer.segments:
+        for seg in segments:
             stage_arrays = {}
             for idx, (layer, tag) in enumerate(seg):
                 if tag == "fn" or not hasattr(layer, "named_parameters"):
@@ -113,13 +305,19 @@ class PipelineParallel:
                     for k in self.stacked}
         self.stacked = {k: jax.device_put(v, pp_shard[k])
                         for k, v in self.stacked.items()}
+        rep = NamedSharding(mesh, P())
+        self.edge = {k: jax.device_put(v, rep)
+                     for k, v in self.edge.items()}
         self.opt_state = {
             k: tuple(jax.device_put(s, pp_shard[k])
                      for s in optimizer._init_state(v))
             for k, v in self.stacked.items()}
+        self.edge_opt_state = {
+            k: tuple(jax.device_put(s, rep)
+                     for s in optimizer._init_state(v))
+            for k, v in self.edge.items()}
 
-        seg0 = pipeline_layer.segments[0]
-        layers0 = [l for l, tag in seg0 if hasattr(l, "named_parameters")]
+        seg0 = segments[0]
 
         def stage_fn(params_here, x):
             out = x
@@ -142,30 +340,60 @@ class PipelineParallel:
         n_micro_ = n_micro
         opt = optimizer
         lfn = self.loss_fn
+        pre_fn, post_fn = self._pre_fn, self._post_fn
 
-        def train_step(stacked, opt_state, lr, step_i, x, y):
-            xm = jnp.stack(jnp.split(x, n_micro_, axis=0))
+        def loss_arr(out, y):
+            l = lfn(Tensor(out), Tensor(y))
+            return l.value if isinstance(l, Tensor) else l
 
-            def loss_of(ps):
-                outs = pipeline_apply(stage_fn, ps, xm, mesh_, n_stages,
-                                      n_micro_)
-                flat = outs.reshape((-1,) + outs.shape[2:])
-                l = lfn(Tensor(flat), Tensor(y))
-                return l.value if isinstance(l, Tensor) else l
+        if self.schedule == "1f1b":
+            def train_step(stacked, edge, opt_state, edge_state, lr,
+                           step_i, x, y):
+                xm = jnp.stack(jnp.split(x, n_micro_, axis=0))
+                ym = jnp.stack(jnp.split(y, n_micro_, axis=0))
+                loss, grads, egrads = pipeline_1f1b(
+                    stage_fn, stacked, edge, pre_fn, post_fn, loss_arr,
+                    xm, ym, mesh_, n_stages, n_micro_)
+                new_p, new_s = opt.apply_gradients_tree(
+                    stacked, grads, opt_state, lr, step_i)
+                if edge:
+                    new_e, new_es = opt.apply_gradients_tree(
+                        edge, egrads, edge_state, lr, step_i)
+                else:
+                    new_e, new_es = edge, edge_state
+                return loss, new_p, new_e, new_s, new_es
+        else:
+            def train_step(stacked, edge, opt_state, edge_state, lr,
+                           step_i, x, y):
+                def loss_of(ps, ep):
+                    xa = jax.vmap(lambda xi: pre_fn(ep, xi))(
+                        jnp.stack(jnp.split(x, n_micro_, axis=0)))
+                    outs = pipeline_apply(stage_fn, ps, xa, mesh_,
+                                          n_stages, n_micro_)
+                    flat = outs.reshape((-1,) + outs.shape[2:])
+                    return loss_arr(post_fn(ep, flat), y)
 
-            loss, grads = jax.value_and_grad(loss_of)(stacked)
-            new_p, new_s = opt.apply_gradients_tree(stacked, grads,
-                                                    opt_state, lr, step_i)
-            return loss, new_p, new_s
+                loss, (grads, egrads) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1))(stacked, edge)
+                new_p, new_s = opt.apply_gradients_tree(
+                    stacked, grads, opt_state, lr, step_i)
+                if edge:
+                    new_e, new_es = opt.apply_gradients_tree(
+                        edge, egrads, edge_state, lr, step_i)
+                else:
+                    new_e, new_es = edge, edge_state
+                return loss, new_p, new_e, new_s, new_es
 
-        self._jitted = jax.jit(train_step, donate_argnums=(0, 1))
+        self._train_step_fn = train_step
+        self._jitted = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
 
     def train_batch(self, x, y):
         self._step_i += 1
         xa = x.value if isinstance(x, Tensor) else jnp.asarray(x)
         ya = y.value if isinstance(y, Tensor) else jnp.asarray(y)
-        loss, self.stacked, self.opt_state = self._jitted(
-            self.stacked, self.opt_state,
+        (loss, self.stacked, self.edge, self.opt_state,
+         self.edge_opt_state) = self._jitted(
+            self.stacked, self.edge, self.opt_state, self.edge_opt_state,
             jnp.asarray(self.optimizer.get_lr(), jnp.float32),
             self._step_i, xa, ya)
         return Tensor(loss)
@@ -173,6 +401,8 @@ class PipelineParallel:
     def forward(self, x):
         xa = x.value if isinstance(x, Tensor) else jnp.asarray(x)
         xm = jnp.stack(jnp.split(xa, self.n_micro, axis=0))
+        xm = jax.vmap(lambda xi: self._pre_fn(self.edge, xi))(xm)
         outs = pipeline_apply(self._stage_fn, self.stacked, xm, self.mesh,
                               self.n_stages, self.n_micro)
-        return Tensor(outs.reshape((-1,) + outs.shape[2:]))
+        flat = outs.reshape((-1,) + outs.shape[2:])
+        return Tensor(self._post_fn(self.edge, flat))

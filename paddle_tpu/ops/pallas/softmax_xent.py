@@ -69,10 +69,11 @@ def _bwd_kernel(x_ref, lab_ref, lse_ref, dloss_ref, dx_ref, *, block_v):
 
 
 def _choose_block(n, cap, align):
-    """Largest divisor of n that is <= cap and a multiple of `align`
-    (or n itself when n <= cap)."""
+    """Largest divisor of n that is <= cap and a multiple of `align`.
+    Returns 0 (unsupported) when no aligned divisor exists — unaligned
+    blocks violate the TPU (8, 128) tiling rule and fail Mosaic lowering."""
     if n <= cap:
-        return n
+        return n if n % align == 0 else 0
     best = 0
     b = align
     while b <= cap:
