@@ -91,12 +91,25 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 def build_train_step(model, loss_fn, optimizer, recompute=None,
-                     accumulate_steps=None, param_dtype=None):
-    """Assemble the hybrid-parallel jitted train step from fleet state."""
+                     accumulate_steps=None, param_dtype=None,
+                     sharding_stage=None):
+    """Assemble the hybrid-parallel jitted train step from fleet state.
+
+    sharding_stage resolution order: explicit arg > ShardingStage2/3
+    wrapper markers on the model/optimizer > strategy.sharding_configs
+    ["stage"] > 1."""
     strat = _state["strategy"] or DistributedStrategy()
     hcg = get_hybrid_communicate_group()
+    if sharding_stage is None:
+        sharding_stage = getattr(model, "_sharding_stage", None) \
+            or getattr(optimizer, "_sharding_stage", None) \
+            or (strat.sharding_configs.get("stage", 1)
+                if strat.sharding else 1)
     if isinstance(model, _DistributedModel):
         model = model.wrapped
+    # unwrap ShardingStage2/3 shells down to the real layer/optimizer
+    model = getattr(model, "_layer", model)
+    optimizer = getattr(optimizer, "_optim", optimizer)
     if recompute is None:
         recompute = strat.recompute
     if accumulate_steps is None:
@@ -107,7 +120,8 @@ def build_train_step(model, loss_fn, optimizer, recompute=None,
     return HybridTrainStep(model, loss_fn, optimizer, hcg.mesh,
                            recompute=recompute,
                            accumulate_steps=accumulate_steps,
-                           param_dtype=param_dtype)
+                           param_dtype=param_dtype,
+                           sharding_stage=sharding_stage)
 
 
 def worker_index():

@@ -83,12 +83,28 @@ class HybridTrainStep:
     """Build once, call per batch. See module docstring."""
 
     def __init__(self, model, loss_fn, optimizer, mesh, recompute=False,
-                 accumulate_steps=1, donate=True, param_dtype=None):
+                 accumulate_steps=1, donate=True, param_dtype=None,
+                 sharding_stage=1):
+        """sharding_stage selects the ZeRO behavior over the 'sharding'
+        mesh axis (ref sharding/sharding_stage2.py:43, sharding_stage3.py:51):
+          1 — optimizer state sharded (grads allreduced, params replicated)
+          2 — + gradients pinned to the zero specs: the update runs on
+              grad shards and the grad sync lowers to all-reduce+slice,
+              which the TPU ReduceScatterCreator pass fuses into a true
+              reduce-scatter (half the sync bytes); updated params
+              all-gather back to their param specs
+          3 — + parameters THEMSELVES stored sharded; XLA all-gathers
+              weights at use sites and frees them after use
+        """
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
         self.accumulate_steps = accumulate_steps
+        self.sharding_stage = int(sharding_stage)
+        if self.sharding_stage not in (1, 2, 3):
+            raise ValueError(f"sharding_stage must be 1|2|3, got "
+                             f"{sharding_stage}")
         self._step_i = 0
 
         params, buffers = state_arrays(model)
@@ -98,8 +114,16 @@ class HybridTrainStep:
             params = {k: v.astype(dt) if jnp.issubdtype(
                 v.dtype, jnp.floating) else v for k, v in params.items()}
         self.param_specs = _collect_specs(model, params)
+        self.zero_specs = {
+            k: _zero_spec(self.param_specs[k], mesh, v)
+            for k, v in params.items()}
+        # stage 3: parameters live sharded over 'sharding'; XLA
+        # all-gathers them at use sites (ZeRO-3 param partitioning)
+        store_specs = self.zero_specs if self.sharding_stage >= 3 \
+            else self.param_specs
         self.param_shardings = {
-            k: NamedSharding(mesh, s) for k, s in self.param_specs.items()}
+            k: NamedSharding(mesh, store_specs[k])
+            for k in self.param_specs}
         self.params = {
             k: jax.device_put(v, self.param_shardings[k])
             for k, v in params.items()}
@@ -119,6 +143,9 @@ class HybridTrainStep:
 
         model_ref = model
         opt = optimizer
+        stage = self.sharding_stage
+        zero_shardings = {k: NamedSharding(mesh, s)
+                          for k, s in self.zero_specs.items()}
 
         def loss_of(ps, bufs, key, micro):
             def run(inputs):
@@ -153,6 +180,15 @@ class HybridTrainStep:
             else:
                 loss, grads = jax.value_and_grad(
                     lambda ps: loss_of(ps, bufs, key, batch))(params_)
+
+            if stage >= 2:
+                # ZeRO-2: pin gradients to the zero specs — the SPMD
+                # partitioner then lowers dp grad sync as reduce-scatter
+                # (each rank keeps only its grad shard) instead of
+                # all-reduce, and the optimizer update below runs on
+                # shards (ref sharding_stage2.py:43)
+                grads = jax.lax.with_sharding_constraint(grads,
+                                                         zero_shardings)
 
             clip = opt._grad_clip
             if clip is not None:

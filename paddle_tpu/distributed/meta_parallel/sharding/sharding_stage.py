@@ -8,9 +8,12 @@ broadcast/allgather. TPU-native: the state/param pytrees simply carry a
 NamedSharding with the 'sharding' mesh axis; XLA's SPMD partitioner emits
 the reduce-scatter for gradient averaging and the all-gather before use —
 the exact ZeRO communication schedule — without bespoke runtime classes.
-These wrappers exist for API parity and to stamp the shardings onto an
-optimizer/layer used with fleet's HybridTrainStep (which already applies
-`_zero_spec` placement when sharding_degree > 1).
+These wrappers select real behavior: the `_sharding_stage` marker they set
+is consumed by fleet.build_train_step, which passes it to
+HybridTrainStep(sharding_stage=...) — stage 2 pins gradients to the
+'sharding' axis (update on grad shards; sync lowers to reduce-scatter on
+TPU), stage 3 stores the parameters themselves sharded (all-gather at use
+sites). See tests/test_distributed.py::TestZeROStages.
 """
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -227,3 +227,80 @@ class TestCollectivesAPI:
         assert len(lst) == 1
         dist.broadcast(t, 0)
         assert dist.get_world_size() == 8
+
+
+class TestZeROStages:
+    """Real ZeRO stage-2/3 behavior (ref sharding_stage2.py:43,
+    sharding_stage3.py:51): stage selection changes the compiled program
+    (reduce-scatter / sharded param storage) without changing numerics."""
+
+    def _build(self, stage, lr=0.01):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 4
+        strategy.hybrid_configs["sharding_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.AdamW(learning_rate=lr, parameters=m.parameters())
+        return fleet.build_train_step(m, make_loss_fn(), o,
+                                      sharding_stage=stage)
+
+    def test_stage2_grads_constrained_sharded(self):
+        """Stage-2 pins gradients to the 'sharding' axis: the lowered
+        program must carry the sharding constraints (28 grad leaves), and
+        the compiled update must run on grad SHARDS (sliced shapes), with
+        the grad sync lowered as all-reduce+slice — the pair the TPU
+        ReduceScatterCreator pass fuses into reduce-scatter (the CPU
+        pipeline keeps them separate, so we assert the pattern, not the
+        fused op name)."""
+        import jax.numpy as jnp
+        from paddle_tpu.framework.random import split_key
+        step = self._build(2)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        arrays = [ids.value, ids.value]
+        lowered = step._jitted.lower(
+            step.params, step.opt_state, step.buffers, split_key(),
+            jnp.asarray(0.1, jnp.float32), 1, *arrays)
+        assert lowered.as_text().count("sharding_constraint") >= 20
+        hlo = lowered.compile().as_text()
+        # qkv grad [64,192] over sharding=2 -> update math sees [32,192]
+        assert "f32[32,192]" in hlo, "update does not run on grad shards"
+        assert ("reduce-scatter" in hlo) or ("all-reduce" in hlo)
+
+    def test_stage3_params_stored_sharded(self):
+        step = self._build(3)
+        pk = "gpt.h.0.attn.qkv_proj.weight"
+        assert "sharding" in str(step.params[pk].sharding.spec)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        hlo = step.compiled_text(ids, ids)
+        assert "all-gather" in hlo, "stage-3 must all-gather params at use"
+
+    def test_stages_numerics_match(self):
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        losses = {}
+        for stage in (1, 2, 3):
+            step = self._build(stage)
+            losses[stage] = [step(ids, ids).item() for _ in range(3)]
+        np.testing.assert_allclose(losses[1], losses[2], rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(losses[1], losses[3], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_wrappers_select_behavior(self):
+        """ShardingStage3(layer) marker must flow into the train step."""
+        from paddle_tpu.distributed.meta_parallel.sharding.sharding_stage \
+            import ShardingStage3
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 4
+        strategy.hybrid_configs["sharding_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = ShardingStage3(GPTForCausalLM(gpt_tiny()))
+        o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+        step = fleet.build_train_step(m, make_loss_fn(), o)
+        assert step.sharding_stage == 3
+        pk = "gpt.h.0.attn.qkv_proj.weight"
+        assert "sharding" in str(step.params[pk].sharding.spec)
