@@ -99,6 +99,28 @@ def build_train_step(model, loss_fn, optimizer, recompute=None,
     wrapper markers on the model/optimizer > strategy.sharding_configs
     ["stage"] > 1."""
     strat = _state["strategy"] or DistributedStrategy()
+    for flag in ("dgc", "localsgd", "asp"):
+        if getattr(strat, flag, False):
+            # refuse rather than silently ignore: a no-op strategy flag
+            # corrupts experiments (ref fleet/meta_optimizers/ has real
+            # dgc/localsgd/asp passes; they are out of scope here)
+            raise NotImplementedError(
+                f"DistributedStrategy.{flag} is not implemented in "
+                f"paddle_tpu; unset it or use supported strategies "
+                f"(amp/recompute/sharding/gradient_merge/lars/lamb)")
+    if strat.lars:
+        from ...optimizer import Momentum, LarsMomentum
+        if isinstance(optimizer, Momentum) and \
+                not isinstance(optimizer, LarsMomentum):
+            cfg = strat.lars_configs
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                parameters=optimizer._parameters,
+                grad_clip=optimizer._grad_clip,
+                epsilon=cfg.get("epsilon", 1e-9))
     hcg = get_hybrid_communicate_group()
     if sharding_stage is None:
         sharding_stage = getattr(model, "_sharding_stage", None) \

@@ -1,4 +1,4 @@
 """paddle.optimizer namespace. Parity: python/paddle/optimizer/__init__.py."""
 from . import lr
-from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
-                        Adagrad, Adadelta, RMSProp, Lamb)
+from .optimizer import (Optimizer, SGD, Momentum, LarsMomentum, Adam,
+                        AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb)

@@ -14,7 +14,7 @@ from ..framework.core import Tensor, Parameter, no_grad
 from ..regularizer import WeightDecayRegularizer, L2Decay
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+__all__ = ["Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW", "Adamax",
            "Adagrad", "Adadelta", "RMSProp", "Lamb"]
 
 
@@ -223,6 +223,39 @@ class Momentum(Optimizer):
         else:
             p = p - lr * vel
         return p, (vel,)
+
+
+class LarsMomentum(Momentum):
+    """LARS (layer-wise adaptive rate scaling) momentum. Parity:
+    fluid/optimizer.py LarsMomentumOptimizer / fleet meta_optimizers/
+    lars_optimizer.py. local_lr = lr * coeff * ||w|| /
+    (||g|| + lars_weight_decay * ||w|| + epsilon), per parameter."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         False, None, grad_clip, multi_precision,
+                         1.0, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _update(self, p, g, state, lr, step):
+        (vel,) = state
+        pf = p.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(pf * pf))
+        g_norm = jnp.sqrt(jnp.sum(gf * gf))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + self._eps),
+            lr)
+        vel = self._momentum * vel + local_lr * (
+            gf + self._lars_wd * pf).astype(vel.dtype)
+        return (pf - vel.astype(jnp.float32)).astype(p.dtype), (vel,)
 
 
 class Adam(Optimizer):

