@@ -12,7 +12,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ...framework.core import Tensor, apply_op
 from ..env import get_mesh
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op"]
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Planner", "plan"]
 
 
 class ProcessMesh:
@@ -76,10 +76,20 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, dist_attr=None):
 
 def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
              out_shard_specs=None, **kwargs):
+    """Parity: auto_parallel/interface.py:shard_op — constrain an op's
+    inputs and outputs to dist specs; GSPMD partitions the op body."""
     mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) \
         else (process_mesh or get_mesh())
+    pm = ProcessMesh(mesh)
 
     def wrapped(*args):
+        if in_shard_specs is not None:
+            in_specs = in_shard_specs if isinstance(in_shard_specs, list) \
+                else [in_shard_specs]
+            args = tuple(
+                shard_tensor(a, pm, s) if s is not None else a
+                for a, s in zip(args, list(in_specs)
+                                + [None] * (len(args) - len(in_specs))))
         out = op_fn(*args)
         if out_shard_specs is not None:
             specs = out_shard_specs if isinstance(out_shard_specs, list) \
@@ -87,7 +97,73 @@ def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
             outs = out if isinstance(out, (list, tuple)) else [out]
             new = []
             for o, s in zip(outs, specs):
-                new.append(shard_tensor(o, ProcessMesh(mesh), s))
+                new.append(shard_tensor(o, pm, s))
             return new if isinstance(out, (list, tuple)) else new[0]
         return out
     return wrapped
+
+
+class Planner:
+    """Sharding planner. Parity: auto_parallel/planner.py (PlanSpace +
+    MCMC search over per-op dims_mappings). TPU-native: XLA's GSPMD
+    propagation IS the search — given input/param annotations it assigns
+    a sharding to every intermediate while minimizing resharding. plan()
+    compiles the function and returns the concrete shardings XLA chose
+    for inputs and outputs (inspectable, and reusable as constraints)."""
+
+    def __init__(self, process_mesh=None):
+        mesh = process_mesh.mesh if isinstance(process_mesh, ProcessMesh) \
+            else (process_mesh or get_mesh())
+        self.mesh = mesh
+
+    def plan(self, fn, *example_args, in_specs=None):
+        arrays = [a.value if isinstance(a, Tensor) else jnp_asarray(a)
+                  for a in example_args]
+        if in_specs is not None:
+            shardings = tuple(
+                NamedSharding(self.mesh, _to_spec(s, a.ndim))
+                for s, a in zip(in_specs, arrays))
+            jitted = jax.jit(fn, in_shardings=shardings)
+        else:
+            jitted = jax.jit(fn)
+        compiled = jitted.lower(*arrays).compile()
+        return PlanResult(compiled)
+
+
+class PlanResult:
+    def __init__(self, compiled):
+        self.compiled = compiled
+
+    @property
+    def input_shardings(self):
+        return self.compiled.input_shardings
+
+    @property
+    def output_shardings(self):
+        return self.compiled.output_shardings
+
+    def cost(self):
+        """Analytical cost report from XLA (flops/bytes when available),
+        the role of the reference's cost_model.py."""
+        try:
+            ca = self.compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            return dict(ca)
+        except Exception:
+            return {}
+
+    def __call__(self, *args):
+        arrays = [a.value if isinstance(a, Tensor) else jnp_asarray(a)
+                  for a in args]
+        return self.compiled(*arrays)
+
+
+def jnp_asarray(a):
+    import jax.numpy as jnp
+    return jnp.asarray(a)
+
+
+def plan(fn, *example_args, process_mesh=None, in_specs=None):
+    return Planner(process_mesh).plan(fn, *example_args,
+                                      in_specs=in_specs)

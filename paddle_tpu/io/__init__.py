@@ -289,6 +289,19 @@ def default_collate_fn(batch):
     return batch
 
 
+def _tensorify_tree(batch):
+    """numpy tree from a worker process → Tensor leaves (parent side)."""
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, dict):
+        return {k: _tensorify_tree(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        if batch and isinstance(batch[0], (str, bytes)):
+            return list(batch)
+        return [_tensorify_tree(v) for v in batch]
+    return batch
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -302,6 +315,9 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        self._mp_pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -342,7 +358,44 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._make_batch(indices)
             return
+        if self.use_shared_memory:
+            it = self._iter_multiprocess()
+            if it is not None:
+                yield from it
+                return
         yield from self._iter_threaded()
+
+    def _iter_multiprocess(self):
+        """Subprocess workers (reference
+        fluid/dataloader/dataloader_iter.py:326): CPU-bound transforms
+        scale past the GIL. Returns None when the dataset/collate_fn
+        can't be pickled — caller falls back to the threaded path."""
+        from .mp_loader import MultiprocessPool
+        pool = self._mp_pool
+        if pool is None or not pool._alive:
+            try:
+                # only a python collate_fn travels to the workers; the
+                # default collate runs as numpy there, tensorified here
+                custom = None if self.collate_fn is default_collate_fn \
+                    else self.collate_fn
+                pool = MultiprocessPool(self.dataset, custom,
+                                        self.num_workers,
+                                        self.worker_init_fn,
+                                        self.prefetch_factor)
+            except Exception:
+                return None  # unpicklable → threaded fallback
+            self._mp_pool = pool
+
+        def gen():
+            try:
+                for batch in pool.run_epoch(iter(self.batch_sampler),
+                                            self.timeout):
+                    yield _tensorify_tree(batch)
+            finally:
+                if not self.persistent_workers:
+                    pool.shutdown()
+                    self._mp_pool = None
+        return gen()
 
     def _iter_threaded(self):
         """Prefetching iterator: worker threads assemble batches into a

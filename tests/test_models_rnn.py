@@ -79,7 +79,7 @@ class TestRNN:
         o = opt.Adam(learning_rate=0.01, parameters=params)
         x = paddle.randn([4, 10, 4])
         y = paddle.randn([4, 1])
-        for i in range(30):
+        for i in range(12):
             out, (h, c) = lstm(x)
             loss = ((head(out[:, -1]) - y) ** 2).mean()
             loss.backward()
@@ -168,7 +168,9 @@ class TestRingAttention:
             return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
 
         for causal in (True, False):
-            out = ring_attention_arrays(qd, kd, vd, mesh, causal=causal)
+            ring = jax.jit(lambda a, b, c, _c=causal: ring_attention_arrays(
+                a, b, c, mesh, causal=_c))
+            out = ring(qd, kd, vd)
             err = float(jnp.abs(jnp.asarray(out) - ref(causal)).max())
             assert err < 1e-4, f"causal={causal} err={err}"
 
@@ -186,7 +188,7 @@ class TestRingAttention:
         def f(qq):
             return ring_attention_arrays(qq, qq, qq, mesh,
                                          causal=True).sum()
-        g = jax.grad(f)(qd)
+        g = jax.jit(jax.grad(f))(qd)
         assert np.isfinite(np.asarray(g)).all()
 
 

@@ -1,0 +1,129 @@
+"""Multiprocess DataLoader workers (ref
+fluid/dataloader/dataloader_iter.py:326): subprocess pool, ordered
+batches, GIL-escaping scaling, pickling fallback."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class RangeSquares(Dataset):
+    """Top-level (picklable) dataset."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.asarray([i * i], np.float32)
+
+
+class SlowCPUDataset(Dataset):
+    """~2ms of pure-python work per sample — GIL-bound in threads."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for j in range(150000):
+            acc += (i * j) % 7
+        return np.float32(acc % 100)
+
+
+def _collect(loader):
+    xs = []
+    for batch in loader:
+        xs.append(batch)
+    return xs
+
+
+class TestMultiprocessLoader:
+    def test_batches_ordered_and_correct(self):
+        dl = DataLoader(RangeSquares(32), batch_size=4, num_workers=2)
+        out = _collect(dl)
+        assert len(out) == 8
+        for b, batch in enumerate(out):
+            i, sq = batch
+            np.testing.assert_allclose(
+                i.numpy(), np.arange(b * 4, b * 4 + 4, dtype=np.float32))
+            np.testing.assert_allclose(sq.numpy()[:, 0],
+                                       (i.numpy() ** 2))
+        assert dl._mp_pool is None  # pool torn down after epoch
+
+    def test_persistent_workers_reused(self):
+        dl = DataLoader(RangeSquares(16), batch_size=4, num_workers=2,
+                        persistent_workers=True)
+        _collect(dl)
+        pool = dl._mp_pool
+        assert pool is not None and pool._alive
+        _collect(dl)  # second epoch reuses the same pool
+        assert dl._mp_pool is pool
+        pool.shutdown()
+
+    def test_unpicklable_falls_back_to_threads(self):
+        class Local(Dataset):  # class defined in function → unpicklable
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        dl = DataLoader(Local(), batch_size=2, num_workers=2)
+        out = _collect(dl)
+        assert len(out) == 4
+        assert dl._mp_pool is None
+
+    def test_worker_error_propagates(self):
+        dl = DataLoader(RangeSquares(-1), batch_size=2, num_workers=2)
+        # __len__ < 0 -> sampler empty; craft a real error instead:
+        class Bad(RangeSquares):
+            pass
+        dl = DataLoader(BadSample(8), batch_size=2, num_workers=2)
+        with pytest.raises(RuntimeError, match="worker"):
+            _collect(dl)
+
+    def test_cpu_bound_transforms_scale(self):
+        """Processes must beat the GIL-bound threaded path on pure-python
+        work (the whole point of multiprocess workers). The speedup
+        assertion needs real parallel hardware — on a single-core host we
+        still run both paths and check correctness under load."""
+        import os
+        ds = SlowCPUDataset(48)
+        dl_mp = DataLoader(ds, batch_size=4, num_workers=4,
+                           persistent_workers=True)
+        dl_th = DataLoader(ds, batch_size=4, num_workers=4,
+                           use_shared_memory=False)  # threaded path
+        # warm the pool so spawn cost isn't in the timed region
+        _collect(dl_mp)
+        t0 = time.perf_counter()
+        _collect(dl_mp)
+        t_mp = time.perf_counter() - t0
+        dl_mp._mp_pool.shutdown()
+        t0 = time.perf_counter()
+        out_th = _collect(dl_th)
+        t_th = time.perf_counter() - t0
+        assert len(out_th) == 12
+        if (os.cpu_count() or 1) >= 2:
+            assert t_mp < t_th, (t_mp, t_th)
+
+
+class BadSample(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i >= 4:
+            raise ValueError("boom")
+        return np.float32(i)

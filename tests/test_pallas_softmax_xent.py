@@ -65,7 +65,11 @@ class TestSoftmaxXentKernel:
         assert _choose_block(50304, 4096, 128) > 0
         assert 50304 % _choose_block(50304, 4096, 128) == 0
         assert _choose_block(8192, 4096, 128) == 4096
-        assert _choose_block(1000, 4096, 128) == 1000  # fits whole
+        # unaligned sizes are rejected (Mosaic (8,128) tiling rule) and
+        # the caller falls back to the XLA composition
+        assert _choose_block(1000, 4096, 128) == 0
+        assert _choose_block(1024, 4096, 128) == 1024  # aligned, fits
+        assert not supported(8192, 1000)
         assert supported(8192, 50304)
 
     def test_bf16_logits(self):
