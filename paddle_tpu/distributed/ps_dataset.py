@@ -198,6 +198,12 @@ class InMemoryDataset(DatasetBase):
                 declared.append(None)
         if all(d is not None for d in declared) and declared:
             return declared
+        import warnings
+        warnings.warn(
+            "MultiSlot slot dtypes not declared on use_vars; sniffing "
+            "from the first 100 data lines — an all-integral float slot "
+            "would be mistyped int64. Declare dtypes on the use_var "
+            "Variables to silence this.", UserWarning)
         sampled = [1] * len(names)
         seen = 0
         for fname in self._filelist:

@@ -247,6 +247,36 @@ def _fused_layers():
                 out = self.ln(out)
             return out if cache is None else (out, (k, v))
 
+        def set_state_dict(self, state_dict, use_structured_name=True):
+            """Accepts our native layout OR the reference fused-op layout
+            (incubate/nn/layer/fused_transformer.py): qkv_weight
+            [3, H, hd, E], qkv_bias [3, H, hd], linear_weight/bias,
+            pre_ln_scale/bias or ln_scale/bias — converted into the
+            qkv_proj/out_proj/ln sublayers."""
+            import numpy as _np
+            sd = {k: (v.numpy() if hasattr(v, "numpy") else _np.asarray(v))
+                  for k, v in state_dict.items()}
+            if "qkv_weight" in sd:
+                E = self.embed_dim
+                conv = {}
+                qkv_w = sd.pop("qkv_weight")          # [3, H, hd, E]
+                conv["qkv_proj.weight"] = _np.transpose(
+                    qkv_w.reshape(3 * E, E))          # -> [E, 3E] (in,out)
+                if "qkv_bias" in sd:
+                    conv["qkv_proj.bias"] = sd.pop("qkv_bias").reshape(-1)
+                if "linear_weight" in sd:
+                    conv["out_proj.weight"] = sd.pop("linear_weight")
+                if "linear_bias" in sd:
+                    conv["out_proj.bias"] = sd.pop("linear_bias")
+                lnk = ("pre_ln_scale", "pre_ln_bias") \
+                    if self.normalize_before else ("ln_scale", "ln_bias")
+                if lnk[0] in sd:
+                    conv["ln.weight"] = sd.pop(lnk[0])
+                if lnk[1] in sd:
+                    conv["ln.bias"] = sd.pop(lnk[1])
+                sd = conv
+            return _nn.Layer.set_state_dict(self, sd, use_structured_name)
+
     class FusedFeedForward(_nn.Layer):
         def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
                      epsilon=1e-5, activation="relu",
@@ -279,6 +309,28 @@ def _fused_layers():
             if not self.normalize_before:
                 out = self.ln(out)
             return out
+
+        def set_state_dict(self, state_dict, use_structured_name=True):
+            """Accepts the reference fused-op layout (linear1_weight,
+            linear2_weight, ln1_scale/ln2_scale...) besides ours."""
+            import numpy as _np
+            sd = {k: (v.numpy() if hasattr(v, "numpy") else _np.asarray(v))
+                  for k, v in state_dict.items()}
+            if "linear1_weight" in sd:
+                conv = {"linear1.weight": sd.pop("linear1_weight"),
+                        "linear2.weight": sd.pop("linear2_weight")}
+                if "linear1_bias" in sd:
+                    conv["linear1.bias"] = sd.pop("linear1_bias")
+                if "linear2_bias" in sd:
+                    conv["linear2.bias"] = sd.pop("linear2_bias")
+                lnk = ("ln1_scale", "ln1_bias") if self.normalize_before \
+                    else ("ln2_scale", "ln2_bias")
+                if lnk[0] in sd:
+                    conv["ln.weight"] = sd.pop(lnk[0])
+                if lnk[1] in sd:
+                    conv["ln.bias"] = sd.pop(lnk[1])
+                sd = conv
+            return _nn.Layer.set_state_dict(self, sd, use_structured_name)
 
     return FusedMultiHeadAttention, FusedFeedForward
 

@@ -74,18 +74,23 @@ def sparse_attention(query, key, value, sparse_csr_offset=None,
                                             attn_mask=attn_mask)
 
     def fn(q, k, v, off, cols):
-        B, H, T, D = q.shape[0], q.shape[2], q.shape[1], q.shape[-1]
-        # build mask [B,H,T,T] from CSR rows
-        mask = jnp.zeros((off.shape[0], off.shape[1], T, T), bool)
-        import numpy as np
-        offn = np.asarray(off)
-        colsn = np.asarray(cols)
-        m = np.zeros(mask.shape, dtype=bool)
-        for b in range(offn.shape[0]):
-            for h in range(offn.shape[1]):
-                for r in range(T):
-                    lo, hi = offn[b, h, r], offn[b, h, r + 1]
-                    m[b, h, r, colsn[b, h, lo:hi]] = True
-        return _sdpa_reference(q, k, v, jnp.asarray(m))
+        import jax
+        T = q.shape[1]
+
+        def row_mask(off_bh, cols_bh):
+            # entry j lives in row r iff off[r] <= j < off[r+1]; invalid
+            # tail entries (j >= nnz) are routed to row T and dropped by
+            # the scatter's out-of-bounds rule. One vectorized scatter —
+            # no host loop, works under jit.
+            nnz = cols_bh.shape[0]
+            j = jnp.arange(nnz)
+            rows = jnp.searchsorted(off_bh.astype(jnp.int32), j,
+                                    side="right") - 1
+            rows = jnp.where(j < off_bh[-1], rows, T)
+            return jnp.zeros((T, T), bool).at[rows, cols_bh].set(
+                True, mode="drop")
+
+        mask = jax.vmap(jax.vmap(row_mask))(off, cols)
+        return _sdpa_reference(q, k, v, mask)
     return apply_op(fn, query, key, value, sparse_csr_offset,
                     sparse_csr_columns)

@@ -7,6 +7,8 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.incubate as incubate
+FusedMultiHeadAttention = incubate.nn.FusedMultiHeadAttention
+FusedFeedForward = incubate.nn.FusedFeedForward
 
 
 def _mha(**kw):
@@ -68,3 +70,55 @@ class TestFusedFeedForward:
         ref = ff.ln(x + ff.linear2(F.relu(ff.linear1(x))))
         np.testing.assert_allclose(ff(x).numpy(), ref.numpy(),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestReferenceStateDictLayout:
+    """Reference fused-op checkpoints (qkv_weight [3,H,hd,E], ...) must
+    load into the sublayer-structured fused layers (ADVICE r1 layout
+    divergence; ref incubate/nn/layer/fused_transformer.py)."""
+
+    def test_fused_mha_loads_reference_layout(self):
+        paddle.seed(0)
+        E, H = 8, 2
+        m = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                    attn_dropout_rate=0.0)
+        rng = np.random.RandomState(0)
+        qkv_w = rng.randn(3, H, E // H, E).astype(np.float32)
+        ref_sd = {
+            "qkv_weight": qkv_w,
+            "qkv_bias": rng.randn(3, H, E // H).astype(np.float32),
+            "linear_weight": rng.randn(E, E).astype(np.float32),
+            "linear_bias": rng.randn(E).astype(np.float32),
+            "ln_scale": np.ones(E, np.float32),
+            "ln_bias": np.zeros(E, np.float32),
+        }
+        missing, unexpected = m.set_state_dict(ref_sd)
+        assert not missing and not unexpected, (missing, unexpected)
+        # qkv_proj.weight is [E, 3E] (in,out); entry (i,h,d) of the ref
+        # tensor must land at out column i*E + h*hd + d
+        got = m.qkv_proj.weight.numpy()
+        np.testing.assert_allclose(got[:, 0], qkv_w[0, 0, 0, :])
+        np.testing.assert_allclose(got[:, E + 1], qkv_w[1, 0, 1, :])
+        # forward runs with the loaded weights
+        x = paddle.to_tensor(rng.randn(2, 4, E).astype(np.float32))
+        m.eval()
+        out = m(x)
+        assert out.shape == [2, 4, E]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_ffn_loads_reference_layout(self):
+        paddle.seed(0)
+        m = FusedFeedForward(8, 16, dropout_rate=0.0)
+        rng = np.random.RandomState(0)
+        ref_sd = {
+            "linear1_weight": rng.randn(8, 16).astype(np.float32),
+            "linear1_bias": rng.randn(16).astype(np.float32),
+            "linear2_weight": rng.randn(16, 8).astype(np.float32),
+            "linear2_bias": rng.randn(8).astype(np.float32),
+            "ln2_scale": np.ones(8, np.float32),
+            "ln2_bias": np.zeros(8, np.float32),
+        }
+        missing, unexpected = m.set_state_dict(ref_sd)
+        assert not missing and not unexpected, (missing, unexpected)
+        np.testing.assert_allclose(m.linear1.weight.numpy(),
+                                   ref_sd["linear1_weight"])
