@@ -29,7 +29,8 @@ from jax import shard_map
 from ...framework.core import Tensor
 from ...jit.api import functional_call, state_arrays, _bind, _restore
 
-__all__ = ["PipelineParallel", "pipeline_apply", "pipeline_1f1b"]
+__all__ = ["PipelineParallel", "pipeline_apply",
+           "pipeline_apply_interleaved", "pipeline_1f1b"]
 
 
 def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, n_stages,
@@ -69,6 +70,69 @@ def pipeline_apply(stage_fn, stacked_params, x_micro, mesh, n_stages,
         outputs = jax.lax.psum(
             jnp.where(stage == n_stages - 1, outputs, 0.0), "pp")
         return outputs
+
+    pp_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
+    return shard_map(
+        spmd, mesh=mesh,
+        in_specs=(pp_specs, P()), out_specs=P(),
+        check_vma=False)(stacked_params, x_micro)
+
+
+def pipeline_apply_interleaved(stage_fn, stacked_params, x_micro, mesh,
+                               n_stages, n_micro, n_virtual):
+    """Interleaved virtual-stage schedule (Megatron-style; ref
+    pipeline_parallel.py "interleaved"/virtual pp + pp_layers.py virtual
+    stages): each device owns V non-contiguous model chunks, so the
+    pipeline fill is V× shallower relative to per-tick work — bubble
+    fraction drops from (S-1)/(M+S-1) toward (S-1)/(M·V+S-1).
+
+    stacked_params leaves: [S*V, ...] in DEVICE-MAJOR order (row d*V+c =
+    chunk c living on device d); under P("pp") sharding device d holds
+    exactly its V chunks. Schedule position for device d at tick t:
+    k = t-d; group g = k//(S·V), j = k%(S·V), chunk c = j//S, and
+    micro m = g·S + j%S. Activations hop d→d+1 each tick; the wrap
+    S-1→0 carries the micro into its next chunk. Requires n_micro %
+    n_stages == 0. Backward is reverse-mode AD through the loop (GPipe-
+    class memory; combine with recompute for depth-bounded footprint)."""
+    S, V, M = n_stages, n_virtual, n_micro
+    if M % S != 0:
+        raise ValueError(f"interleaved schedule needs n_micro ({M}) "
+                         f"divisible by n_stages ({S})")
+    G = M // S
+    T = S - 1 + G * S * V
+
+    def spmd(params_local, xs):
+        # params_local leaves: [V, ...] — this device's chunks
+        d = jax.lax.axis_index("pp")
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        mb_shape = xs.shape[1:]
+        outputs = jnp.zeros((M,) + mb_shape, xs.dtype)
+        recv0 = jnp.zeros(mb_shape, xs.dtype)
+
+        def tick(t, state):
+            recv, outputs = state
+            k = t - d
+            valid = (k >= 0) & (k < G * S * V)
+            kc = jnp.clip(k, 0, G * S * V - 1)
+            g = kc // (S * V)
+            j = kc % (S * V)
+            c = j // S
+            m = g * S + (j % S)
+            params_here = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, c, 0,
+                                                       keepdims=False),
+                params_local)
+            inp = jnp.where((d == 0) & (c == 0), xs[m], recv)
+            out = stage_fn(params_here, inp)
+            done = valid & (d == S - 1) & (c == V - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(done, out, outputs[m]), m, 0)
+            recv = jax.lax.ppermute(out, "pp", perm)
+            return recv, outputs
+
+        _, outputs = jax.lax.fori_loop(0, T, tick, (recv0, outputs))
+        return jax.lax.psum(
+            jnp.where(d == S - 1, outputs, 0.0), "pp")
 
     pp_specs = jax.tree.map(lambda _: P("pp"), stacked_params)
     return shard_map(
@@ -212,7 +276,7 @@ class PipelineParallel:
     uses (ref parallel_layers/pp_layers.py:49)."""
 
     def __init__(self, pipeline_layer, optimizer, mesh, n_micro=2,
-                 loss_fn=None, schedule="gpipe"):
+                 loss_fn=None, schedule="gpipe", n_virtual=1):
         self.layer = pipeline_layer
         self.optimizer = optimizer
         self.mesh = mesh
@@ -220,8 +284,12 @@ class PipelineParallel:
         self.n_stages = pipeline_layer.num_stages
         self.loss_fn = loss_fn or pipeline_layer._loss_fn
         self.schedule = schedule.lower().replace("-", "")
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(f"unknown pipeline schedule {schedule!r}")
+        if self.schedule == "interleaved":
+            self.n_virtual = max(2, int(n_virtual))
+        else:
+            self.n_virtual = 1
         self._step_i = 0
 
         # ---- split the stack: [pre edge][uniform trunk][post edge] -----
@@ -233,13 +301,14 @@ class PipelineParallel:
         while items and id(items[-1][0]) in shared_ids:
             post_items.append(items.pop())
         post_items.reverse()
-        if len(items) % self.n_stages != 0:
+        n_seg = self.n_stages * self.n_virtual
+        if len(items) % n_seg != 0:
             raise ValueError(
                 f"trunk of {len(items)} layers does not divide into "
-                f"{self.n_stages} uniform stages")
-        per = len(items) // self.n_stages
-        segments = [items[i * per:(i + 1) * per]
-                    for i in range(self.n_stages)]
+                f"{n_seg} uniform stages "
+                f"({self.n_stages} stages x {self.n_virtual} chunks)")
+        per = len(items) // n_seg
+        segments = [items[i * per:(i + 1) * per] for i in range(n_seg)]
         self._segments = segments
 
         # ---- edge (replicated, possibly tied) params -------------------
@@ -299,8 +368,14 @@ class PipelineParallel:
                 raise ValueError(
                     "pipeline stages are not structurally uniform: "
                     f"{sorted(sp.keys())} vs {keys}")
+        # row order: device-major (row d*V+c = logical segment c*S+d) so
+        # the P('pp') shard of device d is exactly its V chunks; for
+        # V=1 this is plain segment order
+        S, V = self.n_stages, self.n_virtual
+        row_order = [c * S + d for d in range(S) for c in range(V)]
         self.stacked = {
-            k: jnp.stack([sp[k] for sp in seg_params]) for k in keys}
+            k: jnp.stack([seg_params[l][k] for l in row_order])
+            for k in keys}
         pp_shard = {k: NamedSharding(mesh, P("pp"))
                     for k in self.stacked}
         self.stacked = {k: jax.device_put(v, pp_shard[k])
@@ -346,6 +421,16 @@ class PipelineParallel:
             l = lfn(Tensor(out), Tensor(y))
             return l.value if isinstance(l, Tensor) else l
 
+        n_virtual_ = self.n_virtual
+
+        def apply_trunk(ps, xa):
+            if n_virtual_ > 1:
+                return pipeline_apply_interleaved(
+                    stage_fn, ps, xa, mesh_, n_stages, n_micro_,
+                    n_virtual_)
+            return pipeline_apply(stage_fn, ps, xa, mesh_, n_stages,
+                                  n_micro_)
+
         if self.schedule == "1f1b":
             def train_step(stacked, edge, opt_state, edge_state, lr,
                            step_i, x, y):
@@ -368,8 +453,7 @@ class PipelineParallel:
                 def loss_of(ps, ep):
                     xa = jax.vmap(lambda xi: pre_fn(ep, xi))(
                         jnp.stack(jnp.split(x, n_micro_, axis=0)))
-                    outs = pipeline_apply(stage_fn, ps, xa, mesh_,
-                                          n_stages, n_micro_)
+                    outs = apply_trunk(ps, xa)
                     flat = outs.reshape((-1,) + outs.shape[2:])
                     return loss_arr(post_fn(ep, flat), y)
 
@@ -402,7 +486,12 @@ class PipelineParallel:
         xa = x.value if isinstance(x, Tensor) else jnp.asarray(x)
         xm = jnp.stack(jnp.split(xa, self.n_micro, axis=0))
         xm = jax.vmap(lambda xi: self._pre_fn(self.edge, xi))(xm)
-        outs = pipeline_apply(self._stage_fn, self.stacked, xm, self.mesh,
-                              self.n_stages, self.n_micro)
+        if self.n_virtual > 1:
+            outs = pipeline_apply_interleaved(
+                self._stage_fn, self.stacked, xm, self.mesh,
+                self.n_stages, self.n_micro, self.n_virtual)
+        else:
+            outs = pipeline_apply(self._stage_fn, self.stacked, xm,
+                                  self.mesh, self.n_stages, self.n_micro)
         flat = outs.reshape((-1,) + outs.shape[2:])
         return Tensor(self._post_fn(self.edge, flat))

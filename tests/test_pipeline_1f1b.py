@@ -132,3 +132,59 @@ class Test1F1B:
     def test_bad_schedule_rejected(self):
         with pytest.raises(ValueError):
             _make("interleaved-2f2b")
+
+
+class TestInterleaved:
+    """Virtual-stage interleaved schedule (Megatron-style; ref
+    'interleaved'/virtual pp in fleet pipeline_parallel.py)."""
+
+    def _build(self, schedule, n_virtual=1, lr=0.02):
+        paddle.seed(0)
+        mesh = build_mesh(dp=1, pp=2, mp=1, devices=jax.devices()[:2])
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 16, 16) for _ in range(8)],
+            num_stages=2, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        o = opt.SGD(learning_rate=lr, parameters=pipe.parameters())
+        return PipelineParallel(pipe, o, mesh, n_micro=4,
+                                schedule=schedule, n_virtual=n_virtual), \
+            pipe
+
+    def test_matches_gpipe_and_single_device(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        inter, pipe = self._build("interleaved", n_virtual=2)
+        # forward parity vs the single-device full stack
+        np.testing.assert_allclose(inter.forward(x).numpy(),
+                                   pipe(x).numpy(), rtol=1e-4, atol=1e-5)
+        gp, _ = self._build("gpipe")
+        li = inter.train_batch(x, y).item()
+        lg = gp.train_batch(x, y).item()
+        assert abs(li - lg) < 1e-5, (li, lg)
+        for _ in range(8):
+            l = inter.train_batch(x, y).item()
+        assert l < li
+
+    def test_micro_must_divide_stages(self):
+        paddle.seed(0)
+        mesh = build_mesh(dp=1, pp=2, mp=1, devices=jax.devices()[:2])
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 8, 8) for _ in range(4)],
+            num_stages=2, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        o = opt.SGD(learning_rate=0.01, parameters=pipe.parameters())
+        eng = PipelineParallel(pipe, o, mesh, n_micro=3,
+                               schedule="interleaved", n_virtual=2)
+        x = paddle.randn([6, 8])
+        with pytest.raises(ValueError, match="divisible"):
+            eng.train_batch(x, x)
+
+    def test_trunk_must_divide_chunks(self):
+        paddle.seed(0)
+        mesh = build_mesh(dp=1, pp=2, mp=1, devices=jax.devices()[:2])
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 8, 8) for _ in range(6)],
+            num_stages=2, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        o = opt.SGD(learning_rate=0.01, parameters=pipe.parameters())
+        with pytest.raises(ValueError, match="uniform stages"):
+            PipelineParallel(pipe, o, mesh, n_micro=4,
+                             schedule="interleaved", n_virtual=2)
