@@ -138,7 +138,12 @@ class HybridTrainStep:
         self.opt_state = {k: init_state(k, v)
                           for k, v in self.params.items()}
 
-        self.batch_sharding = NamedSharding(mesh, P(("dp",)))
+        # batch dim over dp; with a sequence-parallel mesh (sp>1), the
+        # sequence dim is sharded over 'sp' too — ring attention inside
+        # the model consumes it without gathering (long-context path)
+        sp_deg = mesh.shape.get("sp", 1)
+        self.batch_sharding = NamedSharding(
+            mesh, P(("dp",), "sp") if sp_deg > 1 else P(("dp",)))
         loss_sharding = NamedSharding(mesh, P())
 
         model_ref = model
@@ -219,9 +224,11 @@ class HybridTrainStep:
                            state_shardings))
 
     def __call__(self, *batch):
+        dp_only = NamedSharding(self.mesh, P(("dp",)))
         arrays = [jax.device_put(
-            b.value if isinstance(b, Tensor) else jnp.asarray(b),
-            self.batch_sharding) for b in batch]
+            a, self.batch_sharding if a.ndim >= 2 else dp_only)
+            for a in (b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch)]
         self._step_i += 1
         lr = self.optimizer.get_lr()
         loss, self.params, self.opt_state = self._jitted(

@@ -28,7 +28,8 @@ class GPTConfig:
                  num_heads=12, intermediate_size=None,
                  max_position_embeddings=1024, dropout=0.0,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
-                 use_bias=True, scan_layers=True, scan_remat=False):
+                 use_bias=True, scan_layers=True, scan_remat=False,
+                 sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -46,6 +47,10 @@ class GPTConfig:
         # scan body in jax.checkpoint (recompute activations in backward).
         self.scan_layers = scan_layers
         self.scan_remat = scan_remat
+        # sequence_parallel: shard the sequence dim over the 'sp' mesh
+        # axis; attention runs as ring attention (K/V shards rotate via
+        # ppermute, online-softmax merge) — exact, long-context capable
+        self.sequence_parallel = sequence_parallel
 
 
 class StaticCacheSlot:
@@ -72,6 +77,7 @@ class GPTAttention(nn.Layer):
                                   weight_attr=w_init, bias_attr=battr)
         self.out_proj = nn.Linear(h, h, weight_attr=w_init, bias_attr=battr)
         self.dropout = cfg.dropout
+        self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, x, cache=None):
         B, T, H = x.shape
@@ -85,9 +91,13 @@ class GPTAttention(nn.Layer):
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)
             cache = (k, v)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.dropout if self.training else 0.0)
+        if self.sequence_parallel and cache is None:
+            from ..ops.ring_attention import ring_attention
+            out = ring_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.dropout if self.training else 0.0)
         out = self.out_proj(out.reshape([B, T, H]))
         return (out, cache) if cache is not None else out
 

@@ -86,7 +86,17 @@ def ring_attention_arrays(q, k, v, mesh, axis="sp", causal=True,
             jnp.moveaxis(w_sum, 1, 2)[..., None], 1e-30)
         return merged.astype(q_loc.dtype)
 
-    spec = P(None, axis, None, None)
+    # batch/head dims ride whatever other mesh axes exist (dp on batch,
+    # mp on heads) so the ring composes inside a fleet hybrid step
+    # without forcing an all-gather of the dp/mp shards
+    def _axis_if(name, dim_size):
+        return name if (name in mesh.axis_names
+                        and mesh.shape[name] > 1
+                        and dim_size % mesh.shape[name] == 0) else None
+
+    b_ax = _axis_if("dp", q.shape[0])
+    h_ax = _axis_if("mp", q.shape[2])
+    spec = P(b_ax, axis, h_ax, None)
     return shard_map(spmd, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)
 
