@@ -199,6 +199,7 @@ def test_device_type_api():
 
 # --------------------------------------------------------------- vision
 
+@pytest.mark.heavy
 def test_yolo_loss_shape_and_grad():
     np.random.seed(0)
     N, na, cls, H, W = 2, 3, 4, 5, 5

@@ -195,6 +195,8 @@ class TestDoubleGrad:
         loss.backward()
         np.testing.assert_allclose(x.grad.numpy(), [8.0, 24.0])
 
+    @pytest.mark.heavy
+
     def test_wgan_gp_style_penalty(self):
         """Gradient penalty: grads of an interpolation point flow back
         into discriminator weights (the WGAN-GP training pattern)."""

@@ -123,6 +123,7 @@ class TestGenerateProposals:
 
 
 class TestDeformConv2DLayer:
+    @pytest.mark.heavy
     def test_zero_offset_equals_plain_conv(self):
         import torch
         import torch.nn.functional as tF

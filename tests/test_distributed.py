@@ -39,6 +39,7 @@ class TestMesh:
 
 
 class TestHybridTrain:
+    @pytest.mark.heavy
     def test_dp_mp_sharding_step(self):
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs["dp_degree"] = 2
@@ -76,6 +77,7 @@ class TestHybridTrain:
         assert "all-reduce" in hlo or "all-gather" in hlo or \
             "reduce-scatter" in hlo
 
+    @pytest.mark.heavy
     def test_dp_matches_single_device(self):
         """dp=8 training must produce the same loss trajectory as a
         single-device run on the same global batch."""
@@ -119,6 +121,7 @@ class TestHybridTrain:
 
 
 class TestPipeline:
+    @pytest.mark.heavy
     def test_forward_parity_and_training(self):
         paddle.seed(0)
         mesh = build_mesh(dp=1, pp=4, mp=1, devices=jax.devices()[:4])
@@ -245,6 +248,8 @@ class TestZeROStages:
         return fleet.build_train_step(m, make_loss_fn(), o,
                                       sharding_stage=stage)
 
+    @pytest.mark.heavy
+
     def test_stage2_grads_constrained_sharded(self):
         """Stage-2 pins gradients to the 'sharding' axis: the lowered
         program must carry the sharding constraints (28 grad leaves), and
@@ -268,6 +273,8 @@ class TestZeROStages:
         assert "f32[32,192]" in hlo, "update does not run on grad shards"
         assert ("reduce-scatter" in hlo) or ("all-reduce" in hlo)
 
+    @pytest.mark.heavy
+
     def test_stage3_params_stored_sharded(self):
         step = self._build(3)
         pk = "gpt.h.0.attn.qkv_proj.weight"
@@ -277,6 +284,7 @@ class TestZeROStages:
         hlo = step.compiled_text(ids, ids)
         assert "all-gather" in hlo, "stage-3 must all-gather params at use"
 
+    @pytest.mark.heavy
     def test_stages_numerics_match(self):
         ids = paddle.to_tensor(
             np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
@@ -288,6 +296,8 @@ class TestZeROStages:
                                    atol=1e-5)
         np.testing.assert_allclose(losses[1], losses[3], rtol=1e-4,
                                    atol=1e-5)
+
+    @pytest.mark.heavy
 
     def test_wrappers_select_behavior(self):
         """ShardingStage3(layer) marker must flow into the train step."""
@@ -376,11 +386,13 @@ class TestSequenceParallel:
             np.random.RandomState(0).randint(0, 256, size=(8, 32)))
         return step, [step(ids, ids).item() for _ in range(2)]
 
+    @pytest.mark.heavy
     def test_ring_matches_dense(self):
         _, base = self._run(sep_degree=1, sequence_parallel=False, dp=2)
         _, ring = self._run(sep_degree=4, sequence_parallel=True, dp=2)
         np.testing.assert_allclose(base, ring, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.heavy
     def test_seq_dim_sharded_and_ring_in_hlo(self):
         step, _ = self._run(sep_degree=4, sequence_parallel=True, dp=2)
         assert "sp" in str(step.batch_sharding.spec)

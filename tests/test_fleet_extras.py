@@ -131,6 +131,7 @@ class TestHonestStrategy:
             with pytest.raises(NotImplementedError):
                 fleet.build_train_step(m, _loss_fn(), o)
 
+    @pytest.mark.heavy
     def test_lars_swaps_optimizer(self):
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
         strategy = fleet.DistributedStrategy()
@@ -151,6 +152,7 @@ class TestHonestStrategy:
             l = step(ids, ids).item()
         assert np.isfinite(l) and l < l0
 
+    @pytest.mark.heavy
     def test_gradient_merge_flag_accumulates(self):
         """strategy.gradient_merge k_steps=2 must match explicit
         accumulate_steps=2 exactly."""

@@ -38,6 +38,8 @@ class TestGPTScanBlocks:
                                    np.asarray(out_unroll),
                                    rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.heavy
+
     def test_grads_match_unrolled_and_remat(self):
         cfg, m, params, ids = _setup()
 
@@ -76,6 +78,7 @@ class TestStaticCacheGenerate:
                         dropout=0.0)
         return GPTForCausalLM(cfg), cfg
 
+    @pytest.mark.heavy
     def test_matches_naive_greedy(self):
         import jax
         import jax.numpy as jnp
@@ -91,6 +94,8 @@ class TestStaticCacheGenerate:
             nxt = logits[:, -1, :].argmax(-1)[:, None]
             cur = np.concatenate([cur, nxt], axis=1)
         np.testing.assert_array_equal(out.numpy(), cur)
+
+    @pytest.mark.heavy
 
     def test_two_compiled_programs(self):
         m, cfg = self._model()

@@ -4,6 +4,7 @@ semantics (attention/FFN with residual + layer norm folded in), fused on
 TPU via flash attention + Pallas layer norm.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.incubate as incubate
@@ -50,6 +51,7 @@ class TestFusedMultiHeadAttention:
 
 
 class TestFusedFeedForward:
+    @pytest.mark.heavy
     def test_forward_and_grad(self):
         paddle.seed(1)
         ff = incubate.nn.FusedFeedForward(32, 64, dropout_rate=0.0,

@@ -9,6 +9,7 @@ import paddle_tpu.nn as nn
 
 
 class TestRNN:
+    @pytest.mark.heavy
     def test_lstm_vs_torch(self):
         import torch
         paddle.seed(0)
@@ -39,6 +40,8 @@ class TestRNN:
         np.testing.assert_allclose(c_p.numpy(), c_t.numpy(), rtol=1e-4,
                                    atol=1e-5)
 
+    @pytest.mark.heavy
+
     def test_gru_simple_rnn(self):
         import torch
         paddle.seed(1)
@@ -61,6 +64,8 @@ class TestRNN:
         out, h = srnn(paddle.to_tensor(x))
         assert out.shape == [B, T, H] and h.shape == [1, B, H]
 
+    @pytest.mark.heavy
+
     def test_cells(self):
         cell = nn.LSTMCell(4, 8)
         x = paddle.randn([2, 4])
@@ -70,6 +75,7 @@ class TestRNN:
         h, _ = g(x)
         assert h.shape == [2, 8]
 
+    @pytest.mark.heavy
     def test_rnn_trainable(self):
         paddle.seed(0)
         lstm = nn.LSTM(4, 8)
@@ -91,6 +97,7 @@ class TestRNN:
 
 
 class TestBert:
+    @pytest.mark.heavy
     def test_forward_and_mlm_loss(self):
         from paddle_tpu.models import BertForMaskedLM, BertConfig
         paddle.seed(0)
@@ -105,6 +112,7 @@ class TestBert:
         loss = m.loss(ids, ids)
         assert np.isfinite(loss.item())
 
+    @pytest.mark.heavy
     def test_ernie_classifier_trains(self):
         from paddle_tpu.models import (ErnieForSequenceClassification,
                                        ernie_base, BertConfig)
@@ -215,6 +223,8 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(out),
                                        np.asarray(ref(causal)), atol=2e-5)
 
+    @pytest.mark.heavy
+
     def test_backward_matches(self):
         from paddle_tpu.ops.pallas.flash_attention import \
             flash_attention_arrays
@@ -252,6 +262,8 @@ class TestGPTModels:
             np.random.RandomState(0).randint(0, 1024, size=(2, 8)))
         out = m.generate(ids, max_new_tokens=3)
         assert out.shape == [2, 11]
+
+    @pytest.mark.heavy
 
     def test_gpt_kv_cache_consistency(self):
         from paddle_tpu.models import GPTForCausalLM, gpt_tiny

@@ -91,6 +91,7 @@ class TestMultiprocessLoader:
         with pytest.raises(RuntimeError, match="worker"):
             _collect(dl)
 
+    @pytest.mark.heavy
     def test_cpu_bound_transforms_scale(self):
         """Processes must beat the GIL-bound threaded path on pure-python
         work (the whole point of multiprocess workers). The speedup

@@ -47,6 +47,7 @@ class Test1F1B:
             l = b.train_batch(x, y).item()
         assert l < lb
 
+    @pytest.mark.heavy
     def test_activation_memory_below_gpipe(self):
         """With n_micro >> n_stages, 1F1B's ring buffer (depth-bounded)
         must beat GPipe-via-AD (which saves residuals for every tick)."""
@@ -64,6 +65,7 @@ class Test1F1B:
         f = temp_bytes(_make("1f1b", n_micro=n_micro))
         assert f < g, f"1F1B temp {f} not below GPipe temp {g}"
 
+    @pytest.mark.heavy
     def test_shared_embedding_tied_gradients(self):
         """GPT-style tied embedding: SharedLayerDesc at both ends — one
         weight leaf, gradient sums both uses, loss decreases."""
@@ -98,6 +100,8 @@ class Test1F1B:
         for _ in range(15):
             l = pp.train_batch(ids, ids).item()
         assert l < l0, (l0, l)
+
+    @pytest.mark.heavy
 
     def test_shared_embedding_gpipe_parity(self):
         """Same tied-edge model must also work on the GPipe schedule and
@@ -149,6 +153,7 @@ class TestInterleaved:
                                 schedule=schedule, n_virtual=n_virtual), \
             pipe
 
+    @pytest.mark.heavy
     def test_matches_gpipe_and_single_device(self):
         rng = np.random.RandomState(0)
         x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
