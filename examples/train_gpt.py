@@ -1,0 +1,69 @@
+"""Single-chip GPT pretraining with the fully-jitted TrainStep.
+
+    python examples/train_gpt.py --size tiny --steps 20        # CPU smoke
+    python examples/train_gpt.py --size medium --steps 100     # on TPU
+
+The whole step (forward + loss + grads + AdamW update) is ONE XLA
+computation with donated buffers; the block stack runs as lax.scan with
+rematerialization (see paddle_tpu/models/gpt.py)."""
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models.gpt import (GPTForCausalLM, GPTConfig, gpt_tiny,
+                                   gpt_small, gpt_medium)
+
+SIZES = {"tiny": gpt_tiny, "small": gpt_small, "medium": gpt_medium}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", default="tiny", choices=sorted(SIZES))
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--bf16", action="store_true")
+    args = ap.parse_args()
+
+    cfg = SIZES[args.size]()
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings,
+                                      args.seq)
+    if args.size != "tiny":
+        cfg.scan_remat = True
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if args.bf16:
+        model.bfloat16()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    print(f"GPT-{args.size}: {n_params/1e6:.1f}M params")
+
+    o = opt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    def loss_fn(logits, labels):
+        V = logits.shape[-1]
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, o)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, size=(args.batch, args.seq)).astype(np.int32))
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        loss = step(ids, ids)
+        if i % max(args.steps // 10, 1) == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss.item()):.4f}")
+    dt = time.perf_counter() - t0
+    toks = args.batch * args.seq * args.steps
+    print(f"{toks/dt:.0f} tokens/s (incl. compile)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""High-level API (paddle.Model) image classification: prepare / fit /
+evaluate, exactly the reference hapi workflow.
+
+    python examples/train_vision_hapi.py --model resnet18 --epochs 1
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.io import Dataset, DataLoader
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.metric import Accuracy
+import paddle_tpu.vision.models as zoo
+
+
+class SyntheticImages(Dataset):
+    """Stands in for CIFAR when there's no dataset on disk."""
+
+    def __init__(self, n=128, classes=10, hw=32):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(n, 3, hw, hw).astype(np.float32)
+        self.y = rng.randint(0, classes, n).astype(np.int64)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    net = getattr(zoo, args.model)(num_classes=10)
+    model = Model(net)
+    model.prepare(
+        optimizer=opt.Momentum(learning_rate=0.01, momentum=0.9,
+                               parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    train = DataLoader(SyntheticImages(128), batch_size=args.batch,
+                       shuffle=True)
+    val = DataLoader(SyntheticImages(64), batch_size=args.batch)
+    model.fit(train, val, epochs=args.epochs, verbose=1)
+    print(model.evaluate(val, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

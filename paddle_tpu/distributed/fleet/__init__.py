@@ -63,6 +63,22 @@ def fleet_mesh():
     return get_hybrid_communicate_group().mesh
 
 
+class _PipelineStepAdapter:
+    """Gives a PipelineParallel engine the HybridTrainStep call shape
+    (step(x, y) -> loss Tensor) so fleet users drive pp and non-pp
+    training identically."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.optimizer = engine.optimizer
+
+    def __call__(self, x, y):
+        return self.engine.train_batch(x, y)
+
+    def forward(self, x):
+        return self.engine.forward(x)
+
+
 class _DistributedModel:
     """Wrapper returned by fleet.distributed_model: behaves like the layer
     in eager mode; exposes .train_step_builder() for the SPMD path."""
@@ -132,6 +148,28 @@ def build_train_step(model, loss_fn, optimizer, recompute=None,
     # unwrap ShardingStage2/3 shells down to the real layer/optimizer
     model = getattr(model, "_layer", model)
     optimizer = getattr(optimizer, "_optim", optimizer)
+
+    # pipeline parallelism routes through the PipelineParallel engine
+    # (the reference's fleet.distributed_model does the same wrap for
+    # PipelineLayer models — meta_parallel/__init__.py)
+    from ..meta_parallel import PipelineLayer, PipelineParallel
+    pp_deg = strat.hybrid_configs.get("pp_degree", 1)
+    if isinstance(model, PipelineLayer):
+        if hcg.mesh.shape.get("pp", 1) != model.num_stages:
+            raise ValueError(
+                f"PipelineLayer has {model.num_stages} stages but the "
+                f"mesh 'pp' axis is {hcg.mesh.shape.get('pp', 1)} — set "
+                f"hybrid_configs['pp_degree'] = num_stages")
+        sched = strat.pipeline_configs.get("schedule_mode", "1F1B")
+        n_micro = strat.pipeline_configs.get("accumulate_steps", 1)
+        return _PipelineStepAdapter(PipelineParallel(
+            model, optimizer, hcg.mesh, n_micro=max(n_micro, 1),
+            loss_fn=loss_fn, schedule=sched))
+    if pp_deg > 1:
+        raise ValueError(
+            f"pp_degree={pp_deg} requires the model to be a "
+            f"PipelineLayer (wrap your stack in LayerDesc/SharedLayerDesc)"
+            f" — a plain Layer cannot be stage-partitioned")
     if recompute is None:
         recompute = strat.recompute
     if accumulate_steps is None:

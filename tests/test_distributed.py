@@ -400,3 +400,51 @@ class TestSequenceParallel:
             np.random.RandomState(0).randint(0, 256, size=(8, 32)))
         hlo = step.compiled_text(ids, ids)
         assert "collective-permute" in hlo, "ring hops must be ppermute"
+
+
+class TestFleetPipelineRouting:
+    """fleet.build_train_step must route PipelineLayer models to the
+    PipelineParallel engine (ref fleet.distributed_model wrap) and refuse
+    pp_degree>1 for plain layers instead of silently replicating."""
+
+    def test_pipeline_layer_routed(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["pp_degree"] = 4
+        strategy.pipeline_configs["accumulate_steps"] = 4
+        strategy.pipeline_configs["schedule_mode"] = "1F1B"
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 16, 16) for _ in range(4)],
+            num_stages=4, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        o = opt.SGD(learning_rate=0.02, parameters=pipe.parameters())
+        step = fleet.build_train_step(pipe, None, o)
+        assert step.engine.schedule == "1f1b"
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        l0 = step(x, y).item()
+        for _ in range(5):
+            l = step(x, y).item()
+        assert np.isfinite(l) and l < l0
+
+    def test_plain_layer_with_pp_rejected(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["pp_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
+        with pytest.raises(ValueError, match="PipelineLayer"):
+            fleet.build_train_step(m, make_loss_fn(), o)
+
+    def test_stage_mesh_mismatch_rejected(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["pp_degree"] = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 8, 8) for _ in range(4)],
+            num_stages=4, loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        o = opt.SGD(parameters=pipe.parameters())
+        with pytest.raises(ValueError, match="pp"):
+            fleet.build_train_step(pipe, None, o)
