@@ -76,6 +76,15 @@ _METHOD_NAMES = [
     "std", "var", "median", "nanmedian", "quantile", "nanquantile", "numel",
     # random
     "multinomial",
+    # remaining tensor_method_func parity (reference
+    # python/paddle/tensor/__init__.py tensor_method_func list)
+    "add_n", "broadcast_shape", "broadcast_tensors", "cholesky_solve",
+    "concat", "cond", "cov", "eigvalsh", "erfinv_", "flatten_",
+    "floor_mod", "gcd", "increment", "inverse", "is_complex", "is_empty",
+    "is_floating_point", "is_integer", "is_tensor", "lcm", "lerp_",
+    "logit", "lu", "lu_unpack", "multi_dot", "multiplex",
+    "put_along_axis_", "rank", "reverse", "scatter_nd", "shard_index",
+    "stack", "stanh", "triangular_solve", "unstack",
 ]
 
 

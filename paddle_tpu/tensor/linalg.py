@@ -134,6 +134,11 @@ def eigvalsh(x, UPLO="L", name=None):
     return apply_op(lambda a: jnp.linalg.eigvalsh(a), x)
 
 
+def inverse(x, name=None):
+    """Alias of inv (reference paddle.inverse)."""
+    return inv(x)
+
+
 def inv(x, name=None):
     return apply_op(jnp.linalg.inv, x)
 

@@ -157,6 +157,17 @@ def swapaxes(x, axis0, axis1, name=None):
     return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x)
 
 
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._bind(out._slot)
+    return x
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (reference fluid.layers.reverse)."""
+    return flip(x, axis)
+
+
 def flip(x, axis, name=None):
     ax = _axes(axis)
     return apply_op(lambda a: jnp.flip(a, axis=ax), x)
@@ -407,3 +418,10 @@ def view(x, shape_or_dtype, name=None):
 
 def view_as(x, other, name=None):
     return reshape(x, other.shape)
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign",
+                    name=None):
+    out = put_along_axis(arr, indices, values, axis, reduce)
+    arr._bind(out._slot)
+    return arr

@@ -90,6 +90,13 @@ reciprocal = _wrap_unary(jnp.reciprocal)
 neg = _wrap_unary(jnp.negative)
 erf = _wrap_unary(lambda x: __import__("jax").scipy.special.erf(x))
 erfinv = _wrap_unary(lambda x: __import__("jax").scipy.special.erfinv(x))
+
+
+def erfinv_(x, name=None):
+    out = erfinv(x)
+    x._bind(out._slot)
+    return x
+
 digamma = _wrap_unary(lambda x: __import__("jax").scipy.special.digamma(x))
 lgamma = _wrap_unary(lambda x: __import__("jax").scipy.special.gammaln(x))
 sigmoid = _wrap_unary(lambda x: __import__("jax").nn.sigmoid(x))
@@ -131,6 +138,12 @@ def lerp(x, y, weight, name=None):
     if isinstance(weight, Tensor):
         return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
     return apply_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+def lerp_(x, y, weight, name=None):
+    out = lerp(x, y, weight)
+    x._bind(out._slot)
+    return x
 
 
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):

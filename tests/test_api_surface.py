@@ -1,0 +1,57 @@
+"""API-surface lock: every reference Tensor method must exist
+(generated from the reference tensor_method_func list; SURVEY
+section 2.1)."""
+import paddle_tpu as paddle
+
+
+TENSOR_METHODS = [
+    'abs', 'acos', 'acosh', 'add', 'add_',
+    'add_n', 'addmm', 'all', 'allclose', 'amax',
+    'amin', 'angle', 'any', 'argmax', 'argmin',
+    'argsort', 'as_complex', 'as_real', 'asin', 'asinh',
+    'atan', 'atanh', 'bincount', 'bitwise_and', 'bitwise_not',
+    'bitwise_or', 'bitwise_xor', 'bmm', 'broadcast_shape', 'broadcast_tensors',
+    'broadcast_to', 'cast', 'ceil', 'ceil_', 'cholesky',
+    'cholesky_solve', 'chunk', 'clip', 'clip_', 'concat',
+    'cond', 'conj', 'cos', 'cosh', 'cov',
+    'cross', 'cumprod', 'cumsum', 'deg2rad', 'diagonal',
+    'diff', 'digamma', 'dist', 'divide', 'dot',
+    'eig', 'eigvals', 'eigvalsh', 'equal', 'equal_all',
+    'erf', 'erfinv', 'erfinv_', 'exp', 'exp_',
+    'expand', 'expand_as', 'exponential_', 'flatten', 'flatten_',
+    'flip', 'floor', 'floor_', 'floor_divide', 'floor_mod',
+    'fmax', 'fmin', 'gather', 'gather_nd', 'gcd',
+    'greater_equal', 'greater_than', 'histogram', 'imag', 'increment',
+    'index_sample', 'index_select', 'inner', 'inverse', 'is_complex',
+    'is_empty', 'is_floating_point', 'is_integer', 'is_tensor', 'isclose',
+    'isfinite', 'isinf', 'isnan', 'kron', 'kthvalue',
+    'lcm', 'lerp', 'lerp_', 'less_equal', 'less_than',
+    'lgamma', 'log', 'log10', 'log1p', 'log2',
+    'logical_and', 'logical_not', 'logical_or', 'logical_xor', 'logit',
+    'logsumexp', 'lstsq', 'lu', 'lu_unpack', 'masked_select',
+    'matmul', 'matrix_power', 'max', 'maximum', 'mean',
+    'median', 'min', 'minimum', 'mm', 'mod',
+    'moveaxis', 'multi_dot', 'multiplex', 'multiply', 'mv',
+    'nansum', 'neg', 'nonzero', 'norm', 'not_equal',
+    'numel', 'outer', 'pow', 'prod', 'put_along_axis',
+    'put_along_axis_', 'qr', 'quantile', 'rad2deg', 'rank',
+    'real', 'reciprocal', 'reciprocal_', 'remainder', 'repeat_interleave',
+    'reshape', 'reshape_', 'reverse', 'roll', 'rot90',
+    'round', 'round_', 'rsqrt', 'rsqrt_', 'scale',
+    'scale_', 'scatter', 'scatter_', 'scatter_nd', 'scatter_nd_add',
+    'shape', 'shard_index', 'sign', 'sin', 'sinh',
+    'slice', 'solve', 'sort', 'split', 'sqrt',
+    'sqrt_', 'square', 'squeeze', 'squeeze_', 'stack',
+    'stanh', 'std', 'strided_slice', 'subtract', 'subtract_',
+    'sum', 't', 'take_along_axis', 'tanh', 'tanh_',
+    'tensordot', 'tile', 'topk', 'trace', 'transpose',
+    'triangular_solve', 'trunc', 'unbind', 'uniform_', 'unique',
+    'unique_consecutive', 'unsqueeze', 'unsqueeze_', 'unstack', 'var',
+    'where',
+]
+
+
+def test_tensor_methods_present():
+    t = paddle.to_tensor([1.0])
+    missing = [n for n in TENSOR_METHODS if not hasattr(t, n)]
+    assert not missing, missing
