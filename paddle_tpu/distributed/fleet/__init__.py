@@ -172,6 +172,14 @@ def build_train_step(model, loss_fn, optimizer, recompute=None,
             f" — a plain Layer cannot be stage-partitioned")
     if recompute is None:
         recompute = strat.recompute
+    if strat.amp and param_dtype is None:
+        # strategy.amp maps to mixed-precision compute: parameters cast
+        # to bf16 (fp16 when use_bf16=False) inside the jitted step; on
+        # TPU bf16 keeps fp32 range so no loss scaling is needed (the
+        # reference's GradScaler path is an fp16 artifact)
+        ac = strat.amp_configs
+        param_dtype = "bfloat16" if ac.get("use_bf16", True) \
+            else "float16"
     if accumulate_steps is None:
         accumulate_steps = strat.pipeline_configs.get("accumulate_steps", 1) \
             if strat.pipeline else \

@@ -178,3 +178,22 @@ class TestHonestStrategy:
         np.testing.assert_allclose(run(k_steps=2),
                                    run(accumulate_steps=2),
                                    rtol=1e-5, atol=1e-6)
+
+    def test_amp_flag_casts_params(self):
+        """strategy.amp must change the compute dtype, not sit inert."""
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        import jax.numpy as jnp
+        strategy = fleet.DistributedStrategy()
+        strategy.amp = True
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = fleet.build_train_step(m, _loss_fn(), o)
+        pk = "gpt.h.0.attn.qkv_proj.weight"
+        assert step.params[pk].dtype == jnp.bfloat16
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        l0 = step(ids, ids).item()
+        l1 = step(ids, ids).item()
+        assert np.isfinite(l0) and np.isfinite(l1)
