@@ -21,21 +21,23 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "get_mesh",
            "is_initialized"]
 
 
-def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, devices=None):
+def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, ep=1,
+               devices=None):
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * sharding * pp * mp * sp
+    need = dp * sharding * pp * mp * sp * ep
     if need > len(devices):
         raise ValueError(
-            f"mesh {dp}x{sharding}x{pp}x{mp}x{sp}={need} exceeds "
+            f"mesh {dp}x{sharding}x{pp}x{mp}x{sp}x{ep}={need} exceeds "
             f"{len(devices)} devices")
     if need < len(devices):
         # absorb the remainder into dp (reference: fleet auto-infers
         # dp_degree as world_size / (mp*pp*sharding))
         dp = len(devices) // (sharding * pp * mp * sp)
-        need = dp * sharding * pp * mp * sp
+        need = dp * sharding * pp * mp * sp * ep
         devices = devices[:need]
-    arr = np.array(devices).reshape(dp, sharding, pp, mp, sp)
-    axis_names = ("dp", "sharding", "pp", "mp", "sp")
+    arr = np.array(devices).reshape(dp, sharding, pp, mp, sp,
+                                    ep)
+    axis_names = ("dp", "sharding", "pp", "mp", "sp", "ep")
     return Mesh(arr, axis_names)
 
 

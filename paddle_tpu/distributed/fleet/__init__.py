@@ -33,10 +33,10 @@ def init(role_maker=None, is_collective=True, strategy=None):
     _state["strategy"] = strategy
     hc = strategy.hybrid_configs
     topo = CommunicateTopology(
-        ("data", "sharding", "pipe", "model", "sep"),
+        ("data", "sharding", "pipe", "model", "sep", "expert"),
         (hc.get("dp_degree", 1), hc.get("sharding_degree", 1),
          hc.get("pp_degree", 1), hc.get("mp_degree", 1),
-         hc.get("sep_degree", 1)))
+         hc.get("sep_degree", 1), hc.get("ep_degree", 1)))
     _state["hcg"] = HybridCommunicateGroup(topo)
     _state["role_maker"] = role_maker
     if role_maker is not None:

@@ -54,7 +54,7 @@ class HybridCommunicateGroup:
     process-level info for API parity."""
 
     AXIS_MAP = {"data": "dp", "sharding": "sharding", "pipe": "pp",
-                "model": "mp", "sep": "sp"}
+                "model": "mp", "sep": "sp", "expert": "ep"}
 
     def __init__(self, topology):
         self._topo = topology
@@ -64,7 +64,8 @@ class HybridCommunicateGroup:
                                sharding=dims.get("sharding", 1),
                                pp=dims.get("pipe", 1),
                                mp=dims.get("model", 1),
-                               sp=dims.get("sep", 1))
+                               sp=dims.get("sep", 1),
+                               ep=dims.get("expert", 1))
         set_mesh(self.mesh)
         self._dims = dims
 

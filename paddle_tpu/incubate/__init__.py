@@ -347,5 +347,10 @@ class nn:
 nn.FusedMultiHeadAttention, nn.FusedFeedForward = _fused_layers()
 
 
+from .moe import MoELayer as _MoELayer  # noqa: E402
+
+nn.MoELayer = _MoELayer
+
+
 LookAhead = optimizer.LookAhead
 ModelAverage = optimizer.ModelAverage

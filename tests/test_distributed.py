@@ -26,7 +26,7 @@ class TestMesh:
     def test_build_mesh_axes(self):
         mesh = build_mesh(dp=2, mp=2, sharding=2)
         assert dict(mesh.shape) == {"dp": 2, "sharding": 2, "pp": 1,
-                                    "mp": 2, "sp": 1}
+                                    "mp": 2, "sp": 1, "ep": 1}
 
     def test_fleet_init_topology(self):
         strategy = fleet.DistributedStrategy()
