@@ -32,7 +32,7 @@ def build_mesh(dp=1, sharding=1, pp=1, mp=1, sp=1, ep=1,
     if need < len(devices):
         # absorb the remainder into dp (reference: fleet auto-infers
         # dp_degree as world_size / (mp*pp*sharding))
-        dp = len(devices) // (sharding * pp * mp * sp)
+        dp = len(devices) // (sharding * pp * mp * sp * ep)
         need = dp * sharding * pp * mp * sp * ep
         devices = devices[:need]
     arr = np.array(devices).reshape(dp, sharding, pp, mp, sp,

@@ -23,7 +23,7 @@ class DistributedStrategy:
         # hybrid parallel degrees (consumed by fleet.init → Mesh axes)
         self.hybrid_configs = _Cfg({
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sharding_degree": 1, "sep_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1,
         })
         # feature switches — each maps to a TPU-native mechanism
         self.amp = False                      # bf16/fp16 autocast policy

@@ -154,12 +154,17 @@ class HybridTrainStep:
 
         def loss_of(ps, bufs, key, micro):
             def run(inputs):
+                from ...jit.api import (reset_aux_losses,
+                                        collect_aux_losses)
+                reset_aux_losses(model_ref)
                 out = functional_call(model_ref, ps, bufs, inputs[:-1],
                                       rng_key=key, training=True)
                 tgt = Tensor(inputs[-1])
                 l = loss_fn(out if isinstance(out, Tensor) else Tensor(out),
                             tgt)
-                return l.value if isinstance(l, Tensor) else l
+                l = l.value if isinstance(l, Tensor) else l
+                aux = collect_aux_losses(model_ref)
+                return l if aux is None else l + aux.astype(l.dtype)
             if recompute:
                 run = jax.checkpoint(run)
             return run(micro)

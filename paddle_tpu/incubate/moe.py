@@ -13,13 +13,13 @@ shapes, no host routing: the whole layer jits into one program.
     y = moe(x)           # [B, T, D] -> [B, T, D]
     loss = task_loss + 0.01 * moe.aux_loss()   # load-balancing loss
 """
+import functools
 import math
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..framework.core import Tensor, apply_op
+from ..framework.core import apply_op
 from .. import nn
 
 __all__ = ["MoELayer"]
@@ -37,7 +37,6 @@ def _moe_forward(x2d, gate_w, w1, b1, w2, b2, *, top_k, capacity,
 
     # iterative top-k routing with per-expert capacity positions
     remaining = probs
-    taken = jnp.zeros((N, E), jnp.float32)              # chosen mask so far
     counts = jnp.zeros((E,), jnp.float32)               # slots used
     dispatch = jnp.zeros((N, E, capacity), jnp.float32)
     combine = jnp.zeros((N, E, capacity), jnp.float32)
@@ -60,7 +59,6 @@ def _moe_forward(x2d, gate_w, w1, b1, w2, b2, *, top_k, capacity,
         gate_sum = gate_sum + g
         counts = counts + jnp.sum(onehot * keep[:, None], axis=0)
         frac_tokens = frac_tokens + jnp.mean(onehot, axis=0)
-        taken = taken + onehot
         remaining = remaining * (1.0 - onehot)
     # normalize combine weights over the chosen experts (GShard)
     combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
@@ -85,7 +83,8 @@ class MoELayer(nn.Layer):
     'ep' axis in the mesh the layer still runs (experts replicated)."""
 
     def __init__(self, d_model, d_hidden, num_experts, top_k=2,
-                 capacity_factor=1.25, activation="gelu", name=None):
+                 capacity_factor=1.25, activation="gelu",
+                 aux_loss_weight=0.01, name=None):
         super().__init__()
         if top_k < 1 or top_k > num_experts:
             raise ValueError(f"top_k={top_k} out of range for "
@@ -95,10 +94,17 @@ class MoELayer(nn.Layer):
         self.num_experts = num_experts
         self.top_k = top_k
         self.capacity_factor = float(capacity_factor)
-        self._act = getattr(jax.nn, activation)
-        rng = np.random.RandomState(hash(name or "moe") % (2 ** 31))
+        # exact (erf) gelu — jax.nn.gelu defaults to the tanh
+        # approximation, which diverges from paddle's gelu semantics
+        if activation == "gelu":
+            self._act = functools.partial(jax.nn.gelu, approximate=False)
+        else:
+            self._act = getattr(jax.nn, activation)
+        # consumed by TrainStep/HybridTrainStep: aux_loss_weight *
+        # load-balancing loss is added to the task loss inside the
+        # jitted step (user adds aux_loss() manually in eager loops)
+        self.aux_loss_weight = float(aux_loss_weight)
         s = 0.02
-        from ..framework.core import Parameter
         self.gate_weight = self.create_parameter(
             [d_model, num_experts],
             default_initializer=nn.initializer.Normal(0.0, s))
@@ -143,8 +149,18 @@ class MoELayer(nn.Layer):
         return out
 
     def aux_loss(self):
-        """Load-balancing loss of the most recent forward (add it to the
-        task loss, typically weighted 1e-2)."""
+        """Load-balancing loss of the most recent EAGER forward (add it
+        to the task loss manually). Under TrainStep / fleet's
+        build_train_step the aux loss is added to the task loss inside
+        the jitted step automatically (weight = aux_loss_weight), so
+        this accessor is eager-only."""
         if self._last_aux is None:
             raise RuntimeError("aux_loss() before any forward()")
+        val = self._last_aux.value if hasattr(self._last_aux, "value") \
+            else self._last_aux
+        if isinstance(val, jax.core.Tracer):
+            raise RuntimeError(
+                "aux_loss() after a jitted step: the load-balancing loss "
+                "was already added inside the compiled program "
+                "(aux_loss_weight); call aux_loss() only in eager loops")
         return self._last_aux

@@ -82,6 +82,29 @@ def not_to_static(fn):
     return fn
 
 
+def reset_aux_losses(model):
+    """Drop any stale per-layer auxiliary-loss records (e.g. a tracer
+    leaked from a previous trace) before a fresh forward."""
+    for layer in model.sublayers(include_self=True):
+        if hasattr(layer, "_last_aux"):
+            layer._last_aux = None
+
+
+def collect_aux_losses(model):
+    """Sum of `aux_loss_weight * aux` over sublayers that recorded an
+    auxiliary loss during the forward just run under the CURRENT trace
+    (MoE load-balancing etc.). Returns None when there is none."""
+    total = None
+    for layer in model.sublayers(include_self=True):
+        aux = getattr(layer, "_last_aux", None)
+        w = getattr(layer, "aux_loss_weight", 0.0)
+        if aux is not None and w:
+            a = aux.value if isinstance(aux, Tensor) else aux
+            term = w * a
+            total = term if total is None else total + term
+    return total
+
+
 class StaticFunction:
     """Compiled wrapper around a Layer or a Tensor function.
     Parity: TranslatedLayer / StaticFunction in the reference."""
@@ -194,12 +217,15 @@ class TrainStep:
 
         def step_fn(params, opt_state, buffers, key, lr, step_i, *batch):
             def loss_of(ps):
+                reset_aux_losses(model)
                 out = functional_call(model, ps, buffers, batch[:-1],
                                       rng_key=key, training=True)
                 tgt = Tensor(batch[-1])
                 loss_t = loss_fn(
                     out if isinstance(out, Tensor) else Tensor(out), tgt)
-                return loss_t.value if isinstance(loss_t, Tensor) else loss_t
+                l = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+                aux = collect_aux_losses(model)
+                return l if aux is None else l + aux.astype(l.dtype)
 
             loss, grads = jax.value_and_grad(loss_of)(params)
             clip = self.optimizer._grad_clip

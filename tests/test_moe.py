@@ -127,3 +127,61 @@ class TestMoE:
         base = run(1)
         par = run(4)
         np.testing.assert_allclose(base, par, rtol=1e-4, atol=1e-5)
+
+
+class TestAuxLossInJittedStep:
+    """The load-balancing loss must be added INSIDE TrainStep/fleet's
+    compiled program (loss_fn can't reach it), and aux_loss() must fail
+    loudly rather than hand back a leaked tracer afterwards."""
+
+    def _net(self):
+        class MoENet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.embed = nn.Embedding(64, 16)
+                self.moe = incubate.nn.MoELayer(16, 32, num_experts=4,
+                                                top_k=2,
+                                                aux_loss_weight=0.5)
+                self.head = nn.Linear(16, 64)
+
+            def forward(self, ids):
+                return self.head(self.moe(self.embed(ids)))
+        paddle.seed(0)
+        return MoENet()
+
+    def test_trainstep_loss_includes_aux(self):
+        from paddle_tpu.jit import TrainStep
+        m = self._net()
+
+        def loss_fn(out, y):
+            return nn.functional.cross_entropy(
+                out.reshape([-1, 64]), y.reshape([-1]))
+
+        o = opt.SGD(learning_rate=0.0, parameters=m.parameters())
+        step = TrainStep(m, loss_fn, o)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 64, size=(4, 8)))
+        jitted_loss = float(step(ids, ids).item())
+
+        logits = m(ids)  # eager forward with the same (lr=0) params
+        task = float(loss_fn(logits, ids).item())
+        aux = float(m.moe.aux_loss().item())
+        np.testing.assert_allclose(jitted_loss, task + 0.5 * aux,
+                                   rtol=1e-5)
+
+    def test_aux_accessor_refuses_leaked_tracer(self):
+        import pytest
+        from paddle_tpu.jit import TrainStep
+        m = self._net()
+
+        def loss_fn(out, y):
+            return nn.functional.cross_entropy(
+                out.reshape([-1, 64]), y.reshape([-1]))
+
+        o = opt.SGD(learning_rate=0.0, parameters=m.parameters())
+        step = TrainStep(m, loss_fn, o)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 64, size=(4, 8)))
+        step(ids, ids)
+        with pytest.raises(RuntimeError, match="jitted step"):
+            m.moe.aux_loss()
