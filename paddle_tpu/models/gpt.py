@@ -20,7 +20,8 @@ from ..nn import functional as F
 from ..nn import initializer as I
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny",
-           "gpt_small", "gpt_medium", "gpt_1p3b", "gpt_6p7b"]
+           "gpt_small", "gpt_medium", "gpt_1p3b", "gpt_6p7b",
+           "gpt_moe"]
 
 
 class GPTConfig:
@@ -29,7 +30,8 @@ class GPTConfig:
                  max_position_embeddings=1024, dropout=0.0,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
                  use_bias=True, scan_layers=True, scan_remat=False,
-                 sequence_parallel=False):
+                 sequence_parallel=False, num_experts=0, moe_every=2,
+                 moe_top_k=2, moe_capacity_factor=1.25):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -51,6 +53,13 @@ class GPTConfig:
         # axis; attention runs as ring attention (K/V shards rotate via
         # ppermute, online-softmax merge) — exact, long-context capable
         self.sequence_parallel = sequence_parallel
+        # num_experts > 0: every `moe_every`-th block swaps its MLP for
+        # an expert-parallel MoELayer (experts shard over 'ep'); the
+        # heterogeneous stack disables the scan-over-layers path
+        self.num_experts = num_experts
+        self.moe_every = moe_every
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
 
 
 class StaticCacheSlot:
@@ -144,14 +153,21 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg):
+    def __init__(self, cfg, use_moe=False):
         super().__init__()
         self.ln_1 = nn.LayerNorm(cfg.hidden_size,
                                  epsilon=cfg.layer_norm_epsilon)
         self.attn = GPTAttention(cfg)
         self.ln_2 = nn.LayerNorm(cfg.hidden_size,
                                  epsilon=cfg.layer_norm_epsilon)
-        self.mlp = GPTMLP(cfg)
+        if use_moe:
+            from ..incubate.moe import MoELayer
+            self.mlp = MoELayer(cfg.hidden_size, cfg.intermediate_size,
+                                num_experts=cfg.num_experts,
+                                top_k=cfg.moe_top_k,
+                                capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(cfg)
 
     def forward(self, x, cache=None):
         if cache is not None:
@@ -173,8 +189,11 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(cfg.max_position_embeddings,
                                 cfg.hidden_size, weight_attr=w_init)
         self.drop = nn.Dropout(cfg.dropout)
-        self.h = nn.LayerList([GPTBlock(cfg)
-                               for _ in range(cfg.num_layers)])
+        self.h = nn.LayerList([
+            GPTBlock(cfg, use_moe=(cfg.num_experts > 0
+                                   and i % cfg.moe_every
+                                   == cfg.moe_every - 1))
+            for i in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size,
                                  epsilon=cfg.layer_norm_epsilon)
 
@@ -210,6 +229,7 @@ class GPTModel(nn.Layer):
         layers are inert in eval mode, so eval always qualifies)."""
         import jax
         return (self.cfg.scan_layers and self.cfg.num_layers > 1
+                and self.cfg.num_experts == 0  # MoE blocks: not uniform
                 and (self.cfg.dropout == 0.0 or not self.training)
                 and isinstance(x.value, jax.core.Tracer))
 
@@ -377,3 +397,12 @@ def gpt_1p3b():
 def gpt_6p7b():
     return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
                      max_position_embeddings=2048)
+
+
+def gpt_moe(num_experts=8, **kw):
+    """MoE flagship: GPT-small trunk with every 2nd MLP an
+    expert-parallel MoELayer (experts shard over 'ep')."""
+    kw.setdefault("hidden_size", 768)
+    kw.setdefault("num_layers", 12)
+    kw.setdefault("num_heads", 12)
+    return GPTConfig(num_experts=num_experts, **kw)

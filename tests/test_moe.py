@@ -185,3 +185,45 @@ class TestAuxLossInJittedStep:
         step(ids, ids)
         with pytest.raises(RuntimeError, match="jitted step"):
             m.moe.aux_loss()
+
+
+class TestGPTMoE:
+    """GPT with MoE blocks (gpt_moe): eager training, and dp x ep fleet
+    training with the aux loss folded in automatically."""
+
+    def _cfg(self):
+        from paddle_tpu.models.gpt import GPTConfig
+        return GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                         num_heads=4, max_position_embeddings=32,
+                         dropout=0.0, num_experts=4, moe_every=2)
+
+    def test_moe_blocks_placed(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        from paddle_tpu.incubate.moe import MoELayer
+        paddle.seed(0)
+        m = GPTForCausalLM(self._cfg())
+        kinds = [type(b.mlp).__name__ for b in m.gpt.h]
+        assert kinds == ["GPTMLP", "MoELayer", "GPTMLP", "MoELayer"]
+
+    def test_trains_through_fleet_dp_ep(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 2
+        strategy.hybrid_configs["ep_degree"] = 4
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(self._cfg())
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+
+        def loss_fn(out, y):
+            return nn.functional.cross_entropy(
+                out.reshape([-1, 128]), y.reshape([-1]))
+
+        step = fleet.build_train_step(m, loss_fn, o)
+        assert "ep" in str(step.params["gpt.h.1.mlp.w1"].sharding.spec)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 128, size=(8, 16)))
+        l0 = step(ids, ids).item()
+        for _ in range(3):
+            l = step(ids, ids).item()
+        assert np.isfinite(l) and l < l0
