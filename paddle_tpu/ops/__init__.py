@@ -40,3 +40,8 @@ def fused_layer_norm_available():
 def fused_layer_norm(x, weight, bias, eps=1e-5):
     from .pallas.layer_norm import layer_norm as ln
     return ln(x, weight, bias, eps)
+
+
+from .block_sparse import (block_sparse_attention,  # noqa: E402
+                           block_sparse_attention_arrays,
+                           local_strided_pattern)

@@ -101,3 +101,98 @@ class TestSparseAttention:
         out.sum().backward()
         assert q.grad is not None
         assert np.isfinite(q.grad.numpy()).all()
+
+
+class TestBlockSparseAttention:
+    """TPU-native block-sparse attention: numerics vs dense-with-mask,
+    differentiability, and a MEASURED flop reduction vs dense (the point
+    the per-token CSR path cannot deliver on MXUs)."""
+
+    def _setup(self, T=32, bs=8, window=1, causal=False):
+        from paddle_tpu.ops.block_sparse import (
+            block_sparse_attention_arrays, local_strided_pattern)
+        rng = np.random.RandomState(0)
+        B, H, D = 2, 2, 4
+        q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+                   for _ in range(3))
+        idx, cnt = local_strided_pattern(T // bs, window=window)
+        return q, k, v, idx, cnt, bs
+
+    def _dense_ref(self, q, k, v, idx, cnt, bs, causal):
+        B, T, H, D = q.shape
+        n_qb = T // bs
+        mask = np.zeros((T, T), bool)
+        idxn, cntn = np.asarray(idx), np.asarray(cnt)
+        for qb in range(n_qb):
+            for m in range(cntn[qb]):
+                kb = idxn[qb, m]
+                mask[qb * bs:(qb + 1) * bs, kb * bs:(kb + 1) * bs] = True
+        if causal:
+            mask &= np.tril(np.ones((T, T), bool))
+        s = np.einsum("bqhd,bkhd->bhqk", np.asarray(q), np.asarray(k))
+        s = s / np.sqrt(D)
+        s = np.where(mask, s, -1e30)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v))
+
+    def test_matches_dense_masked(self):
+        from paddle_tpu.ops.block_sparse import \
+            block_sparse_attention_arrays
+        for causal in (False, True):
+            q, k, v, idx, cnt, bs = self._setup(causal=causal)
+            out = jax.jit(lambda q, k, v: block_sparse_attention_arrays(
+                q, k, v, idx, cnt, bs, causal=causal))(q, k, v)
+            ref = self._dense_ref(q, k, v, idx, cnt, bs, causal)
+            np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_differentiable(self):
+        from paddle_tpu.ops.block_sparse import \
+            block_sparse_attention_arrays
+        q, k, v, idx, cnt, bs = self._setup()
+        g = jax.jit(jax.grad(lambda q: block_sparse_attention_arrays(
+            q, k, v, idx, cnt, bs).sum()))(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_fewer_flops_than_dense(self):
+        """Compiled cost analysis must show a real FLOP reduction at a
+        sparse-friendly size (T=256, window-1 pattern ≈ 3/32 density)."""
+        from paddle_tpu.ops.block_sparse import (
+            block_sparse_attention_arrays, local_strided_pattern)
+        rng = np.random.RandomState(0)
+        B, T, H, D, bs = 1, 256, 2, 16, 32
+        q, k, v = (jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+                   for _ in range(3))
+        idx, cnt = local_strided_pattern(T // bs, window=1)
+
+        def sparse(q, k, v):
+            return block_sparse_attention_arrays(q, k, v, idx, cnt, bs)
+
+        def dense(q, k, v):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+            return jnp.einsum("bhqk,bkhd->bqhd",
+                              jax.nn.softmax(s, -1), v)
+
+        def flops(fn):
+            c = jax.jit(fn).lower(q, k, v).compile().cost_analysis()
+            if isinstance(c, (list, tuple)):
+                c = c[0]
+            return float(c.get("flops", 0.0))
+
+        fs, fd = flops(sparse), flops(dense)
+        assert fs > 0 and fd > 0
+        assert fs < 0.55 * fd, f"sparse {fs} not beating dense {fd}"
+
+    def test_tensor_level_entry_with_tape(self):
+        from paddle_tpu.ops.block_sparse import (block_sparse_attention,
+                                                 local_strided_pattern)
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(1, 16, 2, 4).astype(np.float32),
+                             stop_gradient=False)
+        k = paddle.to_tensor(rng.randn(1, 16, 2, 4).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(1, 16, 2, 4).astype(np.float32))
+        idx, cnt = local_strided_pattern(4, window=1)
+        out = block_sparse_attention(q, k, v, idx, cnt, 4)
+        out.sum().backward()
+        assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
