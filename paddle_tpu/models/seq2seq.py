@@ -1,0 +1,103 @@
+"""Transformer encoder-decoder seq2seq (machine-translation family).
+
+The reference ships this family as its flagship nn.Transformer use
+(fluid tests + book examples: "Transformer for MT"); here it is a
+first-class model on top of paddle_tpu.nn.Transformer with shared
+target embedding/generator weights and greedy decode.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["Seq2SeqConfig", "Seq2SeqTransformer"]
+
+
+class Seq2SeqConfig:
+    def __init__(self, src_vocab_size=32000, tgt_vocab_size=32000,
+                 d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 max_position_embeddings=512, pad_id=0, bos_id=1,
+                 eos_id=2):
+        self.src_vocab_size = src_vocab_size
+        self.tgt_vocab_size = tgt_vocab_size
+        self.d_model = d_model
+        self.nhead = nhead
+        self.num_encoder_layers = num_encoder_layers
+        self.num_decoder_layers = num_decoder_layers
+        self.dim_feedforward = dim_feedforward
+        self.dropout = dropout
+        self.max_position_embeddings = max_position_embeddings
+        self.pad_id = pad_id
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+
+
+class Seq2SeqTransformer(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.src_embed = nn.Embedding(cfg.src_vocab_size, cfg.d_model)
+        self.tgt_embed = nn.Embedding(cfg.tgt_vocab_size, cfg.d_model)
+        self.pos_embed = nn.Embedding(cfg.max_position_embeddings,
+                                      cfg.d_model)
+        self.transformer = nn.Transformer(
+            d_model=cfg.d_model, nhead=cfg.nhead,
+            num_encoder_layers=cfg.num_encoder_layers,
+            num_decoder_layers=cfg.num_decoder_layers,
+            dim_feedforward=cfg.dim_feedforward, dropout=cfg.dropout)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.scale = float(np.sqrt(cfg.d_model))
+
+    def _embed(self, table, ids):
+        T = ids.shape[1]
+        from ..tensor.creation import arange
+        pos = arange(0, T, dtype="int64").unsqueeze(0)
+        return self.drop(table(ids) * self.scale + self.pos_embed(pos))
+
+    def _pad_mask(self, ids):
+        # additive mask broadcastable to [B, nhead, Tq, Tk]
+        neg = (ids.value == self.cfg.pad_id)
+        m = jnp.where(neg[:, None, None, :], jnp.float32(-1e9),
+                      jnp.float32(0.0))
+        return Tensor(m)
+
+    def forward(self, src_ids, tgt_ids):
+        """Teacher-forcing logits [B, T_tgt, tgt_vocab]; the generator
+        shares the target embedding matrix (tied weights)."""
+        src = self._embed(self.src_embed, src_ids)
+        tgt = self._embed(self.tgt_embed, tgt_ids)
+        tgt_mask = nn.Transformer.generate_square_subsequent_mask(
+            tgt_ids.shape[1])
+        out = self.transformer(
+            src, tgt, src_mask=self._pad_mask(src_ids),
+            tgt_mask=tgt_mask, memory_mask=self._pad_mask(src_ids))
+        from ..tensor.linalg import matmul
+        return matmul(out, self.tgt_embed.weight, transpose_y=True)
+
+    def loss(self, src_ids, tgt_ids, label_ids):
+        logits = self(src_ids, tgt_ids)
+        V = logits.shape[-1]
+        return F.cross_entropy(logits.reshape([-1, V]),
+                               label_ids.reshape([-1]),
+                               ignore_index=self.cfg.pad_id)
+
+    def greedy_decode(self, src_ids, max_len=32):
+        """Greedy decoding; one forward per emitted token (the decoder
+        stack is small relative to the encoder, and shapes stay in a
+        per-length jit cache)."""
+        B = src_ids.shape[0]
+        out = np.full((B, 1), self.cfg.bos_id, np.int64)
+        finished = np.zeros((B,), bool)
+        for _ in range(max_len):
+            logits = self(src_ids, Tensor(jnp.asarray(out)))
+            nxt = np.asarray(logits.value[:, -1, :].argmax(-1))
+            nxt = np.where(finished, self.cfg.pad_id, nxt)
+            finished |= nxt == self.cfg.eos_id
+            out = np.concatenate([out, nxt[:, None]], axis=1)
+            if finished.all():
+                break
+        return Tensor(jnp.asarray(out))
