@@ -293,7 +293,7 @@ class GPTForCausalLM(nn.Layer):
                                labels.reshape([-1]), ignore_index=-100)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None):
+                 top_k=None, top_p=None):
         """Top-k/temperature sampling over a STATIC KV cache.
 
         Exactly two compiled programs regardless of max_new_tokens: one
@@ -334,6 +334,18 @@ class GPTForCausalLM(nn.Layer):
             if top_k is not None:
                 kth = jax.lax.top_k(arr, top_k)[0][:, -1:]
                 arr = jnp.where(arr < kth, -1e30, arr)
+            if top_p is not None:
+                # nucleus: keep the smallest prefix of the sorted probs
+                # whose mass reaches top_p (a token stays iff the mass
+                # BEFORE it is < top_p)
+                srt = jnp.sort(arr, axis=-1)[:, ::-1]
+                p_srt = jax.nn.softmax(srt, axis=-1)
+                before = jnp.cumsum(p_srt, axis=-1) - p_srt
+                keep_srt = before < top_p
+                # threshold logit = smallest kept logit per row
+                thresh = jnp.min(jnp.where(keep_srt, srt, jnp.inf),
+                                 axis=-1, keepdims=True)
+                arr = jnp.where(arr >= thresh, arr, -1e30)
             return jax.random.categorical(key, arr)[:, None]
 
         def prefill(ps, ids, key, temp):
@@ -354,7 +366,7 @@ class GPTForCausalLM(nn.Layer):
                                    jnp.arange(max_new_tokens - 1))
             return jnp.concatenate([first_tok, toks.T], axis=1)
 
-        sig = (B, T, max_new_tokens, top_k)
+        sig = (B, T, max_new_tokens, top_k, top_p)
         cache = getattr(self, "_gen_jit", None)
         if cache is None:
             cache = self._gen_jit = {}
