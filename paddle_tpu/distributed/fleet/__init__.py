@@ -124,6 +124,17 @@ def build_train_step(model, loss_fn, optimizer, recompute=None,
                 f"DistributedStrategy.{flag} is not implemented in "
                 f"paddle_tpu; unset it or use supported strategies "
                 f"(amp/recompute/sharding/gradient_merge/lars/lamb)")
+    if strat.lamb:
+        from ...optimizer import Adam, AdamW, Lamb
+        if isinstance(optimizer, Adam) and not isinstance(optimizer, Lamb):
+            cfg = strat.lamb_configs
+            optimizer = Lamb(
+                learning_rate=optimizer._learning_rate,
+                lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+                beta1=optimizer._beta1, beta2=optimizer._beta2,
+                epsilon=optimizer._epsilon,
+                parameters=optimizer._parameters,
+                grad_clip=optimizer._grad_clip)
     if strat.lars:
         from ...optimizer import Momentum, LarsMomentum
         if isinstance(optimizer, Momentum) and \

@@ -197,3 +197,20 @@ class TestHonestStrategy:
         l0 = step(ids, ids).item()
         l1 = step(ids, ids).item()
         assert np.isfinite(l0) and np.isfinite(l1)
+
+    def test_lamb_swaps_optimizer(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        strategy = fleet.DistributedStrategy()
+        strategy.lamb = True
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        o = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+        step = fleet.build_train_step(m, _loss_fn(), o)
+        from paddle_tpu.optimizer import Lamb
+        assert isinstance(step.optimizer, Lamb)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
+        l0 = step(ids, ids).item()
+        l1 = step(ids, ids).item()
+        assert np.isfinite(l0) and l1 < l0
