@@ -19,12 +19,8 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", "tests must run on the CPU backend"
 assert jax.device_count() == 8, "expected 8 virtual CPU devices"
 
-# persistent compilation cache: the suite is compile-bound (single-core
-# hosts spend >80% of wall time in XLA), so cache compiled executables
-# across runs — repeat runs drop from ~8min to well under the 5min
-# SURVEY §4 CI budget.
-_cache_dir = os.environ.get("PADDLE_TPU_TEST_CACHE",
-                            os.path.expanduser("~/.cache/paddle_tpu_xla"))
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# NOTE: a persistent XLA compilation cache was tried here and removed —
+# on this suite the wall time is tracing/eager dispatch, not XLA
+# compiles, and the CPU AOT entries reload with machine-feature
+# mismatch warnings (potential SIGILL per cpu_aot_loader). The wall-
+# clock answer is the two-tier gate in pytest.ini instead.
