@@ -1,0 +1,131 @@
+"""Edge-case op semantics vs numpy oracles — the op_test.py-style corner
+coverage the reference's unittests sweep (0-d, empty, broadcasting,
+dtype promotion, negative axes, nan propagation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestZeroDim:
+    def test_scalar_tensor_ops(self):
+        a = paddle.to_tensor(3.0)
+        b = paddle.to_tensor(4.0)
+        assert a.shape == []
+        assert float((a * b).item()) == 12.0
+        assert (a + b).shape == []
+        assert float(a.sqrt().item()) == pytest.approx(np.sqrt(3.0))
+
+    def test_scalar_reduction_and_grad(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward()
+        assert float(x.grad.item()) == 4.0
+
+    def test_zero_dim_broadcast(self):
+        s = paddle.to_tensor(2.0)
+        m = paddle.to_tensor(np.ones((2, 3), np.float32))
+        np.testing.assert_allclose((s * m).numpy(), 2 * np.ones((2, 3)))
+
+
+class TestEmptyTensors:
+    def test_empty_creation_and_concat(self):
+        e = paddle.to_tensor(np.zeros((0, 4), np.float32))
+        assert e.shape == [0, 4]
+        full = paddle.concat([e, paddle.ones([2, 4])], axis=0)
+        assert full.shape == [2, 4]
+
+    def test_empty_reductions(self):
+        e = paddle.to_tensor(np.zeros((0,), np.float32))
+        assert float(e.sum().item()) == 0.0
+        assert bool(paddle.is_empty(e).item())
+
+    def test_boolean_mask_can_be_empty(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        m = paddle.to_tensor(np.array([False, False]))
+        out = paddle.masked_select(x, m)
+        assert out.shape == [0]
+
+
+class TestBroadcasting:
+    def test_matches_numpy_rules(self):
+        rng = np.random.RandomState(0)
+        cases = [((3, 1, 4), (2, 4)), ((1,), (5, 1)), ((2, 3), (3,)),
+                 ((4, 1, 1), (1, 3, 5))]
+        for sa, sb in cases:
+            a = rng.randn(*sa).astype(np.float32)
+            b = rng.randn(*sb).astype(np.float32)
+            got = (paddle.to_tensor(a) + paddle.to_tensor(b)).numpy()
+            np.testing.assert_allclose(got, a + b, rtol=1e-6)
+
+    def test_incompatible_shapes_raise(self):
+        a = paddle.to_tensor(np.ones((3, 2), np.float32))
+        b = paddle.to_tensor(np.ones((3, 4), np.float32))
+        with pytest.raises(Exception):
+            (a + b).numpy()
+
+    def test_broadcast_shape_api(self):
+        assert paddle.broadcast_shape([3, 1, 4], [2, 4]) == [3, 2, 4]
+
+
+class TestDtypeSemantics:
+    def test_int_float_promotion_via_scalar(self):
+        i = paddle.to_tensor(np.array([1, 2], np.int64))
+        out = i * 2.5
+        assert "float" in str(out.dtype)
+        np.testing.assert_allclose(out.numpy(), [2.5, 5.0])
+
+    def test_bool_tensor_logic(self):
+        a = paddle.to_tensor(np.array([True, False]))
+        b = paddle.to_tensor(np.array([True, True]))
+        np.testing.assert_array_equal(
+            paddle.logical_and(a, b).numpy(), [True, False])
+        np.testing.assert_array_equal(
+            paddle.logical_not(a).numpy(), [False, True])
+
+    def test_cast_round_trip(self):
+        x = paddle.to_tensor(np.array([1.7, -2.3], np.float32))
+        i = x.cast("int32")
+        assert i.numpy().dtype == np.int32
+        np.testing.assert_array_equal(i.numpy(), [1, -2])  # trunc
+
+
+class TestAxesAndKeepdim:
+    def test_negative_axis_everywhere(self):
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+        np.testing.assert_allclose(x.sum(axis=-1).numpy(),
+                                   x.numpy().sum(-1))
+        np.testing.assert_allclose(x.max(axis=-2).numpy(),
+                                   x.numpy().max(-2))
+        assert x.unsqueeze(-1).shape == [2, 3, 4, 1]
+        assert x.squeeze(-1).shape == [2, 3, 4]  # no-op (not size 1)
+
+    def test_keepdim(self):
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        assert x.sum(axis=1, keepdim=True).shape == [2, 1]
+        assert x.mean(axis=0, keepdim=False).shape == [3]
+
+
+class TestNaNSemantics:
+    def test_nan_propagation_and_nansum(self):
+        x = paddle.to_tensor(np.array([1.0, np.nan, 2.0], np.float32))
+        assert np.isnan(float(x.sum().item()))
+        assert float(paddle.nansum(x).item()) == 3.0
+        np.testing.assert_array_equal(paddle.isnan(x).numpy(),
+                                      [False, True, False])
+
+    def test_nan_in_max_min(self):
+        x = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        # jnp/np semantics: nan wins max
+        assert np.isnan(float(x.max().item()))
+        assert float(paddle.fmax(
+            paddle.to_tensor(np.array([np.nan], np.float32)),
+            paddle.to_tensor(np.array([2.0], np.float32))).item()) == 2.0
+
+    def test_isfinite_family(self):
+        x = paddle.to_tensor(np.array([1.0, np.inf, -np.inf, np.nan],
+                                      np.float32))
+        np.testing.assert_array_equal(
+            paddle.isfinite(x).numpy(), [True, False, False, False])
+        np.testing.assert_array_equal(
+            paddle.isinf(x).numpy(), [False, True, True, False])
