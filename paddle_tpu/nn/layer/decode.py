@@ -72,10 +72,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
         logits, states = decoder.step(cur, states)
         logp = jax.nn.log_softmax(logits.value.astype(jnp.float32), -1)
         V = logp.shape[-1]
-        logp = np.asarray(logp).reshape(B, beam, V)
-        # frozen finished beams only extend with end_token
+        logp = np.array(logp).reshape(B, beam, V)  # writable copy
+        # frozen finished beams only extend with end_token (score 0)
         logp[finished] = neg_inf
-        logp[finished, :] = neg_inf
         logp[finished, decoder.end_token] = 0.0
         total = scores[:, :, None] + logp
         flat = total.reshape(B, beam * V)
