@@ -146,6 +146,7 @@ class EarlyStopping(Callback):
         self.mode = mode
         self.best = None
         self.wait = 0
+        self.stopped_epoch = None  # set when training halts (ref parity)
 
     def _better(self, cur, best):
         if self.mode == "min":
@@ -167,6 +168,7 @@ class EarlyStopping(Callback):
         else:
             self.wait += 1
             if self.wait >= self.patience:
+                self.stopped_epoch = epoch
                 self.model.stop_training = True
 
 

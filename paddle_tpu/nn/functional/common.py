@@ -130,14 +130,13 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         size = [int(v) for v in size.numpy()]
     if size is not None and not isinstance(size, (list, tuple)):
         size = [int(size)]
-    if scale_factor is not None and not isinstance(scale_factor,
-                                                   (list, tuple)):
-        scale_factor = [scale_factor] * (1 if size is None else len(size))
-
     def fn(a):
         channel_last = data_format.endswith("C")
         nd = a.ndim
         n_spatial = nd - 2
+        sf = scale_factor
+        if sf is not None and not isinstance(sf, (list, tuple)):
+            sf = [sf] * n_spatial  # scalar factor scales EVERY spatial dim
         sp_axes = list(range(1, 1 + n_spatial)) if channel_last \
             else list(range(2, nd))
         in_sizes = [a.shape[i] for i in sp_axes]
@@ -145,7 +144,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             out_sizes = [int(s) for s in size]
         else:
             out_sizes = [int(round(s * f))
-                         for s, f in zip(in_sizes, scale_factor)]
+                         for s, f in zip(in_sizes, sf)]
         out_shape = list(a.shape)
         for ax, s in zip(sp_axes, out_sizes):
             out_shape[ax] = s
