@@ -1,0 +1,228 @@
+"""Second sweep of previously-untested APIs vs torch-cpu oracles:
+losses (CTC/Triplet/CosineEmbedding/HingeEmbedding), norms (Group/
+Instance/LocalResponse), conv3d (+transpose), LR schedules (OneCycle/
+Cyclic), initializers (Orthogonal/Dirac), vision layers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    import torch
+    return torch.tensor(np.asarray(a))
+
+
+class TestLosses:
+    def test_ctc_loss_matches_torch(self):
+        import torch
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        T, B, C = 6, 2, 5  # time, batch, classes (blank=0)
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 2, 3], [2, 3, 0]], np.int64)  # padded
+        in_len = np.array([6, 6], np.int64)
+        lab_len = np.array([3, 2], np.int64)
+        got = F.ctc_loss(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len),
+                         paddle.to_tensor(lab_len),
+                         blank=0, reduction="none").numpy()
+        lp = tF.log_softmax(_t(logits), -1)
+        want = tF.ctc_loss(lp, _t(labels), _t(in_len), _t(lab_len),
+                           blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_triplet_margin_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        a, p, n = (rng.randn(4, 8).astype(np.float32) for _ in range(3))
+        got = nn.TripletMarginLoss(margin=0.5)(
+            paddle.to_tensor(a), paddle.to_tensor(p),
+            paddle.to_tensor(n)).numpy()
+        want = tF.triplet_margin_loss(_t(a), _t(p), _t(n),
+                                      margin=0.5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cosine_embedding_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x1 = rng.randn(4, 8).astype(np.float32)
+        x2 = rng.randn(4, 8).astype(np.float32)
+        y = np.array([1, -1, 1, -1], np.int64)
+        got = nn.CosineEmbeddingLoss(margin=0.2)(
+            paddle.to_tensor(x1), paddle.to_tensor(x2),
+            paddle.to_tensor(y)).numpy()
+        want = tF.cosine_embedding_loss(_t(x1), _t(x2), _t(y),
+                                        margin=0.2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_hinge_embedding_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.randn(6).astype(np.float32)
+        y = np.array([1, -1, 1, -1, 1, -1], np.float32)
+        got = nn.HingeEmbeddingLoss(margin=1.0)(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        want = tF.hinge_embedding_loss(_t(x), _t(y),
+                                       margin=1.0).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestNorms:
+    def test_group_norm_matches_torch(self):
+        import torch
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6, 4, 4).astype(np.float32)
+        paddle.seed(0)
+        gn = nn.GroupNorm(num_groups=3, num_channels=6)
+        got = gn(paddle.to_tensor(x)).numpy()
+        tgn = torch.nn.GroupNorm(3, 6)
+        with torch.no_grad():
+            tgn.weight.copy_(_t(gn.weight.numpy()))
+            tgn.bias.copy_(_t(gn.bias.numpy()))
+            want = tgn(_t(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_instance_norm_matches_torch(self):
+        import torch
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 5, 5).astype(np.float32)
+        inorm = nn.InstanceNorm2D(3)
+        got = inorm(paddle.to_tensor(x)).numpy()
+        with torch.no_grad():
+            want = torch.nn.InstanceNorm2d(3, affine=True)(_t(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_local_response_norm_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 8, 4, 4).astype(np.float32)
+        got = nn.LocalResponseNorm(size=5)(paddle.to_tensor(x)).numpy()
+        want = tF.local_response_norm(_t(x), size=5).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestConv3D:
+    def test_conv3d_matches_torch(self):
+        import torch
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 5, 6, 7).astype(np.float32)
+        paddle.seed(0)
+        c = nn.Conv3D(2, 3, kernel_size=3, padding=1, stride=2)
+        got = c(paddle.to_tensor(x)).numpy()
+        tc = torch.nn.Conv3d(2, 3, 3, padding=1, stride=2)
+        with torch.no_grad():
+            tc.weight.copy_(_t(c.weight.numpy()))
+            tc.bias.copy_(_t(c.bias.numpy()))
+            want = tc(_t(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_conv3d_transpose_matches_torch(self):
+        import torch
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 4, 4, 4).astype(np.float32)
+        paddle.seed(0)
+        c = nn.Conv3DTranspose(3, 2, kernel_size=2, stride=2)
+        got = c(paddle.to_tensor(x)).numpy()
+        tc = torch.nn.ConvTranspose3d(3, 2, 2, stride=2)
+        with torch.no_grad():
+            tc.weight.copy_(_t(c.weight.numpy()))
+            tc.bias.copy_(_t(c.bias.numpy()))
+            want = tc(_t(x)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestLRSchedules:
+    def test_one_cycle_matches_torch(self):
+        import torch
+        from paddle_tpu.optimizer.lr import OneCycleLR
+        sched = OneCycleLR(max_learning_rate=0.1, total_steps=20)
+        p = torch.nn.Parameter(torch.zeros(1))
+        topt = torch.optim.SGD([p], lr=0.1)
+        tsched = torch.optim.lr_scheduler.OneCycleLR(
+            topt, max_lr=0.1, total_steps=20)
+        ours, theirs = [], []
+        for _ in range(19):
+            ours.append(sched())
+            sched.step()
+            theirs.append(topt.param_groups[0]["lr"])
+            tsched.step()
+        # identical up/anneal curves; the final annihilation point
+        # differs by a one-step phase-boundary rounding (torch is not
+        # paddle's oracle here) — hence the small atol
+        np.testing.assert_allclose(ours, theirs, rtol=2e-2, atol=2e-4)
+
+    def test_cyclic_triangular(self):
+        from paddle_tpu.optimizer.lr import CyclicLR
+        s = CyclicLR(base_learning_rate=0.01, max_learning_rate=0.1,
+                     step_size_up=4)
+        vals = []
+        for _ in range(9):
+            vals.append(s())
+            s.step()
+        assert abs(vals[0] - 0.01) < 1e-9
+        assert abs(max(vals) - 0.1) < 1e-6
+        assert vals[1] < vals[2] < vals[3]      # rising
+        assert vals[5] > vals[6] > vals[7]      # falling
+
+
+class TestInitializers:
+    def test_orthogonal_rows_orthonormal(self):
+        paddle.seed(0)
+        lin = nn.Linear(16, 8,
+                        weight_attr=nn.initializer.Orthogonal())
+        w = lin.weight.numpy()          # [16, 8]
+        wtw = w.T @ w
+        np.testing.assert_allclose(wtw, np.eye(8), atol=1e-4)
+
+    def test_dirac_preserves_channels(self):
+        paddle.seed(0)
+        c = nn.Conv2D(3, 3, 3, padding=1,
+                      weight_attr=nn.initializer.Dirac(),
+                      bias_attr=False)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 3, 5, 5).astype(np.float32))
+        np.testing.assert_allclose(c(x).numpy(), x.numpy(), atol=1e-6)
+
+
+class TestVisionLayers:
+    def test_channel_shuffle_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 6, 2, 2).astype(np.float32)
+        got = nn.ChannelShuffle(3)(paddle.to_tensor(x)).numpy()
+        want = tF.channel_shuffle(_t(x), 3).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_pixel_unshuffle_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 6, 6).astype(np.float32)
+        got = nn.PixelUnshuffle(2)(paddle.to_tensor(x)).numpy()
+        want = tF.pixel_unshuffle(_t(x), 2).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_pairwise_distance_matches_torch(self):
+        import torch.nn.functional as tF
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 8).astype(np.float32)
+        b = rng.randn(4, 8).astype(np.float32)
+        got = nn.PairwiseDistance(p=2)(
+            paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        want = tF.pairwise_distance(_t(a), _t(b), p=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_alpha_dropout_eval_identity_train_stats(self):
+        paddle.seed(0)
+        ad = nn.AlphaDropout(p=0.3)
+        x = paddle.randn([512, 16])
+        ad.eval()
+        np.testing.assert_allclose(ad(x).numpy(), x.numpy())
+        ad.train()
+        y = ad(x).numpy()
+        # self-normalizing: mean/var approximately preserved
+        assert abs(y.mean() - x.numpy().mean()) < 0.1
+        assert abs(y.std() - x.numpy().std()) < 0.25
