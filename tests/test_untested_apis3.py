@@ -1,0 +1,146 @@
+"""Third sweep: fft hermitian family, signal frame/overlap_add,
+ViterbiDecoder, Dirichlet/Multinomial distributions, matrix_rank —
+numpy/scipy/torch oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestFFTHermitian:
+    def test_rfft2_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6).astype(np.float32)
+        got = paddle.fft.rfft2(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.rfft2(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_hfft_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = (rng.randn(5) + 1j * rng.randn(5)).astype(np.complex64)
+        got = paddle.fft.hfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.hfft(x), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_ihfft_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(8).astype(np.float32)
+        got = paddle.fft.ihfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.ihfft(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_irfft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(10).astype(np.float32)
+        back = paddle.fft.irfft(paddle.fft.rfft(paddle.to_tensor(x)),
+                                n=10).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+class TestSignalFraming:
+    def test_frame_matches_manual(self):
+        x = np.arange(10, dtype=np.float32)
+        got = paddle.signal.frame(paddle.to_tensor(x), frame_length=4,
+                                  hop_length=2).numpy()
+        # frames along the last axis: [n_frames from hops]
+        want = np.stack([x[i:i + 4] for i in range(0, 7, 2)], axis=-1)
+        np.testing.assert_allclose(got, want)
+
+    def test_overlap_add_inverts_frame_cola(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16).astype(np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=4,
+                                 hop_length=4)  # non-overlapping
+        back = paddle.signal.overlap_add(fr, hop_length=4).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 256).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64,
+                                  hop_length=16)
+        back = paddle.signal.istft(spec, n_fft=64, hop_length=16).numpy()
+        n = min(back.shape[-1], 256)
+        np.testing.assert_allclose(back[0, 32:n - 32], x[0, 32:n - 32],
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        from paddle_tpu.text import ViterbiDecoder
+        rng = np.random.RandomState(0)
+        B, T, N = 2, 4, 3
+        pot = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans),
+                             include_bos_eos_tag=False)
+        lengths = paddle.to_tensor(np.array([4, 4], np.int64))
+        scores, paths = dec(paddle.to_tensor(pot), lengths)
+
+        # brute force over all tag sequences
+        import itertools
+        for b in range(B):
+            best, best_path = -1e30, None
+            for seq in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, seq[0]]
+                for t in range(1, T):
+                    s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                if s > best:
+                    best, best_path = s, seq
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(paths.numpy()[b], best_path)
+
+
+class TestDistributions:
+    def test_dirichlet_stats(self):
+        from paddle_tpu.distribution import Dirichlet
+        conc = paddle.to_tensor(np.array([2.0, 3.0, 5.0], np.float32))
+        d = Dirichlet(conc)
+        np.testing.assert_allclose(d.mean.numpy(), [0.2, 0.3, 0.5],
+                                   rtol=1e-5)
+        s = d.sample([2000])
+        assert s.shape == [2000, 3]
+        np.testing.assert_allclose(s.numpy().sum(-1), np.ones(2000),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(s.numpy().mean(0), [0.2, 0.3, 0.5],
+                                   atol=0.03)
+        # log_prob vs scipy
+        from scipy.stats import dirichlet as sp_d
+        x = np.array([0.3, 0.3, 0.4], np.float32)
+        got = float(d.log_prob(paddle.to_tensor(x)).item())
+        np.testing.assert_allclose(got, sp_d.logpdf(x, [2., 3., 5.]),
+                                   rtol=1e-4)
+
+    def test_multinomial_log_prob(self):
+        from paddle_tpu.distribution import Multinomial
+        from scipy.stats import multinomial as sp_m
+        probs = np.array([0.2, 0.3, 0.5], np.float32)
+        m = Multinomial(10, paddle.to_tensor(probs))
+        x = np.array([2.0, 3.0, 5.0], np.float32)
+        got = float(m.log_prob(paddle.to_tensor(x)).item())
+        np.testing.assert_allclose(got, sp_m.logpmf(x, 10, probs),
+                                   rtol=1e-4)
+        s = m.sample([500])
+        np.testing.assert_allclose(np.asarray(s.numpy()).sum(-1),
+                                   np.full(500, 10.0), rtol=1e-6)
+
+
+class TestLinalgTail:
+    def test_matrix_rank(self):
+        a = np.diag([1.0, 2.0, 0.0]).astype(np.float32)
+        assert int(paddle.linalg.matrix_rank(
+            paddle.to_tensor(a)).item()) == 2
+
+    def test_cholesky_solve_matches_scipy(self):
+        from scipy.linalg import cho_solve
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        L = np.linalg.cholesky(spd).astype(np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        got = paddle.linalg.cholesky_solve(
+            paddle.to_tensor(b), paddle.to_tensor(L),
+            upper=False).numpy()
+        want = cho_solve((L, True), b)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
