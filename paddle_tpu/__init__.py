@@ -143,12 +143,33 @@ def summary(net, input_size=None, dtypes=None, input=None):
     return _summary(net, input_size, dtypes, input)
 
 
+# flags system (ref fluid/framework/flags): a real store; flags with a
+# runtime behavior are applied on set, the rest are carried for
+# introspection parity
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": True,   # XLA is deterministic
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.0,
+    "FLAGS_use_cinn": False,             # XLA is the compiler
+}
+
+
 def get_flags(flags=None):
-    return {}
+    if flags is None:
+        return dict(_FLAGS)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS.get(k) for k in flags}
 
 
 def set_flags(flags):
-    pass
+    for k, v in dict(flags).items():
+        _FLAGS[k] = v
+        if k == "FLAGS_check_nan_inf":
+            from .framework.debug import set_nan_inf_check
+            # NB: bare `bool` here is paddle.bool (the dtype export)
+            set_nan_inf_check(True if v else False)
 
 
 def set_printoptions(**kwargs):

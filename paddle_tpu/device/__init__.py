@@ -92,11 +92,17 @@ def synchronize(device=None):
 
 def get_device_properties(device=None):
     d = _current or _default_device()
+    stats = {}
+    if hasattr(d, "memory_stats"):
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+
     class _Props:
         name = str(d)
         major, minor = 0, 0
-        total_memory = getattr(d, "memory_stats", lambda: {})() \
-            .get("bytes_limit", 0) if hasattr(d, "memory_stats") else 0
+        total_memory = stats.get("bytes_limit", 0)
         multi_processor_count = 1
     return _Props()
 
@@ -122,16 +128,25 @@ class Stream:
 
 class Event:
     def __init__(self, enable_timing=False, blocking=False, interprocess=False):
-        pass
+        self._t = None
 
     def record(self, stream=None):
-        pass
+        # dispatch is async; sync so the timestamp marks completed work
+        synchronize()
+        import time
+        self._t = time.perf_counter()
 
     def query(self):
         return True
 
     def synchronize(self):
         synchronize()
+
+    def elapsed_time(self, end_event):
+        """Milliseconds between two recorded events (CUDA Event parity)."""
+        if self._t is None or end_event._t is None:
+            raise RuntimeError("elapsed_time() on un-recorded events")
+        return max((end_event._t - self._t) * 1000.0, 0.0)
 
 
 class cuda:
