@@ -60,6 +60,7 @@ class Program:
         self._build_fns = []  # (fn, placeholders_order) recorded builders
         self.random_seed = 0
         self._builder = None
+        self._params = []  # params created by static.nn under this program
 
     def global_block(self):
         return self
@@ -113,6 +114,7 @@ class _Scope(dict):
 
 
 _scope = _Scope()
+Scope = _Scope  # paddle.static.Scope parity
 
 
 def global_scope():
@@ -193,9 +195,17 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
+    """Reference semantics (static/backward.py): with no parameter_list,
+    return (param, grad) for every trainable parameter the current
+    program's static.nn layers created."""
     loss.backward(retain_graph=True)
-    params = parameter_list or []
-    return [(p, p.grad) for p in params]
+    if parameter_list is None:
+        parameter_list = [p for p in default_main_program()._params
+                          if getattr(p, "trainable", True)]
+    no_grad = set(no_grad_set or ())
+    return [(p, p.grad) for p in parameter_list
+            if p.grad is not None and getattr(p, "name", None)
+            not in no_grad]
 
 
 def cpu_places(device_count=None):

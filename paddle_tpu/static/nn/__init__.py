@@ -48,8 +48,9 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
             xi = xi.reshape([*shape[:num_flatten_dims], feat]) \
                 if feat != shape[-1] or len(shape) != num_flatten_dims + 1 \
                 else xi
-        lin = Linear(int(np.prod(xi.shape[num_flatten_dims:])), size,
-                     weight_attr=weight_attr, bias_attr=bias_attr)
+        lin = _track(Linear(
+            int(np.prod(xi.shape[num_flatten_dims:])), size,
+            weight_attr=weight_attr, bias_attr=bias_attr))
         flat = xi.reshape([*xi.shape[:num_flatten_dims], -1])
         outs.append(lin(flat))
     out = outs[0]
@@ -60,18 +61,32 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     return out
 
 
+def _track(layer):
+    """Register a static.nn layer's parameters on the active Program so
+    append_backward(parameter_list=None) can find them (reference
+    static/backward.py walks the program's params)."""
+    from .. import default_main_program
+    prog = default_main_program()
+    for _, prm in layer.named_parameters():
+        prog._params.append(prm)
+    return layer
+
+
 def _make_param(shape, dtype, attr, default_init):
     from ...nn.layer.layers import Layer
     holder = Layer()
-    return holder.create_parameter(shape, attr=attr, dtype=dtype,
-                                   default_initializer=default_init)
+    p = holder.create_parameter(shape, attr=attr, dtype=dtype,
+                                default_initializer=default_init)
+    from .. import default_main_program
+    default_main_program()._params.append(p)
+    return p
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype='float32'):
     from ...nn.layer.common import Embedding
-    emb = Embedding(size[0], size[1], padding_idx=padding_idx,
-                    weight_attr=param_attr)
+    emb = _track(Embedding(size[0], size[1], padding_idx=padding_idx,
+                           weight_attr=param_attr))
     return emb(input)
 
 
@@ -94,6 +109,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     layer = Conv2D(cin, num_filters, k, stride=stride, padding=padding,
                    dilation=dilation, groups=groups, weight_attr=param_attr,
                    bias_attr=bias_attr, data_format=data_format)
+    _track(layer)
     out = layer(input)
     if act:
         out = getattr(_F(), act)(out)
@@ -112,6 +128,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                             padding=padding, dilation=dilation, groups=groups,
                             weight_attr=param_attr, bias_attr=bias_attr,
                             data_format=data_format)
+    _track(layer)
     out = layer(input, output_size=output_size)
     if act:
         out = getattr(_F(), act)(out)
@@ -128,6 +145,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     layer = Conv3D(cin, num_filters, k, stride=stride, padding=padding,
                    dilation=dilation, groups=groups, weight_attr=param_attr,
                    bias_attr=bias_attr, data_format=data_format)
+    _track(layer)
     out = layer(input)
     if act:
         out = getattr(_F(), act)(out)
@@ -146,6 +164,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                             padding=padding, dilation=dilation, groups=groups,
                             weight_attr=param_attr, bias_attr=bias_attr,
                             data_format=data_format)
+    _track(layer)
     out = layer(input, output_size=output_size)
     if act:
         out = getattr(_F(), act)(out)
@@ -184,6 +203,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                 data_format=data_layout if nd == 4 else 'NCL')
     if is_test or use_global_stats:
         layer.eval()
+    _track(layer)
     out = layer(input)
     if act:
         out = getattr(_F(), act)(out)
@@ -212,6 +232,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
     c = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
     layer = GroupNorm(groups, c, epsilon=epsilon, weight_attr=param_attr,
                       bias_attr=bias_attr)
+    _track(layer)
     out = layer(input)
     if act:
         out = getattr(_F(), act)(out)
@@ -236,6 +257,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
     layer = LayerNorm(norm_shape, epsilon=epsilon,
                       weight_attr=param_attr if scale else False,
                       bias_attr=bias_attr if shift else False)
+    _track(layer)
     out = layer(input)
     if act:
         out = getattr(_F(), act)(out)

@@ -170,7 +170,8 @@ def adjust_hue(img, factor):
     lut = [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
            np.stack([p, v, t], -1), np.stack([p, q, v], -1),
            np.stack([t, p, v], -1), np.stack([v, p, q], -1)]
-    out = np.select([i == k for k in range(6)], lut)
+    # conditions are [H, W]; broadcast against the [H, W, 3] choices
+    out = np.select([(i == k)[..., None] for k in range(6)], lut)
     return np.clip(out * 255, 0, 255).astype(_hwc(img).dtype)
 
 
