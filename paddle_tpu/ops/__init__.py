@@ -45,3 +45,5 @@ def fused_layer_norm(x, weight, bias, eps=1e-5):
 from .block_sparse import (block_sparse_attention,  # noqa: E402
                            block_sparse_attention_arrays,
                            local_strided_pattern)
+
+from .paged_attention import PagedKVCache, paged_attention  # noqa: E402

@@ -1,0 +1,143 @@
+"""Paged KV-cache attention for continuous-batching inference.
+
+Beyond-parity (the reference era predates it; see PAPERS.md "Ragged
+Paged Attention ... for TPU"): decode-time KV memory is allocated in
+fixed-size PAGES shared by all sequences, so a batch of requests with
+wildly different lengths wastes no HBM on padding and sequences can
+join/leave the batch without reshaping anything static.
+
+TPU-native formulation: the page pool is one [n_pages, page_size, H, D]
+array per layer; a per-sequence page table [B, max_pages] turns decode
+attention into ONE XLA gather (pages → [B, max_pages*page_size, H, D])
+plus a masked flash-style softmax — static shapes, jit-stable across
+steps, no per-token recompilation. The allocator is host-side Python
+(free-list of page ids), exactly the part that should not be traced.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "paged_attention"]
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None):
+    """q: [B, H, D] (one decode token per sequence);
+    k_pages/v_pages: [n_pages, page_size, H, D];
+    page_table: [B, max_pages] int32 page ids (0-padded);
+    lengths: [B] int32 — tokens currently stored per sequence.
+    Returns [B, H, D]."""
+    B, H, D = q.shape
+    P = k_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    # one gather: each sequence's pages, flattened to a token axis
+    k = k_pages[page_table].reshape(B, max_pages * P, H, D)
+    v = v_pages[page_table].reshape(B, max_pages * P, H, D)
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    t = jnp.arange(max_pages * P)[None, None, :]
+    s = jnp.where(t < lengths[:, None, None], s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+class PagedKVCache:
+    """Host-side page allocator + device-side page pools (per layer).
+
+    write()/extend() copy new k/v into pages with one dynamic_update per
+    page touched; sequences allocate pages lazily and release them on
+    free() — the pool is shared, so peak HBM tracks the TOTAL tokens in
+    flight, not batch * max_len."""
+
+    def __init__(self, n_layers, n_pages, page_size, n_heads, head_dim,
+                 dtype=jnp.float32):
+        self.n_layers = n_layers
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.n_heads = n_heads
+        self.head_dim = head_dim
+        shape = (n_pages, page_size, n_heads, head_dim)
+        self.k = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        self.v = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        # page 0 is reserved as the pad page so 0-padded tables are safe
+        self._free = list(range(1, n_pages))
+        self._tables = {}   # seq_id -> list of page ids
+        self._len = {}      # seq_id -> tokens stored
+
+    # ---- allocator ----------------------------------------------------
+    def add_sequence(self, seq_id):
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already present")
+        self._tables[seq_id] = []
+        self._len[seq_id] = 0
+
+    def free_sequence(self, seq_id):
+        self._free.extend(self._tables.pop(seq_id))
+        self._len.pop(seq_id)
+
+    def length(self, seq_id):
+        return self._len[seq_id]
+
+    def n_free_pages(self):
+        return len(self._free)
+
+    def _ensure_capacity(self, seq_id, n_new):
+        need = self._len[seq_id] + n_new
+        have = len(self._tables[seq_id]) * self.page_size
+        while have < need:
+            if not self._free:
+                raise RuntimeError(
+                    "PagedKVCache out of pages — free finished sequences "
+                    "or grow n_pages")
+            self._tables[seq_id].append(self._free.pop())
+            have += self.page_size
+
+    # ---- writes -------------------------------------------------------
+    def extend(self, seq_id, layer, k_new, v_new):
+        """Append k/v [T, H, D] for one layer. Call for every layer with
+        the same T before advance()."""
+        self._ensure_capacity(seq_id, k_new.shape[0])
+        k_new = k_new.astype(self.k[layer].dtype)
+        v_new = v_new.astype(self.v[layer].dtype)
+        pos = self._len[seq_id]
+        T = k_new.shape[0]
+        P = self.page_size
+        table = self._tables[seq_id]
+        off = 0
+        while off < T:
+            page = table[(pos + off) // P]
+            in_page = (pos + off) % P
+            n = min(P - in_page, T - off)
+            self.k[layer] = jax.lax.dynamic_update_slice(
+                self.k[layer], k_new[off:off + n][None],
+                (page, in_page, 0, 0))
+            self.v[layer] = jax.lax.dynamic_update_slice(
+                self.v[layer], v_new[off:off + n][None],
+                (page, in_page, 0, 0))
+            off += n
+
+    def advance(self, seq_id, n_tokens):
+        """Commit n_tokens appended to EVERY layer."""
+        self._len[seq_id] += n_tokens
+
+    # ---- reads --------------------------------------------------------
+    def batch_views(self, seq_ids):
+        """(page_table [B, max_pages] i32, lengths [B] i32) for a decode
+        batch — pad tables with the reserved page 0."""
+        tables = [self._tables[s] for s in seq_ids]
+        width = max(1, max(len(t) for t in tables))
+        pt = np.zeros((len(seq_ids), width), np.int32)
+        for i, t in enumerate(tables):
+            pt[i, :len(t)] = t
+        lens = np.asarray([self._len[s] for s in seq_ids], np.int32)
+        return jnp.asarray(pt), jnp.asarray(lens)
+
+    def attend(self, layer, q, seq_ids):
+        """Decode attention for one layer: q [B, H, D] against each
+        sequence's paged history."""
+        pt, lens = self.batch_views(seq_ids)
+        return paged_attention(q, self.k[layer], self.v[layer], pt, lens)
