@@ -13,6 +13,7 @@ plus a masked flash-style softmax — static shapes, jit-stable across
 steps, no per-token recompilation. The allocator is host-side Python
 (free-list of page ids), exactly the part that should not be traced.
 """
+import functools
 import math
 
 import numpy as np
@@ -20,6 +21,17 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["PagedKVCache", "paged_attention"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_block(pool, block, page, in_page):
+    """In-place page write: the pool buffer is DONATED, so XLA updates
+    it without copying the whole [n_pages, page_size, H, D] array (an
+    eager dynamic_update_slice would copy the pool per token). page/
+    in_page are traced, so one program serves every position."""
+    return jax.lax.dynamic_update_slice(
+        pool, block, (page, in_page,
+                      jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
 
 
 def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None):
@@ -88,13 +100,17 @@ class PagedKVCache:
     def _ensure_capacity(self, seq_id, n_new):
         need = self._len[seq_id] + n_new
         have = len(self._tables[seq_id]) * self.page_size
-        while have < need:
-            if not self._free:
-                raise RuntimeError(
-                    "PagedKVCache out of pages — free finished sequences "
-                    "or grow n_pages")
+        n_pages = -(-max(need - have, 0) // self.page_size)
+        if n_pages > len(self._free):
+            # atomic: raise BEFORE touching the free list, so a caught
+            # allocation failure leaves the pool consistent (a scheduler
+            # can defer this sequence and admit a smaller one)
+            raise RuntimeError(
+                f"PagedKVCache out of pages (need {n_pages}, free "
+                f"{len(self._free)}) — free finished sequences or grow "
+                f"n_pages")
+        for _ in range(n_pages):
             self._tables[seq_id].append(self._free.pop())
-            have += self.page_size
 
     # ---- writes -------------------------------------------------------
     def extend(self, seq_id, layer, k_new, v_new):
@@ -112,12 +128,12 @@ class PagedKVCache:
             page = table[(pos + off) // P]
             in_page = (pos + off) % P
             n = min(P - in_page, T - off)
-            self.k[layer] = jax.lax.dynamic_update_slice(
+            self.k[layer] = _write_block(
                 self.k[layer], k_new[off:off + n][None],
-                (page, in_page, 0, 0))
-            self.v[layer] = jax.lax.dynamic_update_slice(
+                jnp.int32(page), jnp.int32(in_page))
+            self.v[layer] = _write_block(
                 self.v[layer], v_new[off:off + n][None],
-                (page, in_page, 0, 0))
+                jnp.int32(page), jnp.int32(in_page))
             off += n
 
     def advance(self, seq_id, n_tokens):
@@ -126,18 +142,29 @@ class PagedKVCache:
 
     # ---- reads --------------------------------------------------------
     def batch_views(self, seq_ids):
-        """(page_table [B, max_pages] i32, lengths [B] i32) for a decode
-        batch — pad tables with the reserved page 0."""
+        """(page_table [B, width] i32, lengths [B] i32) for a decode
+        batch — tables pad with the reserved page 0 and width rounds up
+        to the next power of two, so the jitted attention compiles once
+        per bucket instead of every time the longest sequence crosses a
+        page boundary. Build ONCE per decode step and pass to attend()
+        for every layer (the views are layer-independent)."""
+        if not seq_ids:
+            raise ValueError("batch_views() needs at least one sequence")
         tables = [self._tables[s] for s in seq_ids]
         width = max(1, max(len(t) for t in tables))
+        width = 1 << (width - 1).bit_length()  # bucket: power of two
         pt = np.zeros((len(seq_ids), width), np.int32)
         for i, t in enumerate(tables):
             pt[i, :len(t)] = t
         lens = np.asarray([self._len[s] for s in seq_ids], np.int32)
         return jnp.asarray(pt), jnp.asarray(lens)
 
-    def attend(self, layer, q, seq_ids):
+    def attend(self, layer, q, seq_ids=None, views=None):
         """Decode attention for one layer: q [B, H, D] against each
-        sequence's paged history."""
-        pt, lens = self.batch_views(seq_ids)
+        sequence's paged history. Pass `views=batch_views(seq_ids)`
+        (computed once per step) to avoid rebuilding the host-side
+        tables + H2D transfer per layer."""
+        if views is None:
+            views = self.batch_views(seq_ids)
+        pt, lens = views
         return paged_attention(q, self.k[layer], self.v[layer], pt, lens)

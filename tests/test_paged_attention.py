@@ -93,3 +93,49 @@ class TestPagedAttention:
         b = jit_pa(q, cache.k[0], cache.v[0], pt, lens)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
         assert jit_pa._cache_size() == 1
+
+
+class TestReviewHardening:
+    def test_failed_allocation_leaves_pool_intact(self):
+        """Out-of-pages must not leak pages to the failed sequence —
+        another (smaller) request must still fit."""
+        cache = PagedKVCache(1, 4, 2, 1, 2)  # 3 usable pages, 6 tokens
+        cache.add_sequence("big")
+        with pytest.raises(RuntimeError):
+            cache.extend("big", 0, jnp.zeros((8, 1, 2)),
+                         jnp.zeros((8, 1, 2)))
+        assert cache.n_free_pages() == 3  # nothing leaked
+        cache.add_sequence("small")
+        cache.extend("small", 0, jnp.zeros((4, 1, 2)),
+                     jnp.zeros((4, 1, 2)))
+        cache.advance("small", 4)
+
+    def test_width_buckets_power_of_two(self):
+        cache = PagedKVCache(1, 32, 2, 1, 2)
+        cache.add_sequence("s")
+        cache.extend("s", 0, jnp.zeros((10, 1, 2)),
+                     jnp.zeros((10, 1, 2)))  # 5 pages
+        cache.advance("s", 10)
+        pt, _ = cache.batch_views(["s"])
+        assert pt.shape[1] == 8  # 5 -> next pow2
+
+    def test_views_reused_across_layers(self):
+        rng = np.random.RandomState(0)
+        cache = PagedKVCache(2, 8, 4, 2, 4)
+        cache.add_sequence("s")
+        for layer in range(2):
+            cache.extend("s", layer,
+                         jnp.asarray(rng.randn(4, 2, 4), jnp.float32),
+                         jnp.asarray(rng.randn(4, 2, 4), jnp.float32))
+        cache.advance("s", 4)
+        views = cache.batch_views(["s"])
+        q = jnp.asarray(rng.randn(1, 2, 4), jnp.float32)
+        a0 = cache.attend(0, q, views=views)
+        a1 = cache.attend(1, q, views=views)
+        assert np.isfinite(np.asarray(a0)).all()
+        assert not np.allclose(np.asarray(a0), np.asarray(a1))
+
+    def test_empty_batch_clear_error(self):
+        cache = PagedKVCache(1, 4, 2, 1, 2)
+        with pytest.raises(ValueError, match="at least one"):
+            cache.batch_views([])
