@@ -74,6 +74,20 @@ class StaticCacheSlot:
         self.pos = pos
 
 
+class PagedCacheSlot:
+    """One layer's view of a shared PagedKVCache for continuous-batching
+    decode: `cache` is the ops.paged_attention.PagedKVCache, `seq_ids`
+    the batch rows, `views` the per-step (page_table, lengths)."""
+
+    __slots__ = ("cache", "layer", "seq_ids", "views")
+
+    def __init__(self, cache, layer, seq_ids, views):
+        self.cache = cache
+        self.layer = layer
+        self.seq_ids = seq_ids
+        self.views = views
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, cfg):
         super().__init__()
@@ -95,6 +109,8 @@ class GPTAttention(nn.Layer):
         q, k, v = qkv.unbind(axis=2)
         if isinstance(cache, StaticCacheSlot):
             return self._forward_static_cache(x, q, k, v, cache)
+        if isinstance(cache, PagedCacheSlot):
+            return self._forward_paged_cache(x, q, k, v, cache)
         if cache is not None:  # legacy growing (k, v) protocol
             from ..tensor.manipulation import concat
             k = concat([cache[0], k], axis=1)
@@ -134,6 +150,39 @@ class GPTAttention(nn.Layer):
         out = self.out_proj(Tensor(out.reshape(B, T, H).astype(
             x.value.dtype)))
         return out, StaticCacheSlot(Tensor(kb), Tensor(vb), pos)
+
+
+    def _forward_paged_cache(self, x, q, k, v, cache):
+        """Continuous-batching path: write this step's k/v into the
+        shared page pool, attend each row against its own paged history.
+        Prefill (T>1) runs causal attention over the new tokens PLUS the
+        paged history; decode (T==1) is one paged_attention gather."""
+        from ..ops.paged_attention import paged_attention
+        B, T, H = x.shape
+        pc = cache.cache
+        for i, sid in enumerate(cache.seq_ids):
+            pc.extend(sid, cache.layer, k.value[i], v.value[i])
+        # lengths are committed (advance) only after the LAST layer, so
+        # batch_views here reports the pre-step history; the T tokens
+        # this layer just wrote are added explicitly
+        pt, old_lens = pc.batch_views(cache.seq_ids)
+        if T == 1:
+            out = paged_attention(q.value[:, 0], pc.k[cache.layer],
+                                  pc.v[cache.layer], pt, old_lens + 1)
+            out = out[:, None]
+        else:
+            # prefill: query position t sees history + new tokens <= t
+            outs = [paged_attention(q.value[:, t], pc.k[cache.layer],
+                                    pc.v[cache.layer], pt,
+                                    old_lens + t + 1)
+                    for t in range(T)]
+            out = jnp.stack(outs, axis=1)
+        if cache.layer == pc.n_layers - 1:
+            for sid in cache.seq_ids:
+                pc.advance(sid, T)
+        out = self.out_proj(Tensor(out.reshape(B, T, H).astype(
+            x.value.dtype)))
+        return out, cache
 
 
 class GPTMLP(nn.Layer):
@@ -204,6 +253,13 @@ class GPTModel(nn.Layer):
                                                  StaticCacheSlot):
                 pos_arr = caches[0].pos + jnp.arange(T, dtype=jnp.int32)
                 position_ids = Tensor(pos_arr[None, :])
+            elif caches is not None and isinstance(caches[0],
+                                                   PagedCacheSlot):
+                pc = caches[0].cache
+                lens = np.array([pc.length(s)
+                                 for s in caches[0].seq_ids])[:, None]
+                position_ids = Tensor(jnp.asarray(
+                    lens + np.arange(T), jnp.int64))
             else:
                 from ..tensor.creation import arange
                 start = 0 if caches is None else caches[0][0].shape[1]
@@ -291,6 +347,26 @@ class GPTForCausalLM(nn.Layer):
         V = logits.shape[-1]
         return F.cross_entropy(logits.reshape([-1, V]),
                                labels.reshape([-1]), ignore_index=-100)
+
+    def make_paged_cache(self, n_pages, page_size=16, dtype=None):
+        """Shared page pool sized for this model (continuous batching)."""
+        from ..ops.paged_attention import PagedKVCache
+        cfg = self.cfg
+        return PagedKVCache(
+            cfg.num_layers, n_pages, page_size, cfg.num_heads,
+            cfg.hidden_size // cfg.num_heads,
+            dtype or self.gpt.wte.weight.value.dtype)
+
+    def paged_decode_step(self, cache, seq_ids, input_ids):
+        """One continuous-batching step over a shared PagedKVCache:
+        prefill when input_ids has T>1 (new request joining the batch),
+        decode when T==1. Rows are independent sequences; lengths may be
+        ragged — each attends only its own paged history. Returns
+        next-token logits [B, vocab]."""
+        caches = [PagedCacheSlot(cache, l, list(seq_ids), None)
+                  for l in range(self.cfg.num_layers)]
+        logits, _ = self(input_ids, caches=caches)
+        return logits[:, -1, :]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None):
