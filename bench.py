@@ -16,16 +16,18 @@ import numpy as np
 def _run():
     import signal
 
+    init_budget = int(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+
     def _init_timeout(signum, frame):
         raise TimeoutError(
-            "TPU backend init did not complete within 240s — axon tunnel "
-            "unreachable (jax.devices() blocked on recvfrom)")
+            f"TPU backend init did not complete within {init_budget}s — "
+            "axon tunnel unreachable (jax.devices() blocked on recvfrom)")
 
     # backend init goes through the axon tunnel; if the tunnel is wedged
     # the first device query blocks forever — fail with a diagnostic
     # instead (observed 2026-07-29: tunnel outage mid-round)
     signal.signal(signal.SIGALRM, _init_timeout)
-    signal.alarm(240)
+    signal.alarm(init_budget)
     import jax
     import jax.numpy as jnp
     jax.devices()  # force backend init under the alarm
