@@ -41,13 +41,21 @@ def _run():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        # scan_remat=True: recompute block activations in backward; without
-        # it the 24-layer lax.scan stacks every layer's residuals (>10 GB
-        # of bf16 temps on a 16 GB chip -> OOM, see BENCH_r02.json).
+        # scan_remat="names": save only the three tagged per-block matmul
+        # outputs, recompute the rest in backward (measured best: 249 ms/
+        # step vs 262 ms full remat; scan_remat=False OOMs — the 24-layer
+        # lax.scan would stack >10 GB of residuals, see BENCH_r02.json).
+        # _run() retries with full remat if this config fails to compile.
         batch, seq = 8, 1024
+        remat = os.environ.get("BENCH_REMAT", "names")
+        if remat not in ("true", "false", "names", "dots"):
+            raise ValueError(f"BENCH_REMAT={remat!r}: expected "
+                             "true|false|names|dots")
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=seq,
-                        dropout=0.0, scan_remat=True)
+                        dropout=0.0,
+                        scan_remat={"true": True,
+                                    "false": False}.get(remat, remat))
     else:  # smoke-size on CPU so the script always runs
         batch, seq = 2, 128
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
@@ -71,19 +79,41 @@ def _run():
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
 
-    # warmup (compile)
+    # warmup (compile); sync via a data fetch — through the axon tunnel
+    # block_until_ready returns before execution finishes, so only a
+    # fetch (.item()) is a true barrier
     for _ in range(3):
         loss = step(ids, ids)
     float(loss.item())
 
-    iters = 20 if on_tpu else 3
+    iters = 30 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, ids)
-    loss.value.block_until_ready()
+    float(loss.item())
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
+    # calibrate sustained matmul rate (the realistic MXU ceiling for this
+    # chip/tunnel) with a 100-iter chained bf16 matmul, one scalar fetch
+    mm_tflops = 0.0
+    if on_tpu:
+        from jax import lax
+        a = jnp.asarray(rng.randn(4096, 4096) * 0.01, jnp.bfloat16)
+        w = jnp.asarray(rng.randn(4096, 4096) * 0.01, jnp.bfloat16)
+
+        @jax.jit
+        def mm_chain(x):
+            def body(c, _):
+                return (c @ w) * 0.01, None
+            y, _ = lax.scan(body, x, None, length=100)
+            return y.ravel()[0].astype(jnp.float32)
+
+        float(mm_chain(a))
+        t0 = time.perf_counter()
+        float(mm_chain(a))
+        mm_dt = time.perf_counter() - t0
+        mm_tflops = 100 * 2 * 4096**3 / mm_dt / 1e12
     # MFU: train step ~ 6*N flops/token (fwd 2N + bwd 4N), against the
     # chip generation's bf16 peak.  Context only; headline stays tokens/s.
     peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
@@ -109,23 +139,46 @@ def _run():
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
         "mfu": round(mfu, 4),
+        # mfu uses the v5e nominal 197 TFLOP/s; mfu_vs_measured_peak uses
+        # the sustained bf16 matmul rate calibrated above (~100 TFLOP/s on
+        # this chip/tunnel) — the honest utilization ceiling
+        "measured_matmul_tflops": round(mm_tflops, 1),
+        "mfu_vs_measured_peak": round(
+            6.0 * n_params * tokens_per_sec / (mm_tflops * 1e12), 4)
+        if mm_tflops else 0.0,
+        "remat": os.environ.get("BENCH_REMAT", "names"),
         "loss": round(float(loss.item()), 4),
     }))
 
 
 def main():
+    first_tb = None
     try:
+        try:
+            _run()
+            return
+        except Exception:
+            # selective-remat compile can be flaky through the remote
+            # compile helper — one retry on the full-remat config, but
+            # only when the operator didn't pin a config explicitly
+            if "BENCH_REMAT" in os.environ:
+                raise
+            first_tb = traceback.format_exc()
+            os.environ["BENCH_REMAT"] = "true"
         _run()
     except Exception as e:  # diagnostic JSON line, never a bare traceback
         tb = traceback.format_exc()
-        print(json.dumps({
+        out = {
             "metric": "gpt_medium_train_tokens_per_sec_per_chip",
             "value": 0.0,
             "unit": "tokens/s/chip",
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {str(e)[:400]}",
             "traceback_tail": tb[-800:],
-        }))
+        }
+        if first_tb is not None:
+            out["first_attempt_traceback_tail"] = first_tb[-600:]
+        print(json.dumps(out))
         raise SystemExit(1)
 
 

@@ -88,6 +88,17 @@ class PagedCacheSlot:
         self.views = views
 
 
+def _ckpt_name(t, name):
+    """Tag a traced activation as a named remat save point. No-op in
+    eager mode (concrete arrays go through the tape; re-wrapping would
+    orphan them from it)."""
+    import jax
+    if isinstance(t.value, jax.core.Tracer):
+        from jax.ad_checkpoint import checkpoint_name
+        return Tensor(checkpoint_name(t.value, name))
+    return t
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, cfg):
         super().__init__()
@@ -104,8 +115,8 @@ class GPTAttention(nn.Layer):
 
     def forward(self, x, cache=None):
         B, T, H = x.shape
-        qkv = self.qkv_proj(x).reshape([B, T, 3, self.num_heads,
-                                        self.head_dim])
+        qkv = _ckpt_name(self.qkv_proj(x), "gpt_qkv")
+        qkv = qkv.reshape([B, T, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
         if isinstance(cache, StaticCacheSlot):
             return self._forward_static_cache(x, q, k, v, cache)
@@ -123,7 +134,8 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True,
                 dropout_p=self.dropout if self.training else 0.0)
-        out = self.out_proj(out.reshape([B, T, H]))
+        out = _ckpt_name(out.reshape([B, T, H]), "gpt_attn_out")
+        out = self.out_proj(out)
         return (out, cache) if cache is not None else out
 
     def _forward_static_cache(self, x, q, k, v, cache):
@@ -197,8 +209,8 @@ class GPTMLP(nn.Layer):
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
-        return self.drop(self.fc_out(F.gelu(self.fc_in(x),
-                                            approximate=True)))
+        h = _ckpt_name(self.fc_in(x), "gpt_ffn_in")
+        return self.drop(self.fc_out(F.gelu(h, approximate=True)))
 
 
 class GPTBlock(nn.Layer):
@@ -320,6 +332,14 @@ class GPTModel(nn.Layer):
             if self.cfg.scan_remat == "dots":
                 policy = jax.checkpoint_policies.\
                     dots_with_no_batch_dims_saveable
+            elif self.cfg.scan_remat == "names":
+                # selective: save exactly the three big per-block matmul
+                # outputs (qkv, attn out, ffn up — tagged via
+                # checkpoint_name above), recompute the cheap rest.
+                # Unlike "dots" this skips the flash-attention internals
+                # and keeps HBM bounded at ~10*B*T*H bf16 per block.
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "gpt_qkv", "gpt_attn_out", "gpt_ffn_in")
             step = jax.checkpoint(step, prevent_cse=False, policy=policy)
         y, _ = jax.lax.scan(lambda h, p: (step(h, p), None), x.value,
                             stacked)
