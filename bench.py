@@ -67,7 +67,10 @@ def _run():
     model.bfloat16() if on_tpu else None
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
 
-    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    # multi_precision: f32 master weights — a bf16 param's ulp (~2^-8
+    # relative) would otherwise swallow typical late-training updates
+    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                  multi_precision=on_tpu)
 
     def loss_fn(logits, labels):
         V = logits.shape[-1]

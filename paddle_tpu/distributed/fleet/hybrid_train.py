@@ -131,10 +131,13 @@ class HybridTrainStep:
 
         # optimizer state: param spec + ZeRO sharding axis
         def init_state(k, v):
-            st = optimizer._init_state(v)
+            # init_leaf_state may wrap the tuple with an f32 master copy
+            # (multi_precision); master/state leaves all share the param's
+            # ZeRO sharding (same shapes)
+            st = optimizer.init_leaf_state(v)
             sh = NamedSharding(mesh, _zero_spec(self.param_specs[k], mesh,
                                                 v))
-            return tuple(jax.device_put(s, sh) for s in st)
+            return jax.tree.map(lambda s: jax.device_put(s, sh), st)
         self.opt_state = {k: init_state(k, v)
                           for k, v in self.params.items()}
 

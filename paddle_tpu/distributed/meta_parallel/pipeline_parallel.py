@@ -384,12 +384,13 @@ class PipelineParallel:
         self.edge = {k: jax.device_put(v, rep)
                      for k, v in self.edge.items()}
         self.opt_state = {
-            k: tuple(jax.device_put(s, pp_shard[k])
-                     for s in optimizer._init_state(v))
+            k: jax.tree.map(lambda s, _sh=pp_shard[k]:
+                            jax.device_put(s, _sh),
+                            optimizer.init_leaf_state(v))
             for k, v in self.stacked.items()}
         self.edge_opt_state = {
-            k: tuple(jax.device_put(s, rep)
-                     for s in optimizer._init_state(v))
+            k: jax.tree.map(lambda s: jax.device_put(s, rep),
+                            optimizer.init_leaf_state(v))
             for k, v in self.edge.items()}
 
         seg0 = segments[0]

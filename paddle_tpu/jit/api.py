@@ -210,7 +210,7 @@ class TrainStep:
         # model's own Parameters stay valid for eager use
         self.params = jax.tree.map(jnp.array, params)
         self.opt_state = jax.tree.map(
-            lambda v: self.optimizer._init_state(v), self.params,
+            lambda v: self.optimizer.init_leaf_state(v), self.params,
             is_leaf=lambda x: hasattr(x, "dtype"))
         self._step_i = 0
         self._mesh = mesh

@@ -171,10 +171,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
 
 def _choose_blocks(t_q, t_k, d):
-    bq = min(512, t_q)
-    bk = min(512, t_k)
+    # Biggest blocks win decisively on real TPU (measured on
+    # [128,1024,64] bf16: 1024x1024 runs fwd 1.9x / fwd+bwd 1.5x faster
+    # than 512x512; small bk is the worst axis to shrink). 1024x1024
+    # puts the f32 [bq, bk] score+prob tiles at ~8 MB of VMEM — about
+    # the ceiling once q/k/v/do/acc tiles are added, so the cap is the
+    # VMEM budget; round down to divisors of the seq lens.
+    bq = min(1024, t_q)
     while t_q % bq:
         bq //= 2
+    bk = min(1024, t_k)
     while t_k % bk:
         bk //= 2
     return max(bq, 1), max(bk, 1)

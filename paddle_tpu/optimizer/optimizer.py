@@ -163,9 +163,25 @@ class Optimizer:
     set_dict = set_state_dict
 
     # -- functional API for the jit path --------------------------------
+    def _f32_zeros(self, v):
+        """Optimizer accumulators live in f32 regardless of param dtype —
+        bf16 moments drop the (1-beta)*g increment once |m| >> |g|."""
+        return jnp.zeros(v.shape, jnp.float32)
+
+    def init_leaf_state(self, v):
+        """Per-param state for the jit/tree path. With multi_precision and
+        a low-precision param this wraps the inner state with an f32
+        master copy (reference: multi-precision adam,
+        paddle/fluid/operators/optimizers/adam_op.h); apply_gradients_tree
+        then updates the master and casts down only the working param."""
+        if self._multi_precision and v.dtype != jnp.float32:
+            vf = v.astype(jnp.float32)
+            return {"master": vf, "state": self._init_state(vf)}
+        return self._init_state(v)
+
     def init_tree_state(self, params_tree):
         import jax
-        return jax.tree.map(lambda v: self._init_state(v), params_tree,
+        return jax.tree.map(self.init_leaf_state, params_tree,
                             is_leaf=lambda x: hasattr(x, "dtype"))
 
     def apply_gradients_tree(self, params_tree, grads_tree, state_tree, lr,
@@ -182,15 +198,22 @@ class Optimizer:
         wd = self._decoupled_decay_coeff()
 
         def upd(p, g, s):
-            w = p.astype(jnp.float32)
+            # master-weight leaf (init_leaf_state, multi_precision): the
+            # f32 master accumulates sub-bf16-ulp updates; the working
+            # param is just its rounded shadow
+            master = None
+            if isinstance(s, dict) and "master" in s:
+                master, s = s["master"], s["state"]
+            w = master if master is not None else p.astype(jnp.float32)
             if wd:
                 w = w * (1.0 - lr * wd)
             np_, ns_ = self._update(w, g.astype(jnp.float32), s, lr, step)
-            np_ = np_.astype(p.dtype)
             ns_ = jax.tree.map(
                 lambda a, b: a.astype(b.dtype) if hasattr(b, "dtype") else a,
                 ns_, s)
-            return np_, ns_
+            if master is not None:
+                return np_.astype(p.dtype), {"master": np_, "state": ns_}
+            return np_.astype(p.dtype), ns_
 
         flat_p, treedef = jax.tree.flatten(params_tree)
         flat_g = treedef.flatten_up_to(grads_tree)
@@ -224,7 +247,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _init_state(self, v):
-        return (jnp.zeros(v.shape, jnp.float32),)
+        return (self._f32_zeros(v),)
 
     def _update(self, p, g, state, lr, step):
         (vel,) = state
@@ -281,8 +304,7 @@ class Adam(Optimizer):
         self._epsilon = epsilon
 
     def _init_state(self, v):
-        z = lambda: jnp.zeros(v.shape, jnp.float32)
-        return (z(), z())
+        return (self._f32_zeros(v), self._f32_zeros(v))
 
     def _update(self, p, g, state, lr, step):
         m, v = state
@@ -318,8 +340,7 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _init_state(self, v):
-        z = lambda: jnp.zeros(v.shape, jnp.float32)
-        return (z(), z())
+        return (self._f32_zeros(v), self._f32_zeros(v))
 
     def _update(self, p, g, state, lr, step):
         m, u = state
@@ -358,8 +379,7 @@ class Adadelta(Optimizer):
         self._epsilon, self._rho = epsilon, rho
 
     def _init_state(self, v):
-        z = lambda: jnp.zeros(v.shape, jnp.float32)
-        return (z(), z())
+        return (self._f32_zeros(v), self._f32_zeros(v))
 
     def _update(self, p, g, state, lr, step):
         acc_g, acc_x = state
@@ -380,8 +400,7 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def _init_state(self, v):
-        z = lambda: jnp.zeros(v.shape, jnp.float32)
-        return (z(), z(), z())
+        return (self._f32_zeros(v), self._f32_zeros(v), self._f32_zeros(v))
 
     def _update(self, p, g, state, lr, step):
         ms, mg, mom = state
@@ -408,8 +427,7 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def _init_state(self, v):
-        z = lambda: jnp.zeros(v.shape, jnp.float32)
-        return (z(), z())
+        return (self._f32_zeros(v), self._f32_zeros(v))
 
     def _update(self, p, g, state, lr, step):
         m, v = state

@@ -165,8 +165,10 @@ class TestJitStaticInference:
 class TestVision:
     @pytest.mark.parametrize("factory,shape", [
         ("resnet18", (1, 3, 64, 64)),
-        ("mobilenet_v2", (1, 3, 64, 64)),
-        ("vgg11", (1, 3, 224, 224)),
+        pytest.param("mobilenet_v2", (1, 3, 64, 64),
+                     marks=pytest.mark.heavy),
+        pytest.param("vgg11", (1, 3, 224, 224),
+                     marks=pytest.mark.heavy),
     ])
     def test_models_forward(self, factory, shape):
         import paddle_tpu.vision.models as vm

@@ -14,6 +14,7 @@ def _img(n=1, c=3, hw=64):
         rng.randn(n, c, hw, hw).astype(np.float32))
 
 
+@pytest.mark.heavy
 def test_resnext_forward_and_width():
     m = models.resnext50_32x4d(num_classes=10)
     m.eval()
@@ -25,6 +26,7 @@ def test_resnext_forward_and_width():
     assert m64.layer1[0].conv2.weight.shape[0] == 256
 
 
+@pytest.mark.heavy
 def test_densenet_forward():
     m = models.densenet121(num_classes=10)
     m.eval()
@@ -36,6 +38,7 @@ def test_densenet_forward():
         == 2208
 
 
+@pytest.mark.heavy
 def test_googlenet_three_outputs():
     m = models.googlenet(num_classes=10)
     m.eval()
@@ -45,6 +48,7 @@ def test_googlenet_three_outputs():
     assert tuple(aux2.shape) == (1, 10)
 
 
+@pytest.mark.heavy
 def test_inception_v3_forward():
     m = models.inception_v3(num_classes=10)
     m.eval()
@@ -52,6 +56,7 @@ def test_inception_v3_forward():
     assert tuple(out.shape) == (1, 10)
 
 
+@pytest.mark.heavy
 def test_shufflenet_variants():
     for fn, last in [(models.shufflenet_v2_x0_25, 512),
                      (models.shufflenet_v2_swish, 1024)]:
