@@ -41,23 +41,27 @@ def _run():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        # scan_remat="names": save only the three tagged per-block matmul
-        # outputs, recompute the rest in backward (measured best: 249 ms/
-        # step vs 262 ms full remat; scan_remat=False OOMs — the 24-layer
-        # lax.scan would stack >10 GB of residuals, see BENCH_r02.json).
-        # _run() retries with full remat if this config fails to compile.
+        # Fastest measured config: unrolled blocks (scan_layers=False),
+        # no remat — 193 ms/step vs 249 ms for scan+"names" remat and
+        # 262 ms for scan+full remat. The lax.scan path OOMed without
+        # remat because it stacks residuals as [24, ...] buffers
+        # (BENCH_r02.json); unrolled, XLA schedules/frees them per layer
+        # and everything fits. ~60 s compile. _run() retries on the
+        # scan+names config if this one fails.
         batch, seq = 8, 1024
-        remat = os.environ.get("BENCH_REMAT", "names")
+        remat = os.environ.get("BENCH_REMAT", "false")
         if remat not in ("true", "false", "names", "dots"):
             raise ValueError(f"BENCH_REMAT={remat!r}: expected "
                              "true|false|names|dots")
+        scan = os.environ.get("BENCH_SCAN", "0") == "1"
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=seq,
-                        dropout=0.0,
+                        dropout=0.0, scan_layers=scan,
                         scan_remat={"true": True,
                                     "false": False}.get(remat, remat))
     else:  # smoke-size on CPU so the script always runs
         batch, seq = 2, 128
+        remat = scan = None  # report keys: config not applied off-TPU
         cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
                         num_heads=4, max_position_embeddings=seq,
                         dropout=0.0)
@@ -149,7 +153,8 @@ def _run():
         "mfu_vs_measured_peak": round(
             6.0 * n_params * tokens_per_sec / (mm_tflops * 1e12), 4)
         if mm_tflops else 0.0,
-        "remat": os.environ.get("BENCH_REMAT", "names"),
+        "remat": remat,
+        "scan_layers": scan,
         "loss": round(float(loss.item()), 4),
     }))
 
@@ -161,13 +166,15 @@ def main():
             _run()
             return
         except Exception:
-            # selective-remat compile can be flaky through the remote
-            # compile helper — one retry on the full-remat config, but
-            # only when the operator didn't pin a config explicitly
-            if "BENCH_REMAT" in os.environ:
+            # the unrolled program is large — if its compile fails
+            # through the remote compile helper, fall back to the
+            # scan+selective-remat config; skip when the operator pinned
+            # a config explicitly
+            if "BENCH_REMAT" in os.environ or "BENCH_SCAN" in os.environ:
                 raise
             first_tb = traceback.format_exc()
-            os.environ["BENCH_REMAT"] = "true"
+            os.environ["BENCH_REMAT"] = "names"
+            os.environ["BENCH_SCAN"] = "1"
         _run()
     except Exception as e:  # diagnostic JSON line, never a bare traceback
         tb = traceback.format_exc()

@@ -221,10 +221,15 @@ class HybridTrainStep:
                 params_, grads, opt_state_, lr, step_i)
             return loss, new_params, new_state
 
-        state_shardings = {k: tuple(
-            NamedSharding(mesh, _zero_spec(self.param_specs[k], mesh,
-                                           self.params[k]))
-            for _ in self.opt_state[k]) for k in self.opt_state}
+        # mirror each state leaf's structure (tuple, or the
+        # {master, state} dict init_leaf_state builds for multi_precision)
+        state_shardings = {
+            k: jax.tree.map(
+                lambda _s, _sh=NamedSharding(
+                    mesh, _zero_spec(self.param_specs[k], mesh,
+                                     self.params[k])): _sh,
+                self.opt_state[k])
+            for k in self.opt_state}
         self._jitted = jax.jit(
             step_fn,
             donate_argnums=(0, 1) if donate else (),

@@ -88,6 +88,22 @@ class PagedCacheSlot:
         self.views = views
 
 
+def _remat_policy(scan_remat):
+    """Map cfg.scan_remat to a jax.checkpoint policy. True → full
+    recompute (policy None). "dots" → save non-batch matmul outputs.
+    "names" → save exactly the three big per-block matmul outputs (qkv,
+    attn out, ffn up — tagged via checkpoint_name below), recompute the
+    cheap rest; unlike "dots" this skips the flash-attention internals
+    and keeps HBM bounded at ~10*B*T*H bf16 per block."""
+    import jax
+    if scan_remat == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if scan_remat == "names":
+        return jax.checkpoint_policies.save_only_these_names(
+            "gpt_qkv", "gpt_attn_out", "gpt_ffn_in")
+    return None
+
+
 def _ckpt_name(t, name):
     """Tag a traced activation as a named remat save point. No-op in
     eager mode (concrete arrays go through the tape; re-wrapping would
@@ -282,14 +298,41 @@ class GPTModel(nn.Layer):
             x = self._scan_blocks(x)
             return self.ln_f(x)
         new_caches = []
+        remat_fn = self._unrolled_remat(x) if caches is None else None
         for i, block in enumerate(self.h):
             if caches is not None:
                 x, c = block(x, caches[i])
                 new_caches.append(c)
+            elif remat_fn is not None:
+                x = remat_fn(block, x)
             else:
                 x = block(x)
         x = self.ln_f(x)
         return (x, new_caches) if caches is not None else x
+
+    def _unrolled_remat(self, x):
+        """Per-block jax.checkpoint for the unrolled (scan_layers=False)
+        path, honoring cfg.scan_remat exactly like _scan_blocks — without
+        it, unrolled deep models lose memory control entirely. Only under
+        trace: the eager tape manages its own storage."""
+        import jax
+        if not self.cfg.scan_remat or not isinstance(x.value,
+                                                     jax.core.Tracer):
+            return None
+        policy = _remat_policy(self.cfg.scan_remat)
+
+        def call(block, h):
+            if not isinstance(block.mlp, GPTMLP):
+                # MoE block: MoELayer records its aux loss on the layer
+                # as a side channel; under jax.checkpoint that tracer
+                # would leak out of the inner trace — run it unwrapped
+                # (same reason _use_scan excludes MoE stacks)
+                return block(h)
+            fn = jax.checkpoint(lambda hv: block(Tensor(hv)).value,
+                                prevent_cse=False, policy=policy)
+            return Tensor(fn(h.value))
+
+        return call
 
     def _use_scan(self, x):
         """Scan only under trace (the eager tape can't see through a raw
@@ -328,19 +371,8 @@ class GPTModel(nn.Layer):
             # (near-full-checkpoint memory savings without paying the
             # recompute FLOPs of the matmuls). The scan's while-loop
             # already blocks unsound CSE.
-            policy = None
-            if self.cfg.scan_remat == "dots":
-                policy = jax.checkpoint_policies.\
-                    dots_with_no_batch_dims_saveable
-            elif self.cfg.scan_remat == "names":
-                # selective: save exactly the three big per-block matmul
-                # outputs (qkv, attn out, ffn up — tagged via
-                # checkpoint_name above), recompute the cheap rest.
-                # Unlike "dots" this skips the flash-attention internals
-                # and keeps HBM bounded at ~10*B*T*H bf16 per block.
-                policy = jax.checkpoint_policies.save_only_these_names(
-                    "gpt_qkv", "gpt_attn_out", "gpt_ffn_in")
-            step = jax.checkpoint(step, prevent_cse=False, policy=policy)
+            step = jax.checkpoint(step, prevent_cse=False,
+                                  policy=_remat_policy(self.cfg.scan_remat))
         y, _ = jax.lax.scan(lambda h, p: (step(h, p), None), x.value,
                             stacked)
         return Tensor(y)

@@ -177,10 +177,13 @@ def _choose_blocks(t_q, t_k, d):
     # puts the f32 [bq, bk] score+prob tiles at ~8 MB of VMEM — about
     # the ceiling once q/k/v/do/acc tiles are added, so the cap is the
     # VMEM budget; round down to divisors of the seq lens.
+    # the dkv backward holds ~3 concurrent f32 [bq, bk] tiles plus
+    # q/k/v/do tiles that scale with d — shrink bk for head dims > 64
+    # to stay inside the same budget the d=64 measurement validated
     bq = min(1024, t_q)
     while t_q % bq:
         bq //= 2
-    bk = min(1024, t_k)
+    bk = min(1024 * 64 // max(d, 64), t_k)
     while t_k % bk:
         bk //= 2
     return max(bq, 1), max(bk, 1)

@@ -48,7 +48,7 @@ def test_without_master_updates_may_round_away():
     assert not isinstance(leaf, dict)  # plain state, no master
 
 
-def test_master_weights_adam_converges_lower():
+def test_master_weights_sgd_converges_lower():
     stm = _train(multi_precision=True, steps=60)
     stp = _train(multi_precision=False, steps=60)
     wm = np.asarray(stm.opt_state["weight"]["master"])
@@ -56,3 +56,26 @@ def test_master_weights_adam_converges_lower():
     # both move, but the master path must have made at least as much
     # progress toward 0 (it never loses sub-ulp updates)
     assert wm.mean() <= wp.mean() + 1e-3
+
+
+def test_master_weights_adamw_moments_and_master():
+    paddle.seed(0)
+    m = nn.Linear(4, 1, bias_attr=False)
+    m.weight.set_value(jnp.full((4, 1), 256.0, jnp.float32))
+    m.bfloat16()
+    o = opt.AdamW(learning_rate=0.5, parameters=m.parameters(),
+                  multi_precision=True)
+    step = TrainStep(m, lambda out, y: nn.functional.mse_loss(out, y),
+                     o, donate=False)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype("bfloat16")
+    y = paddle.to_tensor(np.zeros((2, 1), np.float32)).astype("bfloat16")
+    for _ in range(10):
+        step(x, y)
+    leaf = step.opt_state["weight"]
+    assert isinstance(leaf, dict)
+    m1, v1 = leaf["state"]
+    assert m1.dtype == jnp.float32 and v1.dtype == jnp.float32
+    assert float(np.abs(np.asarray(m1)).max()) > 0  # moments advanced
+    master = np.asarray(leaf["master"])
+    assert np.all(master < 256.0)
+    assert step.params["weight"].dtype == jnp.bfloat16

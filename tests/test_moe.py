@@ -205,6 +205,35 @@ class TestGPTMoE:
         kinds = [type(b.mlp).__name__ for b in m.gpt.h]
         assert kinds == ["GPTMLP", "MoELayer", "GPTMLP", "MoELayer"]
 
+    def test_moe_with_unrolled_remat_trains(self):
+        # scan_remat on an unrolled MoE stack: dense blocks get
+        # jax.checkpoint, MoE blocks run unwrapped (their aux-loss side
+        # channel cannot cross a checkpoint trace)
+        import numpy as np
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        from paddle_tpu import optimizer as opt
+        import paddle_tpu.nn as nn
+        cfg = self._cfg()
+        cfg.scan_remat = "names"
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+
+        def loss_fn(lg, y):
+            V = lg.shape[-1]
+            return nn.functional.cross_entropy(
+                lg.reshape([-1, V]), y.reshape([-1]))
+
+        step = TrainStep(m, loss_fn, o)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(
+            rng.randint(0, 128, (2, 16)).astype(np.int32))
+        l0 = float(step(ids, ids).item())
+        for _ in range(8):
+            l = step(ids, ids)
+        assert float(l.item()) < l0
+
     def test_trains_through_fleet_dp_ep(self):
         from paddle_tpu.models.gpt import GPTForCausalLM
         strategy = fleet.DistributedStrategy()
