@@ -55,3 +55,9 @@ def test_train_vision_hapi():
     out = _run("train_vision_hapi.py", "--model", "resnet18",
                "--epochs", "1", "--batch", "32")
     assert "loss" in out or "acc" in out
+
+
+@pytest.mark.heavy
+def test_bench_decode():
+    out = _run("bench_decode.py")
+    assert "decode_tok_per_s" in out
