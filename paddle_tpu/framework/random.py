@@ -17,13 +17,24 @@ __all__ = ["seed", "get_rng_state", "set_rng_state", "split_key", "rng_scope"]
 
 
 class _RNGState(threading.local):
+    # `key` is created lazily: building it here would run an eager op at
+    # import time, initializing the JAX backend while Python's import
+    # lock is held — which breaks PJRT plugin discovery (the plugin's
+    # own module import gets skipped and its platform name vanishes
+    # from the backend list). Observed with the axon TPU plugin.
     def __init__(self):
-        self.key = jax.random.key(0)
+        self.key = None
         self.scope_key = None
         self.scope_counter = 0
 
 
 _state = _RNGState()
+
+
+def _key():
+    if _state.key is None:
+        _state.key = jax.random.key(0)
+    return _state.key
 
 
 def seed(s):
@@ -32,7 +43,7 @@ def seed(s):
 
 
 def get_rng_state():
-    return _state.key
+    return _key()
 
 
 def set_rng_state(key):
@@ -61,5 +72,5 @@ def split_key():
     if _state.scope_key is not None:
         _state.scope_counter += 1
         return jax.random.fold_in(_state.scope_key, _state.scope_counter)
-    _state.key, sub = jax.random.split(_state.key)
+    _state.key, sub = jax.random.split(_key())
     return sub
