@@ -1,0 +1,57 @@
+"""The approx (approx_max_k subset) sampling path must agree with the
+exact path — exercised on CPU via PADDLE_TPU_APPROX_SAMPLING=1."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+
+
+def _gen(approx, top_k=None, top_p=None, vocab=16384, temperature=1.0):
+    os.environ["PADDLE_TPU_APPROX_SAMPLING"] = "1" if approx else "0"
+    try:
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=32,
+                        dropout=0.0)
+        paddle.seed(7)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.array([[5, 9, 2]], np.int64))
+        paddle.seed(123)  # same RNG stream for both runs
+        out = m.generate(ids, max_new_tokens=8, top_k=top_k, top_p=top_p,
+                         temperature=temperature)
+        return np.asarray(out.value)
+    finally:
+        del os.environ["PADDLE_TPU_APPROX_SAMPLING"]
+
+
+# top_p alone uses temperature 0.2: a random-init model is near-uniform
+# over 16k tokens, whose nucleus exceeds the 4096-token subset — the
+# approx path then (by design) keeps everything instead of truncating;
+# sharpened logits put the nucleus inside the subset, where the two
+# paths must agree exactly
+@pytest.mark.parametrize("top_k,top_p,temp", [(50, None, 1.0),
+                                              (None, 0.9, 0.2),
+                                              (50, 0.9, 1.0)])
+def test_approx_matches_exact(top_k, top_p, temp):
+    # identical weights + identical keys: the sampled ids must match
+    # token-for-token when the threshold lives inside the subset
+    exact = _gen(False, top_k, top_p, temperature=temp)
+    approx = _gen(True, top_k, top_p, temperature=temp)
+    np.testing.assert_array_equal(exact, approx)
+
+
+def test_uniform_nucleus_falls_back_to_keep_all():
+    # nucleus wider than the subset: approx path must not truncate at
+    # the subset edge — it keeps the full distribution (still a valid
+    # sample, just unfiltered) instead of biasing toward the head
+    out = _gen(True, top_k=None, top_p=0.95)
+    assert out.shape == (1, 11)
+
+
+def test_large_top_k_falls_back_to_exact():
+    # top_k > subset size must still mask correctly (exact kth used)
+    out = _gen(True, top_k=8192, top_p=None)
+    assert out.shape == (1, 11)

@@ -61,3 +61,9 @@ def test_train_vision_hapi():
 def test_bench_decode():
     out = _run("bench_decode.py")
     assert "decode_tok_per_s" in out
+
+
+@pytest.mark.heavy
+def test_bench_bert():
+    out = _run("bench_bert.py")
+    assert "sequences_per_sec" in out
