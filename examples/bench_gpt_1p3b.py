@@ -1,0 +1,72 @@
+"""GPT-1.3B single-chip training benchmark.
+
+A 1.3B-param decoder trains on ONE 16 GB chip: bf16 params (2.6 GB) +
+f32 Momentum velocity (5.2 GB) + full activation remat over the scanned
+block stack (batch residuals stay [L, B, T, H] bf16). AdamW's two f32
+moments don't fit at this scale on one chip — shard optimizer state
+over the `sharding` mesh axis (ZeRO-1, distributed.fleet) for that.
+
+Measured on a v5e-class chip (seq 1024):
+  batch 1: 124 ms/step,  8.2k tokens/s
+  batch 4: 336 ms/step, 12.2k tokens/s (~49% nominal MFU)
+"""
+import json
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_1p3b, gpt_tiny
+
+
+def main():
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        batch, seq = 4, 1024
+        cfg = gpt_1p3b()
+        cfg.max_position_embeddings = seq
+    else:
+        batch, seq = 2, 32
+        cfg = gpt_tiny()
+    cfg.dropout = 0.0
+    cfg.scan_layers = True   # compile the block once, not per layer
+    cfg.scan_remat = True    # full recompute: activations stay tiny
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    o = opt.Momentum(learning_rate=1e-4, momentum=0.9,
+                     parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        V = logits.shape[-1]
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, o)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    for _ in range(2):
+        loss = step(ids, ids)
+    float(loss.item())
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    float(loss.item())
+    dt = (time.perf_counter() - t0) / iters
+    print(json.dumps({
+        "n_params": n_params, "batch": batch, "seq": seq,
+        "step_ms": round(dt * 1e3, 1),
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "loss": round(float(loss.item()), 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

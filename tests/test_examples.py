@@ -67,3 +67,9 @@ def test_bench_decode():
 def test_bench_bert():
     out = _run("bench_bert.py")
     assert "sequences_per_sec" in out
+
+
+@pytest.mark.heavy
+def test_bench_gpt_1p3b():
+    out = _run("bench_gpt_1p3b.py")
+    assert "tokens_per_sec" in out
