@@ -2,9 +2,13 @@
 
 A 1.3B-param decoder trains on ONE 16 GB chip: bf16 params (2.6 GB) +
 f32 Momentum velocity (5.2 GB) + full activation remat over the scanned
-block stack (batch residuals stay [L, B, T, H] bf16). AdamW's two f32
-moments don't fit at this scale on one chip — shard optimizer state
-over the `sharding` mesh axis (ZeRO-1, distributed.fleet) for that.
+block stack (batch residuals stay [L, B, T, H] bf16). Two caveats this
+squeeze accepts, both lifted by sharding over the fleet mesh (ZeRO-1,
+distributed.fleet) when more chips are available: AdamW's two f32
+moments don't fit, and neither do f32 master weights (multi_precision)
+— so per-step updates below a weight's bf16 ulp round away, which a
+long real pretraining run should not accept (bench_bert.py shows the
+master-weight recipe at a size where it fits).
 
 Measured on a v5e-class chip (seq 1024):
   batch 1: 124 ms/step,  8.2k tokens/s
