@@ -89,6 +89,23 @@ class PagedCacheSlot:
         self.views = views
 
 
+class PagedJitSlot:
+    """Traced twin of PagedCacheSlot for the fully-jitted decode step:
+    one layer's k/v page pools (traced, donated by the caller) plus the
+    host-planned write coordinates and read views (see
+    PagedKVCache.plan_decode)."""
+
+    __slots__ = ("k", "v", "pages", "in_pages", "pt", "lens")
+
+    def __init__(self, k, v, pages, in_pages, pt, lens):
+        self.k = k
+        self.v = v
+        self.pages = pages
+        self.in_pages = in_pages
+        self.pt = pt
+        self.lens = lens
+
+
 def _remat_policy(scan_remat):
     """Map cfg.scan_remat to a jax.checkpoint policy. True → full
     recompute (policy None). "dots" → save non-batch matmul outputs.
@@ -137,6 +154,8 @@ class GPTAttention(nn.Layer):
         q, k, v = qkv.unbind(axis=2)
         if isinstance(cache, StaticCacheSlot):
             return self._forward_static_cache(x, q, k, v, cache)
+        if isinstance(cache, PagedJitSlot):
+            return self._forward_paged_jit(x, q, k, v, cache)
         if isinstance(cache, PagedCacheSlot):
             return self._forward_paged_cache(x, q, k, v, cache)
         if cache is not None:  # legacy growing (k, v) protocol
@@ -180,6 +199,24 @@ class GPTAttention(nn.Layer):
             x.value.dtype)))
         return out, StaticCacheSlot(Tensor(kb), Tensor(vb), pos)
 
+
+    def _forward_paged_jit(self, x, q, k, v, slot):
+        """Traced decode step (T==1) over the paged pools: one batched
+        scatter writes every sequence's new k/v row into its page, then
+        one paged_attention gather reads each row's own history. All of
+        it lives inside the caller's single jitted program."""
+        from ..ops.paged_attention import paged_attention
+        B, T, H = x.shape
+        kd = slot.k.dtype
+        slot.k = slot.k.at[slot.pages, slot.in_pages].set(
+            k.value[:, 0].astype(kd))
+        slot.v = slot.v.at[slot.pages, slot.in_pages].set(
+            v.value[:, 0].astype(kd))
+        out = paged_attention(q.value[:, 0], slot.k, slot.v, slot.pt,
+                              slot.lens + 1)
+        out = self.out_proj(Tensor(out.reshape(B, 1, H).astype(
+            x.value.dtype)))
+        return out, slot
 
     def _forward_paged_cache(self, x, q, k, v, cache):
         """Continuous-batching path: write this step's k/v into the
@@ -282,6 +319,11 @@ class GPTModel(nn.Layer):
                                                  StaticCacheSlot):
                 pos_arr = caches[0].pos + jnp.arange(T, dtype=jnp.int32)
                 position_ids = Tensor(pos_arr[None, :])
+            elif caches is not None and isinstance(caches[0],
+                                                   PagedJitSlot):
+                # pre-write length IS the new token's position
+                position_ids = Tensor(
+                    caches[0].lens[:, None].astype(jnp.int32))
             elif caches is not None and isinstance(caches[0],
                                                    PagedCacheSlot):
                 pc = caches[0].cache
@@ -415,11 +457,63 @@ class GPTForCausalLM(nn.Layer):
         prefill when input_ids has T>1 (new request joining the batch),
         decode when T==1. Rows are independent sequences; lengths may be
         ragged — each attends only its own paged history. Returns
-        next-token logits [B, vocab]."""
+        next-token logits [B, vocab].
+
+        Decode runs as ONE jitted program (page pools donated, k/v rows
+        scatter-written in batch) — the host only plans page ids; the
+        per-layer host loop remains for prefill, where T varies."""
+        B, T = input_ids.shape
+        if T == 1:
+            return self._paged_decode_jit(cache, seq_ids, input_ids)
         caches = [PagedCacheSlot(cache, l, list(seq_ids), None)
                   for l in range(self.cfg.num_layers)]
         logits, _ = self(input_ids, caches=caches)
         return logits[:, -1, :]
+
+    def clear_decode_cache(self):
+        """Drop the cached decode params/programs. Call after loading or
+        mutating weights mid-serving (paged_decode_step reuses a frozen
+        param snapshot across steps)."""
+        self._paged_jit_fn = None
+        self._paged_params = None
+        self._gen_jit = {}
+
+    def _paged_decode_jit(self, cache, seq_ids, input_ids):
+        import jax
+        from ..jit.api import functional_call, state_arrays
+
+        L = self.cfg.num_layers
+        pages, in_pages, pt, lens = cache.plan_decode(seq_ids)
+        # params are frozen during serving: snapshot once (see
+        # clear_decode_cache for mid-serving weight swaps)
+        params = getattr(self, "_paged_params", None)
+        if params is None:
+            params = self._paged_params = state_arrays(self)[0]
+        fn = getattr(self, "_paged_jit_fn", None)
+        if fn is None:
+            model = self
+
+            def step(ps, kps, vps, toks, pages, in_pages, pt, lens):
+                slots = [PagedJitSlot(kps[l], vps[l], pages, in_pages,
+                                      pt, lens) for l in range(L)]
+                logits, out_slots = functional_call(
+                    model, ps, {}, (Tensor(toks),),
+                    kwargs={"caches": slots}, training=False)
+                return (logits[:, -1, :], [s.k for s in out_slots],
+                        [s.v for s in out_slots])
+
+            # pools donated: page writes update HBM in place; jax.jit's
+            # own cache keys on (B, table width) shapes
+            fn = self._paged_jit_fn = jax.jit(step, donate_argnums=(1, 2))
+        toks = input_ids.value.astype(jnp.int32)
+        logits, new_k, new_v = fn(
+            params, list(cache.k), list(cache.v), toks, pages, in_pages,
+            pt, lens)
+        cache.k = list(new_k)
+        cache.v = list(new_v)
+        for sid in seq_ids:
+            cache.advance(sid, 1)
+        return Tensor(logits)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None):

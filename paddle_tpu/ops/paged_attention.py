@@ -140,6 +140,24 @@ class PagedKVCache:
         """Commit n_tokens appended to EVERY layer."""
         self._len[seq_id] += n_tokens
 
+    def plan_decode(self, seq_ids):
+        """Host-side plan for ONE fully-jitted decode step: allocate
+        capacity for one new token per sequence and return
+        (pages [B], in_pages [B], page_table [B, width], lengths [B])
+        — the write coordinates and read views the jitted step needs.
+        Lengths are the PRE-write token counts; call advance(sid, 1)
+        after the step commits."""
+        for s in seq_ids:
+            self._ensure_capacity(s, 1)
+        P = self.page_size
+        pages = np.asarray(
+            [self._tables[s][self._len[s] // P] for s in seq_ids],
+            np.int32)
+        in_pages = np.asarray([self._len[s] % P for s in seq_ids],
+                              np.int32)
+        pt, lens = self.batch_views(seq_ids)
+        return jnp.asarray(pages), jnp.asarray(in_pages), pt, lens
+
     # ---- reads --------------------------------------------------------
     def batch_views(self, seq_ids):
         """(page_table [B, width] i32, lengths [B] i32) for a decode
