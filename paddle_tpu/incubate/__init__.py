@@ -335,21 +335,14 @@ def _fused_layers():
     return FusedMultiHeadAttention, FusedFeedForward
 
 
-class nn:
-    """paddle.incubate.nn — fused layers over the Pallas kernel paths."""
-
-    @staticmethod
-    def fused_multi_head_attention(*a, **k):
-        raise NotImplementedError(
-            "use nn.functional.scaled_dot_product_attention")
-
-
-nn.FusedMultiHeadAttention, nn.FusedFeedForward = _fused_layers()
-
+_FusedMultiHeadAttention, _FusedFeedForward = _fused_layers()
 
 from .moe import MoELayer as _MoELayer  # noqa: E402
 
-nn.MoELayer = _MoELayer
+# real submodule (paddle parity: `from paddle.incubate.nn import
+# FusedMultiHeadAttention` must work) — imported last so nn.py can read
+# the classes above off this partially-initialized package
+from . import nn  # noqa: E402
 
 
 LookAhead = optimizer.LookAhead

@@ -1,0 +1,17 @@
+"""paddle.incubate.nn — fused layers over the Pallas kernel paths.
+Parity: python/paddle/incubate/nn/__init__.py (FusedMultiHeadAttention,
+FusedFeedForward) plus the expert-parallel MoELayer."""
+import paddle_tpu.incubate as _inc
+
+FusedMultiHeadAttention = _inc._FusedMultiHeadAttention
+FusedFeedForward = _inc._FusedFeedForward
+MoELayer = _inc._MoELayer
+
+
+def fused_multi_head_attention(*a, **k):
+    raise NotImplementedError(
+        "use nn.functional.scaled_dot_product_attention")
+
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "MoELayer",
+           "fused_multi_head_attention"]

@@ -471,18 +471,26 @@ class GPTForCausalLM(nn.Layer):
         return logits[:, -1, :]
 
     def clear_decode_cache(self):
-        """Drop the cached decode params/programs. Call after loading or
+        """Refresh the decode param snapshot. Call after loading or
         mutating weights mid-serving (paged_decode_step reuses a frozen
-        param snapshot across steps)."""
-        self._paged_jit_fn = None
+        snapshot across steps). Compiled programs are kept — weights are
+        traced arguments, so the executables stay valid."""
         self._paged_params = None
-        self._gen_jit = {}
 
     def _paged_decode_jit(self, cache, seq_ids, input_ids):
         import jax
         from ..jit.api import functional_call, state_arrays
 
         L = self.cfg.num_layers
+        # context-limit guard: inside jit the wpe gather would silently
+        # clamp an out-of-range position to the last row (generate()
+        # raises for the same condition)
+        limit = self.cfg.max_position_embeddings
+        over = [s for s in seq_ids if cache.length(s) >= limit]
+        if over:
+            raise ValueError(
+                f"sequences {over!r} are at max_position_embeddings="
+                f"{limit}; free them or raise the limit")
         pages, in_pages, pt, lens = cache.plan_decode(seq_ids)
         # params are frozen during serving: snapshot once (see
         # clear_decode_cache for mid-serving weight swaps)
@@ -506,9 +514,20 @@ class GPTForCausalLM(nn.Layer):
             # own cache keys on (B, table width) shapes
             fn = self._paged_jit_fn = jax.jit(step, donate_argnums=(1, 2))
         toks = input_ids.value.astype(jnp.int32)
-        logits, new_k, new_v = fn(
-            params, list(cache.k), list(cache.v), toks, pages, in_pages,
-            pt, lens)
+        try:
+            logits, new_k, new_v = fn(
+                params, list(cache.k), list(cache.v), toks, pages,
+                in_pages, pt, lens)
+        except Exception as e:
+            # the pools were donated to the failed program — they are
+            # gone; make the poisoned state loud instead of letting the
+            # next step die with a bare "Array has been deleted"
+            cache.k = cache.v = None
+            raise RuntimeError(
+                "jitted paged decode step failed AFTER its page pools "
+                "were donated — this PagedKVCache is unrecoverable; "
+                "rebuild it with make_paged_cache() and re-prefill "
+                "in-flight sequences") from e
         cache.k = list(new_k)
         cache.v = list(new_v)
         for sid in seq_ids:

@@ -147,6 +147,11 @@ class PagedKVCache:
         — the write coordinates and read views the jitted step needs.
         Lengths are the PRE-write token counts; call advance(sid, 1)
         after the step commits."""
+        if len(set(seq_ids)) != len(seq_ids):
+            # duplicates would scatter two rows to the same (page,
+            # in_page) — one silently lost — then advance twice
+            raise ValueError(f"duplicate seq_ids in decode batch: "
+                             f"{seq_ids!r}")
         for s in seq_ids:
             self._ensure_capacity(s, 1)
         P = self.page_size
