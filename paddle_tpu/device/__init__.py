@@ -149,36 +149,7 @@ class Event:
         return max((end_event._t - self._t) * 1000.0, 0.0)
 
 
-class cuda:
-    """paddle.device.cuda shim mapping onto the TPU runtime."""
-    Stream = Stream
-    Event = Event
-
-    @staticmethod
-    def device_count():
-        return 0
-
-    @staticmethod
-    def synchronize(device=None):
-        synchronize()
-
-    @staticmethod
-    def max_memory_allocated(device=None):
-        d = _default_device()
-        if hasattr(d, "memory_stats"):
-            return d.memory_stats().get("peak_bytes_in_use", 0)
-        return 0
-
-    @staticmethod
-    def memory_allocated(device=None):
-        d = _default_device()
-        if hasattr(d, "memory_stats"):
-            return d.memory_stats().get("bytes_in_use", 0)
-        return 0
-
-    @staticmethod
-    def empty_cache():
-        pass
+from . import cuda  # noqa: E402  (real submodule, paddle parity)
 
 
 # ------------------------------------------------- extra device-type API

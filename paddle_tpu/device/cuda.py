@@ -1,0 +1,34 @@
+"""paddle.device.cuda shim mapping onto the TPU runtime.
+Parity: python/paddle/device/cuda/__init__.py — importable as a real
+submodule so `from paddle.device.cuda import synchronize` works."""
+from . import Stream, Event  # noqa: F401
+from . import synchronize as _synchronize, _default_device
+
+__all__ = ["Stream", "Event", "device_count", "synchronize",
+           "max_memory_allocated", "memory_allocated", "empty_cache"]
+
+
+def device_count():
+    return 0
+
+
+def synchronize(device=None):
+    _synchronize()
+
+
+def max_memory_allocated(device=None):
+    d = _default_device()
+    if hasattr(d, "memory_stats"):
+        return d.memory_stats().get("peak_bytes_in_use", 0)
+    return 0
+
+
+def memory_allocated(device=None):
+    d = _default_device()
+    if hasattr(d, "memory_stats"):
+        return d.memory_stats().get("bytes_in_use", 0)
+    return 0
+
+
+def empty_cache():
+    pass

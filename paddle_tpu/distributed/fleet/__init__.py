@@ -5,6 +5,8 @@ fleet.init(strategy) builds the hybrid mesh; distributed_model /
 distributed_optimizer return wrappers whose jit path is the
 HybridTrainStep SPMD program (hybrid_train.py).
 """
+import sys as _sys
+
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
 from .base.role_maker import (Role, PaddleCloudRoleMaker,
@@ -13,6 +15,22 @@ from .base.util_factory import UtilBase
 from .data_generator import (MultiSlotDataGenerator,
                              MultiSlotStringDataGenerator)
 from .hybrid_train import HybridTrainStep, default_param_rules
+# reference path parity: paddle.distributed.fleet.meta_parallel is the
+# same package as paddle.distributed.meta_parallel here. Alias the WHOLE
+# subtree in sys.modules (importing a deep path under the alias alone
+# would re-run modules with fleet-relative names and break their
+# relative imports), so `from paddle.distributed.fleet.meta_parallel
+# .parallel_layers import ColumnParallelLinear` works.
+from .. import meta_parallel
+import importlib as _importlib
+import pkgutil as _pkgutil
+
+_real = "paddle_tpu.distributed.meta_parallel"
+for _m in _pkgutil.walk_packages(meta_parallel.__path__, _real + "."):
+    _importlib.import_module(_m.name)
+for _name in [n for n in _sys.modules if n.startswith(_real)]:
+    _sys.modules[_name.replace(_real, __name__ + ".meta_parallel", 1)] = \
+        _sys.modules[_name]
 from .utils.recompute import (recompute, recompute_sequential,
                               recompute_hybrid)
 

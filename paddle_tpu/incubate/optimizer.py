@@ -1,0 +1,66 @@
+"""paddle.incubate.optimizer — LookAhead / ModelAverage.
+Parity: python/paddle/incubate/optimizer/__init__.py."""
+import contextlib
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = None
+        self._count = 0
+
+    def step(self):
+        from ..framework.core import no_grad
+        self.inner.step()
+        self._count += 1
+        if self._slow is None:
+            self._slow = [p.value for p in self.inner._parameters]
+        if self._count % self.k == 0:
+            with no_grad():
+                for p, s in zip(self.inner._parameters, self._slow):
+                    new_slow = s + self.alpha * (p.value - s)
+                    p.set_value(new_slow)
+                self._slow = [p.value for p in self.inner._parameters]
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self.parameters = parameters or []
+        self._sum = None
+        self._n = 0
+
+    def step(self):
+        if self._sum is None:
+            self._sum = [p.value for p in self.parameters]
+        else:
+            self._sum = [s + p.value
+                         for s, p in zip(self._sum, self.parameters)]
+        self._n += 1
+
+    def apply(self, executor=None, need_restore=True):
+        @contextlib.contextmanager
+        def ctx():
+            from ..framework.core import no_grad
+            backup = [p.value for p in self.parameters]
+            with no_grad():
+                for p, s in zip(self.parameters, self._sum):
+                    p.set_value(s / max(self._n, 1))
+            yield
+            if need_restore:
+                with no_grad():
+                    for p, b in zip(self.parameters, backup):
+                        p.set_value(b)
+        return ctx()

@@ -463,6 +463,16 @@ class GPTForCausalLM(nn.Layer):
         scatter-written in batch) — the host only plans page ids; the
         per-layer host loop remains for prefill, where T varies."""
         B, T = input_ids.shape
+        # context-limit guard (both paths): inside jit the wpe gather
+        # silently clamps out-of-range positions to the last row
+        # (generate() raises for the same condition)
+        limit = self.cfg.max_position_embeddings
+        over = [s for s in seq_ids if cache.length(s) + T > limit]
+        if over:
+            raise ValueError(
+                f"sequences {over!r} would exceed "
+                f"max_position_embeddings={limit} after {T} token(s); "
+                "free them or raise the limit")
         if T == 1:
             return self._paged_decode_jit(cache, seq_ids, input_ids)
         caches = [PagedCacheSlot(cache, l, list(seq_ids), None)
@@ -482,15 +492,6 @@ class GPTForCausalLM(nn.Layer):
         from ..jit.api import functional_call, state_arrays
 
         L = self.cfg.num_layers
-        # context-limit guard: inside jit the wpe gather would silently
-        # clamp an out-of-range position to the last row (generate()
-        # raises for the same condition)
-        limit = self.cfg.max_position_embeddings
-        over = [s for s in seq_ids if cache.length(s) >= limit]
-        if over:
-            raise ValueError(
-                f"sequences {over!r} are at max_position_embeddings="
-                f"{limit}; free them or raise the limit")
         pages, in_pages, pt, lens = cache.plan_decode(seq_ids)
         # params are frozen during serving: snapshot once (see
         # clear_decode_cache for mid-serving weight swaps)
@@ -519,6 +520,11 @@ class GPTForCausalLM(nn.Layer):
                 params, list(cache.k), list(cache.v), toks, pages,
                 in_pages, pt, lens)
         except Exception as e:
+            # donation only consumes the pools once the compiled program
+            # EXECUTES; a trace/compile failure leaves them valid
+            if not any(getattr(a, "is_deleted", lambda: False)()
+                       for a in (*cache.k, *cache.v)):
+                raise
             # the pools were donated to the failed program — they are
             # gone; make the poisoned state loud instead of letting the
             # next step die with a bare "Array has been deleted"
