@@ -27,7 +27,10 @@ import pkgutil as _pkgutil
 
 _real = "paddle_tpu.distributed.meta_parallel"
 for _m in _pkgutil.walk_packages(meta_parallel.__path__, _real + "."):
-    _importlib.import_module(_m.name)
+    try:
+        _importlib.import_module(_m.name)
+    except Exception:  # a broken leaf shouldn't break `import fleet`
+        pass
 for _name in [n for n in _sys.modules if n.startswith(_real)]:
     _sys.modules[_name.replace(_real, __name__ + ".meta_parallel", 1)] = \
         _sys.modules[_name]

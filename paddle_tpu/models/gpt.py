@@ -492,6 +492,11 @@ class GPTForCausalLM(nn.Layer):
         from ..jit.api import functional_call, state_arrays
 
         L = self.cfg.num_layers
+        if cache.k is None:
+            raise RuntimeError(
+                "this PagedKVCache was poisoned by an earlier failed "
+                "step — rebuild it with make_paged_cache() and "
+                "re-prefill in-flight sequences")
         pages, in_pages, pt, lens = cache.plan_decode(seq_ids)
         # params are frozen during serving: snapshot once (see
         # clear_decode_cache for mid-serving weight swaps)

@@ -1,0 +1,35 @@
+"""Reference import-path parity: every `from paddle.X.Y import Z` form a
+migrating user relies on must resolve as a real module path here."""
+import importlib
+
+import pytest
+
+
+@pytest.mark.parametrize("path,names", [
+    ("paddle_tpu.incubate.nn",
+     ["FusedMultiHeadAttention", "FusedFeedForward", "MoELayer"]),
+    ("paddle_tpu.incubate.optimizer", ["LookAhead", "ModelAverage"]),
+    ("paddle_tpu.device.cuda",
+     ["synchronize", "device_count", "max_memory_allocated", "Stream",
+      "Event"]),
+    ("paddle_tpu.distributed.fleet.meta_parallel",
+     ["PipelineLayer", "PipelineParallel"]),
+    ("paddle_tpu.distributed.fleet.meta_parallel.parallel_layers",
+     ["ColumnParallelLinear", "RowParallelLinear",
+      "VocabParallelEmbedding"]),
+    ("paddle_tpu.distributed.fleet.meta_parallel.sharding", []),
+    ("paddle_tpu.nn.functional", ["relu", "cross_entropy"]),
+    ("paddle_tpu.optimizer.lr", ["LRScheduler", "NoamDecay"]),
+    ("paddle_tpu.vision.transforms", ["Compose", "Resize"]),
+    ("paddle_tpu.static.nn", ["fc", "cond", "while_loop"]),
+])
+def test_module_path_and_names(path, names):
+    mod = importlib.import_module(path)
+    for n in names:
+        assert hasattr(mod, n), f"{path}.{n} missing"
+
+
+def test_fleet_alias_is_same_package():
+    import paddle_tpu.distributed.meta_parallel as real
+    import paddle_tpu.distributed.fleet.meta_parallel as aliased
+    assert aliased is real
