@@ -5,8 +5,10 @@ is the default for O1/O2 ('use_bf16'); fp16 paths keep the reference's
 dynamic loss scaling semantics in GradScaler. auto_cast works by flipping
 a thread-local dtype policy consulted by op dispatch: matmul/conv-class
 ops run in the low dtype (white list), numerically-sensitive ops
-(softmax/log/norms — black list) stay fp32, mirroring
-paddle/fluid/imperative/amp_auto_cast.cc's lists.
+(softmax/log/reductions — black list) stay fp32, mirroring
+paddle/fluid/imperative/amp_auto_cast.cc's lists; norm layers compute
+their statistics in f32 internally (nn/functional/norm.py) instead of
+being input-cast.
 """
 import threading
 
@@ -20,8 +22,12 @@ __all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
            "is_auto_cast_enabled", "get_amp_dtype"]
 
 WHITE_LIST = {"matmul", "conv", "einsum", "bmm", "mm", "linear"}
+# norm-family ops are NOT black-listed here: layer_norm/batch_norm compute
+# their statistics in f32 internally regardless of amp (nn/functional/
+# norm.py) and return the input dtype, which keeps the bf16 activation
+# flow intact under O2 — stronger than an input-cast ever is.
 BLACK_LIST = {"exp", "log", "softmax", "log_softmax", "cross_entropy",
-              "mean", "sum", "norm", "layer_norm", "batch_norm"}
+              "mean", "sum"}
 
 
 class _AmpState(threading.local):
@@ -41,6 +47,10 @@ def is_auto_cast_enabled():
 
 
 def get_amp_dtype():
+    """Introspection only. NEVER consult this inside a function recorded on
+    the eager tape: backward replays outside the autocast context, so any
+    dtype decision must be baked at record time via apply_op(op_name=...)
+    -> amp_op_dtype."""
     return _state.dtype if _state.enabled else None
 
 
@@ -74,22 +84,35 @@ class auto_cast:
 amp_guard = auto_cast
 
 
-def amp_cast(x, op_name="matmul"):
-    """Cast an input for op `op_name` per the active policy (used by the
-    functional layer wrappers on the jit path)."""
-    if not _state.enabled:
-        return x
+def amp_op_dtype(op_name):
+    """Resolve the compute dtype for `op_name` under the active policy, at
+    RECORD time. Returns None when no cast applies. The caller (apply_op)
+    bakes the result into the taped closure so backward's jax.vjp re-derives
+    the exact forward dtypes — the thread-local must never be consulted
+    inside a recorded fn (ref: amp_auto_cast.cc casts participate in the
+    autograd graph for the same reason)."""
+    if not _state.enabled or op_name is None:
+        return None
     name = op_name.lower()
-    in_white = name in WHITE_LIST | _state.custom_white
-    in_black = name in BLACK_LIST | _state.custom_black
-    arr = x.value if isinstance(x, Tensor) else x
-    if not jnp.issubdtype(arr.dtype, jnp.floating):
-        return x
+    in_white = name in WHITE_LIST or name in _state.custom_white
+    in_black = name in BLACK_LIST or name in _state.custom_black
     if _state.level == "O2":
-        target = jnp.float32 if in_black else _state.dtype
-    else:
-        target = _state.dtype if (in_white and not in_black) else jnp.float32
-    if arr.dtype == target:
+        return jnp.float32 if in_black else _state.dtype
+    if in_black:
+        return jnp.float32
+    return _state.dtype if in_white else None
+
+
+def amp_cast(x, op_name="matmul"):
+    """Cast an input for op `op_name` per the active policy. Delegates to
+    amp_op_dtype so the eager tape (apply_op op_name=...) and any direct
+    callers resolve the SAME target — one source of truth for the
+    white/black-list semantics."""
+    target = amp_op_dtype(op_name)
+    if target is None:
+        return x
+    arr = x.value if isinstance(x, Tensor) else x
+    if not jnp.issubdtype(arr.dtype, jnp.floating) or arr.dtype == target:
         return x
     return x.astype(target) if isinstance(x, Tensor) else arr.astype(target)
 
@@ -147,15 +170,20 @@ class GradScaler:
         if not self._enable:
             return
         inv = 1.0 / self._scale
-        found = False
+        found_dev = jnp.asarray(False)
         with no_grad():
             for p in optimizer._parameters:
                 if p.grad is None:
                     continue
-                g = p.grad.value * inv
-                found = found or bool(jnp.any(~jnp.isfinite(g)))
-                p.grad = Tensor(g)
-        self._found_inf = found
+                # unscale in f32: 1/scale underflows fp16 normals for large
+                # scales, and inf detection must see the pre-cast values
+                g32 = p.grad.value.astype(jnp.float32) * inv
+                # accumulate the inf check on device; one host sync below
+                found_dev = jnp.logical_or(
+                    found_dev, jnp.any(~jnp.isfinite(g32)))
+                p.grad = Tensor(g32.astype(p.grad.value.dtype))
+        self._found_inf = bool(found_dev)
+        self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:

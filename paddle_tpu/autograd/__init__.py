@@ -25,6 +25,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 
 class PyLayerContext:
+    """Parity: python/paddle/autograd/py_layer.py PyLayerContext —
+    `saved_tensor` is a METHOD there (`y, = ctx.saved_tensor()`, py_layer.py:88),
+    so it is one here; arbitrary attributes may also be stashed on ctx."""
+
     def __init__(self):
         self._saved = ()
         self._materialize_grads = True
@@ -32,7 +36,6 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = tensors
 
-    @property
     def saved_tensor(self):
         return self._saved
 
@@ -72,13 +75,15 @@ class PyLayer:
 
         from ..framework.core import _Node
         diff_in = [t for t in tensor_args if not t.stop_gradient]
-
-        def node_fn(*in_arrays):
-            # identity in the forward direction; custom vjp via PyLayerNode
-            raise RuntimeError("PyLayer node should not re-run forward")
+        # the user's backward returns one grad per TENSOR input of forward
+        # (reference py_layer.py contract); only the requires-grad subset
+        # feeds the tape, so record which positions those are
+        diff_pos = tuple(i for i, t in enumerate(tensor_args)
+                         if not t.stop_gradient)
 
         node = _PyLayerNode(cls, ctx, [t._slot for t in diff_in],
-                            [o._slot for o in outs], multi)
+                            [o._slot for o in outs], multi, diff_pos,
+                            len(tensor_args))
         for o in outs:
             o._slot.node = node
             o.stop_gradient = False
@@ -87,22 +92,53 @@ class PyLayer:
 
 class _PyLayerNode:
     """Tape node whose vjp is the user's backward()."""
-    __slots__ = ("cls", "ctx", "in_slots", "out_slots", "multi", "fn")
+    __slots__ = ("cls", "ctx", "in_slots", "out_slots", "multi", "fn",
+                 "diff_pos", "n_tensor_args")
 
-    def __init__(self, cls, ctx, in_slots, out_slots, multi):
+    def __init__(self, cls, ctx, in_slots, out_slots, multi, diff_pos,
+                 n_tensor_args):
         self.cls = cls
         self.ctx = ctx
         self.in_slots = in_slots
         self.out_slots = out_slots
         self.multi = multi
+        self.diff_pos = diff_pos
+        self.n_tensor_args = n_tensor_args
         self.fn = None  # engine checks fn only through run_vjp below
+
+    def _select(self, grads):
+        """Align the user's backward return with the requires-grad inputs.
+        Reference contract (py_layer.py): one grad per TENSOR input of
+        forward; grads for stop_gradient inputs are discarded. A return of
+        exactly one grad per requires-grad input is also accepted. Any
+        other count is an error — never silently dropped."""
+        if len(grads) == self.n_tensor_args:
+            return tuple(grads[i] for i in self.diff_pos)
+        if len(grads) == len(self.diff_pos):
+            return tuple(grads)
+        raise ValueError(
+            f"{self.cls.__name__}.backward returned {len(grads)} "
+            f"gradient(s) but forward took {self.n_tensor_args} tensor "
+            f"input(s) ({len(self.diff_pos)} requiring grad)")
 
     def run_vjp(self, cots):
         grads = self.cls.backward(
             self.ctx, *[Tensor(c) for c in cots]) if self.multi else \
             self.cls.backward(self.ctx, Tensor(cots[0]))
         grads = grads if isinstance(grads, (tuple, list)) else (grads,)
-        return tuple(g.value if isinstance(g, Tensor) else g for g in grads)
+        return tuple(g.value if isinstance(g, Tensor) else g
+                     for g in self._select(grads))
+
+    def run_vjp_taped(self, cot_tensors):
+        """create_graph path: the user's backward runs WITH the tape on, so
+        the ops it performs (over saved forward tensors and the taped
+        cotangents) record nodes — the returned grads are differentiable.
+        Parity: reference PyLayer supports higher-order grad
+        (py_layer.py:30 backward composes with the dygraph engine)."""
+        grads = self.cls.backward(self.ctx, *cot_tensors) if self.multi \
+            else self.cls.backward(self.ctx, cot_tensors[0])
+        grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+        return self._select(grads)
 
 
 # ---- functional transforms (jax-native) ------------------------------

@@ -115,18 +115,34 @@ def _backward_pass(root_slots, seed_grads, retain_graph,
         if any(o.grad is not None for o in node.out_slots):
             if hasattr(node, "run_vjp"):  # PyLayer custom backward
                 if create_graph:
-                    raise NotImplementedError(
-                        "create_graph=True through a PyLayer: its custom "
-                        "backward is not taped; compose jax transforms "
-                        "(autograd.vjp/jvp) for higher-order grads instead")
-                with no_grad():
-                    cots = tuple(o.grad if o.grad is not None
-                                 else jnp.zeros_like(o.val)
-                                 for o in node.out_slots)
-                    in_cots = node.run_vjp(cots)
-                    for s, g in zip(node.in_slots, in_cots):
-                        if g is not None:
+                    # run the user's backward ON the tape: cotangents are
+                    # taped Tensors, the ops inside backward() record
+                    # nodes, and the returned grads carry those nodes —
+                    # double grad through PyLayer (ref py_layer.py:30)
+                    cot_tensors = []
+                    for o in node.out_slots:
+                        cs = gslots[id(o)] if o.grad is not None \
+                            else _Slot(jnp.zeros_like(o.val))
+                        t = Tensor(cs)
+                        t.stop_gradient = False
+                        cot_tensors.append(t)
+                    in_grads = node.run_vjp_taped(cot_tensors)
+                    for s, g in zip(node.in_slots, in_grads):
+                        if g is None:
+                            continue
+                        if isinstance(g, Tensor):
+                            acc(s, g.value, g_slot=g._slot)
+                        else:
                             acc(s, g)
+                else:
+                    with no_grad():
+                        cots = tuple(o.grad if o.grad is not None
+                                     else jnp.zeros_like(o.val)
+                                     for o in node.out_slots)
+                        in_cots = node.run_vjp(cots)
+                        for s, g in zip(node.in_slots, in_cots):
+                            if g is not None:
+                                acc(s, g)
             elif create_graph:
                 k = len(node.in_slots)
                 cot_slots = tuple(

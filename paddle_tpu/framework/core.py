@@ -371,12 +371,30 @@ def _requires_grad(t):
     return isinstance(t, Tensor) and not t.stop_gradient
 
 
-def apply_op(fn, *tensors, n_outputs=None):
+def apply_op(fn, *tensors, n_outputs=None, op_name=None):
     """Execute a pure jax function over Tensor inputs; record tape if needed.
 
     `fn` takes the unwrapped jax arrays positionally (non-tensor config must
     be closed over by the caller) and returns one array or a tuple.
+
+    `op_name` opts the op into amp.auto_cast dispatch: when autocast is
+    active the policy dtype is resolved HERE (record time) and baked into
+    the closure, so backward replay re-derives identical dtypes even though
+    it runs outside the autocast context.
     """
+    if op_name is not None:
+        from ..amp import amp_op_dtype
+        amp_dt = amp_op_dtype(op_name)
+        if amp_dt is not None:
+            inner = fn
+
+            def fn(*args, _f=inner, _dt=amp_dt):
+                cast = [a.astype(_dt)
+                        if hasattr(a, "dtype")
+                        and jnp.issubdtype(a.dtype, jnp.floating) else a
+                        for a in args]
+                return _f(*cast)
+
     arrays = [t.value if isinstance(t, Tensor) else t for t in tensors]
     out = fn(*arrays)
     multi = isinstance(out, (tuple, list))

@@ -4,5 +4,7 @@ from .api import to_static, not_to_static, TrainStep, functional_call, \
 from .save_load import save, load, TranslatedLayer, InputSpec
 from .debug import TracedLayer, ProgramTranslator, set_code_level, \
     set_verbosity, get_code_level, get_verbosity
+from . import dy2static
+from .dy2static import enable_to_static
 
 declarative = to_static

@@ -51,11 +51,23 @@ def _restore(saved):
 
 
 def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
-                    training=None):
-    """Run layer.forward with the given arrays bound — pure w.r.t. inputs."""
+                    training=None, convert=False):
+    """Run layer.forward with the given arrays bound — pure w.r.t. inputs.
+
+    convert=True routes forward through dy2static first, so plain Python
+    control flow over tensors lowers onto lax under the trace (the
+    to_static / jit.save path)."""
     kwargs = kwargs or {}
     arrays = dict(params)
     arrays.update(buffers)
+    conv_prev, conv_had = None, False
+    if convert:
+        import types as _types
+        from .dy2static import convert_to_static
+        conv = convert_to_static(type(layer).forward)
+        conv_had = "forward" in layer.__dict__
+        conv_prev = layer.__dict__.get("forward")
+        layer.__dict__["forward"] = _types.MethodType(conv, layer)
     saved = _bind(layer, arrays)
     prev_training = layer.training
     try:
@@ -72,6 +84,11 @@ def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
     finally:
         _restore(saved)
         layer.train() if prev_training else layer.eval()
+        if convert:
+            if conv_had:
+                layer.__dict__["forward"] = conv_prev
+            else:
+                layer.__dict__.pop("forward", None)
     return jax.tree.map(
         lambda t: t.value if isinstance(t, Tensor) else t, out,
         is_leaf=lambda t: isinstance(t, Tensor))
@@ -122,17 +139,22 @@ class StaticFunction:
         return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
 
     def _compile(self, sig, example_args):
+        from .dy2static import convert_to_static
         if self._is_layer:
             layer = self._obj
             training = layer.training if self._training is None \
                 else self._training
 
+            # dy2static: convert the forward's Python control flow so
+            # tensor-dependent if/while lowers onto lax under the trace
+            # (falls back to the original on unsupported constructs)
             def pure(params, buffers, key, *xs):
                 return functional_call(layer, params, buffers, xs,
-                                       rng_key=key, training=training)
+                                       rng_key=key, training=training,
+                                       convert=True)
             jitted = jax.jit(pure)
         else:
-            fn = self._obj
+            fn = convert_to_static(self._obj)
 
             def pure(key, *xs):
                 with no_grad(), rng_scope(key):

@@ -1,0 +1,23 @@
+"""paddle.jit.dy2static — the dygraph→static conversion subsystem.
+
+Parity: python/paddle/fluid/dygraph/dygraph_to_static/ (~9.6k LoC of AST
+transformation + runtime converters). TPU-native scope: conversion targets
+jax.lax control flow through the convert_operators runtime; everything
+data-independent stays plain Python and is simply traced.
+"""
+from .convert_operators import (
+    UNDEFINED, convert_ifelse, convert_ifexp, convert_while_loop,
+    convert_for, convert_for_range, convert_logical_and, convert_logical_or,
+    convert_logical_not, convert_var_to_bool, convert_call, not_returned)
+from .program_translator import (
+    convert_to_static, conversion_enabled, ProgramTranslator,
+    enable_to_static, unwrap_converted)
+
+__all__ = [
+    "UNDEFINED", "convert_ifelse", "convert_ifexp", "convert_while_loop",
+    "convert_for", "convert_for_range", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "convert_var_to_bool",
+    "convert_call", "not_returned", "convert_to_static",
+    "conversion_enabled", "ProgramTranslator", "enable_to_static",
+    "unwrap_converted",
+]

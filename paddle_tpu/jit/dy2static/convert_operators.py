@@ -1,0 +1,425 @@
+"""Runtime converters the rewritten AST dispatches to.
+
+Parity: python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py:26
+(convert_ifelse / convert_while_loop / convert_logical_*). TPU-native
+design: instead of building ProgramDesc cond/while blocks, a converted
+construct decides AT TRACE TIME whether its condition is a traced tensor —
+if so it lowers onto XLA control flow (select for `if`, lax.while_loop /
+fori_loop for loops: static shapes, compiler-friendly); otherwise it
+executes ordinary Python, preserving eager semantics exactly (including
+short-circuiting and non-tensor locals).
+
+Variable plumbing: the AST pass emits `__jst_get_N`/`__jst_set_N` closures
+over the enclosing frame's locals (nonlocal-writing), so branch/body
+functions mutate locals naturally and the converters can snapshot, re-run,
+and select without frame hacking.
+
+`if` lowering note: both branches are executed under the trace and merged
+with a per-leaf select (jnp.where) — the jnp.where formulation XLA compiles
+cond to anyway when branches are cheap, and the only formulation that
+tolerates branches assigning fresh Tensors over Python scalars. Matching
+shapes/dtypes across branches are required, as with lax.cond.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+
+__all__ = [
+    "UNDEFINED", "convert_ifelse", "convert_ifexp", "convert_while_loop",
+    "convert_for", "convert_for_range", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "convert_var_to_bool",
+    "convert_call", "not_returned",
+]
+
+
+class _Undefined:
+    """Sentinel for a name not yet bound when a converted construct starts.
+    Reads of it fail loudly (ref: variable_trans_func UndefinedVar)."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<undefined local>"
+
+    def __bool__(self):
+        raise NameError(
+            "local variable used before assignment inside converted "
+            "control flow")
+
+
+UNDEFINED = _Undefined()
+
+
+def _is_traced(x):
+    v = x.value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
+
+
+def _is_tensorish(x):
+    return isinstance(x, (Tensor, jax.Array)) or \
+        type(x).__name__ == "ArrayImpl"
+
+
+def _raw(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _pred(c):
+    v = c.value if isinstance(c, Tensor) else jnp.asarray(c)
+    return jnp.reshape(v, ()).astype(bool)
+
+
+def _arrs(vals):
+    """Tensor leaves -> arrays (tuple positions only, no nesting)."""
+    return tuple(v.value if isinstance(v, Tensor) else v for v in vals)
+
+
+def _tens(vals):
+    """array leaves -> Tensors."""
+    return tuple(Tensor(v) if hasattr(v, "dtype") and hasattr(v, "shape")
+                 else v for v in vals)
+
+
+def convert_var_to_bool(x):
+    if isinstance(x, Tensor):
+        if _is_traced(x):
+            return x
+        return bool(x.numpy().reshape(()))
+    return x
+
+
+def convert_logical_and(lhs, rhs_fn):
+    """`a and b` with short-circuit preserved for non-tensor `a`."""
+    if _is_tensorish(lhs):
+        rhs = rhs_fn()
+        if _is_tensorish(rhs):
+            return Tensor(jnp.logical_and(_pred(lhs), _pred(rhs)))
+        return Tensor(jnp.logical_and(_pred(lhs), bool(rhs)))
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs, rhs_fn):
+    if _is_tensorish(lhs):
+        rhs = rhs_fn()
+        if _is_tensorish(rhs):
+            return Tensor(jnp.logical_or(_pred(lhs), _pred(rhs)))
+        return Tensor(jnp.logical_or(_pred(lhs), bool(rhs)))
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_tensorish(x):
+        return Tensor(jnp.logical_not(_pred(x)))
+    return not x
+
+
+def not_returned(flag):
+    return convert_logical_not(flag)
+
+
+def _select_leaf(pred_arr, tv, fv, name):
+    """Merge one carried local across the two branches of a converted if."""
+    # identical object / equal value: nothing to select
+    if tv is fv:
+        return tv
+    internal = name.startswith("__jst_")
+    missing_t = tv is UNDEFINED or tv is None
+    missing_f = fv is UNDEFINED or fv is None
+    if (missing_t or missing_f) and not (missing_t and missing_f):
+        # transformer-internal slots (__jst_ret before any return fired)
+        # may be one-sided: the guard discipline guarantees the dead side
+        # is never read, so fill it with zeros of the live side's shape
+        live = fv if missing_t else tv
+        if internal and _is_tensorish(live):
+            la = _raw(live)
+            dead = jnp.zeros_like(la)
+            ta, fa = (dead, la) if missing_t else (la, dead)
+            return Tensor(jnp.where(pred_arr, ta, fa))
+        branch = "false" if missing_t else "true"
+        raise ValueError(
+            f"variable '{name}' is assigned in only the {branch} branch "
+            "of a tensor-dependent `if`; both branches must define it")
+    t_tensorish = _is_tensorish(tv)
+    f_tensorish = _is_tensorish(fv)
+    if not (t_tensorish or f_tensorish):
+        # equal concrete values stay python (e.g. an untouched local)
+        try:
+            if tv == fv:
+                return tv
+        except Exception:
+            pass
+    scalar = (bool, int, float)
+    if t_tensorish or f_tensorish or (
+            isinstance(tv, scalar) and isinstance(fv, scalar)):
+        # genuinely data-dependent value: select on device. Divergent
+        # python scalars (e.g. the early-return flag) tensorize here —
+        # that is the honest semantics: their value depends on the traced
+        # predicate.
+        ta, fa = _raw(tv), _raw(fv)
+        ta = jnp.asarray(ta) if not hasattr(ta, "dtype") else ta
+        fa = jnp.asarray(fa) if not hasattr(fa, "dtype") else fa
+        if ta.shape != fa.shape:
+            raise ValueError(
+                f"tensor-dependent `if`: variable '{name}' has shape "
+                f"{ta.shape} in the true branch but {fa.shape} in the "
+                "false branch; graph control flow needs matching shapes")
+        return Tensor(jnp.where(pred_arr, ta, fa))
+    try:
+        if tv == fv:
+            return tv
+    except Exception:
+        pass
+    raise ValueError(
+        f"variable '{name}' takes different non-tensor values in the two "
+        f"branches of a tensor-dependent `if` ({tv!r} vs {fv!r}); a traced "
+        "predicate can only select between tensors")
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_vars, set_vars,
+                   var_names=None):
+    """Plain Python if for concrete predicates; trace-both-and-select for
+    traced ones."""
+    pred = convert_var_to_bool(pred)
+    if not _is_tensorish(pred):
+        (true_fn if pred else false_fn)()
+        return
+    if not _is_traced(pred):
+        (true_fn if bool(jax.device_get(_pred(pred))) else false_fn)()
+        return
+
+    snapshot = _arrs(get_vars())  # immutable arrays / python objects
+
+    set_vars(_tens(snapshot))
+    true_fn()
+    tvals = get_vars()
+    set_vars(_tens(snapshot))
+    false_fn()
+    fvals = get_vars()
+
+    p = _pred(pred)
+    names = var_names or [f"#{i}" for i in range(len(tvals))]
+    merged = tuple(_select_leaf(p, tv, fv, n)
+                   for tv, fv, n in zip(tvals, fvals, names))
+    set_vars(merged)
+
+
+def convert_ifexp(pred, true_fn, false_fn):
+    """`a if c else b` expression form."""
+    pred = convert_var_to_bool(pred)
+    if not _is_tensorish(pred):
+        return true_fn() if pred else false_fn()
+    if not _is_traced(pred):
+        return true_fn() if bool(jax.device_get(_pred(pred))) \
+            else false_fn()
+    tv, fv = true_fn(), false_fn()
+    return _select_leaf(_pred(pred), tv, fv, "<ifexp>")
+
+
+def _type_undefined_carry(carry0, body_fn, get_vars, set_vars, kind):
+    """Loop-local vars (assigned inside the body, unbound before the loop)
+    enter the lax carry as UNDEFINED — lax needs a typed value. Run the
+    body ONCE speculatively at the current trace level to learn their
+    types, seed them with zeros of that type, and let XLA dead-code-
+    eliminate the speculative ops. A read-before-write of such a var
+    inside the speculative run still hits the UNDEFINED sentinel and
+    fails loudly (matching Python's UnboundLocalError discipline)."""
+    if not any(v is UNDEFINED for v in carry0):
+        return carry0
+    body_fn()
+    probed = get_vars()
+    seeded = []
+    for v0, pv in zip(carry0, probed):
+        if v0 is not UNDEFINED:
+            seeded.append(v0)
+        elif _is_tensorish(pv):
+            seeded.append(Tensor(jnp.zeros_like(_raw(pv))))
+        elif pv is UNDEFINED:
+            raise ValueError(
+                f"a loop-local variable is never assigned on some path "
+                f"through this converted `{kind}` body; define it before "
+                "the loop")
+        else:
+            seeded.append(pv)
+    seeded = tuple(seeded)
+    set_vars(seeded)
+    return seeded
+
+
+def _carryable(v):
+    return _is_tensorish(v) or isinstance(v, (bool, int, float)) \
+        or v is UNDEFINED
+
+
+def _subset_accessors(get_vars, set_vars, idx):
+    """get/set restricted to carry positions `idx`; other locals stay
+    whatever the (traced-once) body last bound them to — they are
+    non-tensor, so they cannot be data-dependent anyway."""
+    def sub_get():
+        full = get_vars()
+        return tuple(full[i] for i in idx)
+
+    def sub_set(vals):
+        full = list(get_vars())
+        for i, v in zip(idx, vals):
+            full[i] = v
+        set_vars(tuple(full))
+    return sub_get, sub_set
+
+
+def convert_while_loop(cond_fn, body_fn, get_vars, set_vars):
+    """Runs as an ordinary Python while as long as the condition is
+    concrete (each such iteration simply unrolls under a trace, exactly
+    like round-3 trace-only behavior); the moment the condition becomes a
+    traced value, the REMAINING iterations lower onto one lax.while_loop.
+    Non-arrayable locals (str/list/None...) never enter the lax carry —
+    they keep their traced-body binding, which is sound because a
+    non-tensor value cannot depend on traced data."""
+    while True:
+        c = cond_fn()
+        if _is_tensorish(c) and _is_traced(c):
+            break
+        if not convert_var_to_bool(c):
+            return
+        body_fn()
+
+    full0 = _type_undefined_carry(get_vars(), body_fn, get_vars,
+                                  set_vars, "while")
+    idx = tuple(i for i, v in enumerate(full0) if _carryable(v))
+    get_c, set_c = _subset_accessors(get_vars, set_vars, idx)
+    carry0 = get_c()
+
+    def _cond(carry):
+        set_c(_tens(carry))
+        return _pred(cond_fn())
+
+    def _body(carry):
+        set_c(_tens(carry))
+        body_fn()
+        return _arrs(get_c())
+
+    out = jax.lax.while_loop(_cond, _body, _arrs(carry0))
+    set_c(_tens(out))
+
+
+def convert_for(iterable, target_set, body_fn, get_vars, set_vars):
+    """`for <tgt> in <iterable>:` — ordinary Python iteration for concrete
+    iterables (a trace unrolls it); ONE lax.fori_loop over the leading dim
+    for a traced Tensor (no unroll, compile time stays flat)."""
+    if not (isinstance(iterable, Tensor) and _is_traced(iterable)):
+        if isinstance(iterable, Tensor):
+            for i in range(iterable.shape[0]):
+                target_set(iterable[i])
+                body_fn()
+            return
+        for item in iterable:
+            target_set(item)
+            body_fn()
+        return
+
+    arr = iterable.value
+    # bind the target to a typed prototype BEFORE capturing the carry so
+    # its slot is not UNDEFINED, then type any other loop-locals
+    target_set(Tensor(jax.lax.dynamic_index_in_dim(
+        arr, 0, axis=0, keepdims=False)))
+    full0 = _type_undefined_carry(get_vars(), body_fn, get_vars,
+                                  set_vars, "for")
+    idx = tuple(i for i, v in enumerate(full0) if _carryable(v))
+    get_c, set_c = _subset_accessors(get_vars, set_vars, idx)
+    carry0 = get_c()
+
+    def _body(i, carry):
+        set_c(_tens(carry))
+        target_set(Tensor(jax.lax.dynamic_index_in_dim(
+            arr, i, axis=0, keepdims=False)))
+        body_fn()
+        return _arrs(get_c())
+
+    out = jax.lax.fori_loop(0, arr.shape[0], _body, _arrs(carry0))
+    set_c(_tens(out))
+
+
+def convert_for_range(range_args, target_set, body_fn, get_vars, set_vars):
+    """`for i in range(...)` where a bound may be a traced tensor: lowers
+    to lax.while_loop with the counter in the carry. Concrete bounds run
+    the ordinary Python range loop (trace unrolls it)."""
+    args = [a.value if isinstance(a, Tensor) else a for a in range_args]
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args[:3]
+    if not any(isinstance(a, jax.core.Tracer) for a in (start, stop, step)):
+        for i in range(int(start) if hasattr(start, "dtype") else start,
+                       int(stop) if hasattr(stop, "dtype") else stop,
+                       int(step) if hasattr(step, "dtype") else step):
+            target_set(i)
+            body_fn()
+        return
+
+    i0 = jnp.asarray(start, jnp.int32)
+    stop_a = jnp.asarray(stop, jnp.int32)
+    step_a = jnp.asarray(step, jnp.int32)
+    target_set(Tensor(i0))
+    full0 = _type_undefined_carry(get_vars(), body_fn, get_vars,
+                                  set_vars, "for")
+    idx = tuple(i for i, v in enumerate(full0) if _carryable(v))
+    get_c, set_c = _subset_accessors(get_vars, set_vars, idx)
+    carry0 = get_c()
+
+    def _cond(c):
+        i = c[0]
+        return jnp.where(step_a > 0, i < stop_a, i > stop_a)
+
+    def _body(c):
+        i, carry = c
+        set_c(_tens(carry))
+        target_set(Tensor(i))
+        body_fn()
+        return (i + step_a, _arrs(get_c()))
+
+    _, out = jax.lax.while_loop(_cond, _body, (i0, _arrs(carry0)))
+    set_c(_tens(out))
+
+
+# ---------------------------------------------------------------- calls
+_NEVER_CONVERT_MODULE_PREFIXES = (
+    "paddle_tpu", "jax", "jaxlib", "numpy", "builtins", "math", "functools",
+    "itertools", "collections", "typing", "torch", "flax", "optax",
+)
+
+
+def convert_call(fn):
+    """Recursively convert user callees so their control flow converts too
+    (ref convert_call, convert_operators.py:26). Framework / library
+    callables pass through; any conversion failure falls back to the
+    original callable (reference behavior: warn-and-fallback)."""
+    from .program_translator import convert_to_static, conversion_enabled
+
+    if not conversion_enabled():
+        return fn
+    try:
+        if getattr(fn, "_not_to_static", False):
+            return fn
+        if getattr(fn, "__paddle_tpu_converted__", False):
+            return fn
+        if not callable(fn) or isinstance(fn, type):
+            return fn
+        from ...nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            return fn  # sub-layer calls keep eager-trace semantics; the
+            # layer's own forward converts when it goes through to_static
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return fn
+        mod = getattr(fn, "__module__", "") or ""
+        if mod.split(".")[0] in _NEVER_CONVERT_MODULE_PREFIXES:
+            return fn
+        return convert_to_static(fn)
+    except Exception:
+        return fn

@@ -1,0 +1,147 @@
+"""Function-level conversion driver.
+
+Parity: python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:1
+(ProgramTranslator + StaticFunction conversion caching). TPU-native
+difference: conversion produces an ordinary Python function whose control
+flow dispatches through convert_operators (lowering onto jax.lax under
+trace); there is no Program/Block IR — XLA is the graph program.
+
+Fallback contract: any function that cannot be converted (unsupported
+construct, source unavailable, exotic closure) is returned UNCHANGED, which
+preserves round-3 behavior: tracing works for everything except
+tensor-dependent Python control flow.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import threading
+import types
+import warnings
+
+from . import convert_operators as _ops
+from .transformers import apply_transforms, UnsupportedConversion, JST
+
+__all__ = ["convert_to_static", "conversion_enabled", "ProgramTranslator",
+           "unwrap_converted"]
+
+_cache = {}  # code object -> converted function (closure-free fns only)
+_code_cache = {}  # code object -> (compiled module code, fn name) for
+# closure-bearing functions: the expensive getsource+parse+transform runs
+# once; per-call work is just exec with the current closure values
+_fail_cache = set()  # code objects whose conversion failed: don't retry
+_state = threading.local()
+
+
+def conversion_enabled():
+    """Conversion is governed by the SAME singleton switch as
+    jit-compilation (paddle.jit.ProgramTranslator, jit/debug.py) — one
+    source of truth, matching the reference where ProgramTranslator.enable
+    gates both."""
+    if not getattr(_state, "enabled", True):
+        return False
+    from ..debug import ProgramTranslator as _PT
+    return bool(getattr(_PT, "enable_to_static", True))
+
+
+# re-export the canonical singleton for parity imports from dy2static
+from ..debug import ProgramTranslator  # noqa: E402
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator.enable_to_static = bool(flag)
+
+
+def unwrap_converted(fn):
+    return getattr(fn, "__paddle_tpu_original__", fn)
+
+
+def _should_skip(tree):
+    """Constructs that make re-exec unsafe: zero-arg super() needs the
+    __class__ cell; locals()/globals()/eval/exec see a different frame."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in (
+                "super", "locals", "globals", "eval", "exec", "vars"):
+            return node.id
+    return None
+
+
+def convert_to_static(fn):
+    """Return a control-flow-converted version of `fn` (cached), or `fn`
+    itself when conversion is not possible/needed."""
+    if not conversion_enabled():
+        return fn
+    if getattr(fn, "__paddle_tpu_converted__", False):
+        return fn
+    if isinstance(fn, types.MethodType):
+        conv = convert_to_static(fn.__func__)
+        if conv is fn.__func__:
+            return fn
+        return types.MethodType(conv, fn.__self__)
+
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn
+    if code in _fail_cache:
+        return fn
+    cacheable = fn.__closure__ is None
+    if cacheable and code in _cache:
+        cached = _cache[code]
+        return cached if cached is not None else fn
+
+    try:
+        converted = _convert(fn)
+    except (UnsupportedConversion, OSError, TypeError, SyntaxError,
+            IndentationError) as e:
+        if isinstance(e, UnsupportedConversion):
+            warnings.warn(
+                f"to_static: falling back to trace-only for "
+                f"{getattr(fn, '__qualname__', fn)}: {e}")
+        converted = None
+        _fail_cache.add(code)
+    if cacheable:
+        _cache[code] = converted
+    return converted if converted is not None else fn
+
+
+def _convert(fn):
+    cached = _code_cache.get(fn.__code__)
+    if cached is None:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fn_node = tree.body[0]
+        if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        skip = _should_skip(fn_node)
+        if skip is not None:
+            raise UnsupportedConversion(f"use of `{skip}`")
+        fn_node.decorator_list = []
+
+        apply_transforms(fn_node)
+
+        filename = f"<dy2static {getattr(fn, '__qualname__', fn.__name__)}>"
+        compiled = compile(ast.Module(body=[fn_node], type_ignores=[]),
+                           filename, "exec")
+        cached = (compiled, fn_node.name)
+        _code_cache[fn.__code__] = cached
+    compiled, fname = cached
+
+    ns = dict(fn.__globals__)
+    ns[JST] = _ops
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                ns[name] = cell.cell_contents
+            except ValueError:  # empty cell (e.g. recursive def)
+                ns[name] = fn
+    exec(compiled, ns)
+    new_fn = ns[fname]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn,
+                             assigned=("__module__", "__name__",
+                                       "__qualname__", "__doc__"),
+                             updated=())
+    new_fn.__paddle_tpu_converted__ = True
+    new_fn.__paddle_tpu_original__ = fn
+    return new_fn

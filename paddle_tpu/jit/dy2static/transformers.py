@@ -1,0 +1,441 @@
+"""AST passes converting Python control flow into converter calls.
+
+Parity: the reference's dygraph_to_static transformer stack —
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py,
+return_transformer.py, call_transformer.py. Same job, different target:
+the reference rewrites into ProgramDesc block ops; these passes rewrite
+into `_jst.convert_*` runtime calls (convert_operators.py) which lower
+onto jax.lax control flow only when the condition is actually traced.
+
+Mechanics: a converted `if`/`while`/`for` body becomes a nested function
+that declares `nonlocal` for every name it assigns, plus `__jst_get_N` /
+`__jst_set_N` accessors over those names, so the runtime can snapshot,
+re-run, and select locals without any frame hacking. Names possibly
+undefined before the statement are pre-bound to `_jst.UNDEFINED` through a
+`try/except` probe, which both makes `nonlocal` legal and gives loud
+use-before-assignment errors.
+"""
+import ast
+
+__all__ = ["UnsupportedConversion", "apply_transforms", "JST"]
+
+JST = "_jst"  # module alias injected into the exec namespace
+_RET = "__jst_ret"
+_FLAG = "__jst_did_return"
+
+
+class UnsupportedConversion(Exception):
+    """Raised when a construct cannot be converted; the caller falls back
+    to the untransformed function (reference: warn-and-fallback)."""
+
+
+# ---------------------------------------------------------------- helpers
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(attr):
+    return ast.Attribute(value=_name(JST), attr=attr, ctx=ast.Load())
+
+
+def _jst_call(attr, args):
+    return ast.Call(func=_jst_attr(attr), args=args, keywords=[])
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+def _carry_names(names):
+    """Drop transformer-generated helper names (nested converted
+    constructs' defs/accessors) from a carry; the return-machinery slots
+    (__jst_ret/__jst_did_return) DO carry."""
+    return [n for n in names
+            if not n.startswith("__jst_") or n in (_RET, _FLAG)]
+
+
+def assigned_names(stmts):
+    """Names bound by a statement list, NOT descending into nested
+    function/class scopes (their assignments are their own locals)."""
+    names = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+
+        def visit_Subscript(self, node):
+            # a[i] = v: carry `a` so a TENSOR target operates on a fresh
+            # re-wrapped Tensor per branch (its jax array is immutable, so
+            # snapshot/select is sound). NOTE: mutation of python
+            # containers (dict/list) in a tensor-dependent branch is NOT
+            # isolated — both branches execute under trace and the object
+            # mutates unconditionally; same caveat as the reference's
+            # side-effect limitations.
+            if isinstance(node.ctx, ast.Store) and isinstance(
+                    node.value, ast.Name):
+                names.add(node.value.id)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            names.add(node.name)  # the def binds its name; skip its body
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Import(self, node):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+
+        visit_ImportFrom = visit_Import
+
+        def visit_ExceptHandler(self, node):
+            if node.name:
+                names.add(node.name)
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return sorted(names)
+
+
+def _contains(node_or_list, types, *, into_loops=True):
+    """Does the subtree contain a node of `types`, not counting nested
+    function/class scopes (and optionally not descending into loops)?"""
+    found = False
+
+    class V(ast.NodeVisitor):
+        def generic_visit(self, node):
+            nonlocal found
+            if found:
+                return
+            if isinstance(node, types):
+                found = True
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if not into_loops and isinstance(node, (ast.While, ast.For)):
+                return
+            super().generic_visit(node)
+
+    nodes = node_or_list if isinstance(node_or_list, list) else [node_or_list]
+    for n in nodes:
+        V().visit(n)
+        if found:
+            break
+    return found
+
+
+def _undef_probe(name):
+    """try: name \n except (NameError, UnboundLocalError): name = UNDEFINED"""
+    return ast.Try(
+        body=[ast.Expr(value=_name(name))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Tuple(elts=[_name("NameError"),
+                                 _name("UnboundLocalError")],
+                           ctx=ast.Load()),
+            name=None,
+            body=[ast.Assign(targets=[_name(name, ast.Store())],
+                             value=_jst_attr("UNDEFINED"))])],
+        orelse=[], finalbody=[])
+
+
+def _nonlocal_or_pass(names):
+    return [ast.Nonlocal(names=list(names))] if names else [ast.Pass()]
+
+
+def _def(fname, body, args=()):
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=a)
+                                                 for a in args],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=body, decorator_list=[], returns=None, type_params=[])
+
+
+def _getter(fname, names):
+    return _def(fname, [ast.Return(value=ast.Tuple(
+        elts=[_name(n) for n in names], ctx=ast.Load()))])
+
+
+def _setter(fname, names):
+    body = _nonlocal_or_pass(names)
+    if names:
+        body = [ast.Nonlocal(names=list(names)),
+                ast.Assign(
+                    targets=[ast.Tuple(elts=[_name(n, ast.Store())
+                                             for n in names],
+                                       ctx=ast.Store())],
+                    value=_name("__jst_vals"))]
+    else:
+        body = [ast.Pass()]
+    return _def(fname, body, args=("__jst_vals",))
+
+
+# ----------------------------------------------------- return transformer
+class ReturnTransformer:
+    """Rewrites early returns (returns nested under `if`) into
+    `__jst_ret/__jst_did_return` assignments with guarded continuations, so
+    a tensor-dependent `if` containing `return` converts cleanly.
+    Parity: return_transformer.py. Returns nested inside loops are not
+    converted (UnsupportedConversion -> whole-function fallback)."""
+
+    def run(self, fn_node):
+        body = fn_node.body
+        if not self._has_early_return(body):
+            for st in body:  # still recurse into nested defs
+                self._recurse_nested(st)
+            return fn_node
+        new_body, _ = self._block(body)
+        fn_node.body = (
+            [ast.Assign(targets=[_name(_FLAG, ast.Store())],
+                        value=_const(False)),
+             ast.Assign(targets=[_name(_RET, ast.Store())],
+                        value=_const(None))]
+            + new_body
+            + [ast.Return(value=_name(_RET))])
+        return fn_node
+
+    def _recurse_nested(self, node):
+        for child in ast.walk(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not node:
+                self.run(child)
+
+    def _has_early_return(self, body):
+        for st in body[:-1]:
+            if _contains(st, ast.Return):
+                return True
+        last = body[-1] if body else None
+        if last is not None and not isinstance(last, ast.Return) \
+                and _contains(last, ast.Return):
+            return True
+        return False
+
+    def _block(self, stmts):
+        """Returns (new_stmts, may_return)."""
+        out = []
+        for k, st in enumerate(stmts):
+            if isinstance(st, ast.Return):
+                val = st.value if st.value is not None else _const(None)
+                out.append(ast.Assign(
+                    targets=[_name(_RET, ast.Store())], value=val))
+                out.append(ast.Assign(
+                    targets=[_name(_FLAG, ast.Store())], value=_const(True)))
+                return out, True  # rest is dead code
+            if not _contains(st, ast.Return):
+                self._recurse_nested(st)
+                out.append(st)
+                continue
+            if isinstance(st, ast.If):
+                b, br = self._block(st.body)
+                o, orr = self._block(st.orelse) if st.orelse else ([], False)
+                st.body = b
+                st.orelse = o
+                out.append(st)
+                rest, rest_ret = self._block(stmts[k + 1:]) \
+                    if k + 1 < len(stmts) else ([], False)
+                if rest:
+                    guard = ast.If(
+                        test=_jst_call("not_returned", [_name(_FLAG)]),
+                        body=rest, orelse=[])
+                    out.append(guard)
+                return out, True
+            if isinstance(st, (ast.While, ast.For, ast.Try, ast.With)):
+                raise UnsupportedConversion(
+                    f"`return` inside a {type(st).__name__.lower()} block "
+                    "cannot be converted to graph control flow; hoist the "
+                    "return out of the loop")
+            raise UnsupportedConversion(
+                f"`return` nested in {type(st).__name__}")
+        return out, False
+
+
+# ----------------------------------------- control-flow (stmt) transformer
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For statements into `_jst.convert_*` dispatch.
+    Parity: ifelse_transformer.py + loop_transformer.py."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # Leave nested scopes' internals to their own visit (mechanics are
+    # scope-local, so plain recursion is correct).
+
+    def _convert_block(self, stmts):
+        out = []
+        for st in stmts:
+            r = self.visit(st)
+            out.extend(r if isinstance(r, list) else [r])
+        return out or [ast.Pass()]
+
+    def visit_FunctionDef(self, node):
+        node.body = self._convert_block(node.body)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_If(self, node):
+        uid = self._uid()
+        body = self._convert_block(node.body)
+        orelse = self._convert_block(node.orelse) if node.orelse \
+            else [ast.Pass()]
+        names = _carry_names(assigned_names(body + orelse))
+        t, f = f"__jst_true_{uid}", f"__jst_false_{uid}"
+        g, s = f"__jst_get_{uid}", f"__jst_set_{uid}"
+        stmts = [_undef_probe(n) for n in names]
+        stmts.append(_def(t, _nonlocal_or_pass(names) + body))
+        stmts.append(_def(f, _nonlocal_or_pass(names) + orelse))
+        stmts.append(_getter(g, names))
+        stmts.append(_setter(s, names))
+        stmts.append(ast.Expr(value=_jst_call(
+            "convert_ifelse",
+            [node.test, _name(t), _name(f), _name(g), _name(s),
+             ast.Tuple(elts=[_const(n) for n in names], ctx=ast.Load())])))
+        for st in stmts:
+            ast.copy_location(st, node)
+        return stmts
+
+    def visit_While(self, node):
+        if node.orelse or _contains(node.body, (ast.Break, ast.Continue),
+                                    into_loops=False):
+            # while/else or break/continue: leave as Python (eager works;
+            # a traced condition will fail loudly at the bool() coercion)
+            node.body = self._convert_block(node.body)
+            return node
+        uid = self._uid()
+        body = self._convert_block(node.body)
+        names = _carry_names(assigned_names(body))
+        c, b = f"__jst_cond_{uid}", f"__jst_body_{uid}"
+        g, s = f"__jst_get_{uid}", f"__jst_set_{uid}"
+        stmts = [_undef_probe(n) for n in names]
+        stmts.append(_def(c, [ast.Return(value=node.test)]))
+        stmts.append(_def(b, _nonlocal_or_pass(names) + body))
+        stmts.append(_getter(g, names))
+        stmts.append(_setter(s, names))
+        stmts.append(ast.Expr(value=_jst_call(
+            "convert_while_loop",
+            [_name(c), _name(b), _name(g), _name(s)])))
+        for st in stmts:
+            ast.copy_location(st, node)
+        return stmts
+
+    def visit_For(self, node):
+        if node.orelse or _contains(node.body, (ast.Break, ast.Continue),
+                                    into_loops=False):
+            node.body = self._convert_block(node.body)
+            return node
+        uid = self._uid()
+        body = self._convert_block(node.body)
+        # loop-target names are assigned by iteration itself
+        tgt_names = assigned_names([ast.Assign(
+            targets=[node.target], value=_const(None))])
+        names = _carry_names(
+            sorted(set(assigned_names(body)) | set(tgt_names)))
+        ts, b = f"__jst_tgt_{uid}", f"__jst_body_{uid}"
+        g, s = f"__jst_get_{uid}", f"__jst_set_{uid}"
+        stmts = [_undef_probe(n) for n in names]
+        # def __jst_tgt(v): nonlocal <tgts>; <target> = v
+        tgt_assign = ast.Assign(targets=[node.target],
+                                value=_name("__jst_vals"))
+        stmts.append(_def(ts, [ast.Nonlocal(names=list(tgt_names)),
+                               tgt_assign] if tgt_names else [ast.Pass()],
+                          args=("__jst_vals",)))
+        stmts.append(_def(b, _nonlocal_or_pass(names) + body))
+        stmts.append(_getter(g, names))
+        stmts.append(_setter(s, names))
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range" and not it.keywords:
+            call = _jst_call("convert_for_range",
+                             [ast.Tuple(elts=list(it.args), ctx=ast.Load()),
+                              _name(ts), _name(b), _name(g), _name(s)])
+        else:
+            call = _jst_call("convert_for",
+                             [it, _name(ts), _name(b), _name(g), _name(s)])
+        stmts.append(ast.Expr(value=call))
+        for st in stmts:
+            ast.copy_location(st, node)
+        return stmts
+
+
+# ------------------------------------------- expression-level transformer
+class ExprTransformer(ast.NodeTransformer):
+    """BoolOp / Not / IfExp / Call conversion.
+    Parity: logical_transformer.py + call_transformer.py."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        vals = node.values
+        attr = "convert_logical_and" if isinstance(node.op, ast.And) \
+            else "convert_logical_or"
+        expr = vals[0]
+        for v in vals[1:]:
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=v)
+            expr = _jst_call(attr, [expr, thunk])
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                _jst_call("convert_logical_not", [node.operand]), node)
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        mk = lambda b: ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=b)
+        return ast.copy_location(
+            _jst_call("convert_ifexp",
+                      [node.test, mk(node.body), mk(node.orelse)]), node)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and (
+                f.id.startswith("__jst_") or f.id in ("super", "locals",
+                                                      "globals", "range")):
+            return node
+        if isinstance(f, ast.Attribute):
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == JST:
+                return node
+            # method calls (x.foo()) pass through: bound methods of
+            # framework objects dominate; user functions are almost always
+            # called by bare name
+            return node
+        if isinstance(f, ast.Name):
+            node.func = ast.copy_location(
+                _jst_call("convert_call", [f]), f)
+        return node
+
+
+def apply_transforms(fn_node):
+    """Run the full pass pipeline over one FunctionDef."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, (ast.Global,)):
+            raise UnsupportedConversion("`global` declarations")
+    ReturnTransformer().run(fn_node)
+    ControlFlowTransformer().visit(fn_node)
+    ExprTransformer().visit(fn_node)
+    ast.fix_missing_locations(fn_node)
+    return fn_node

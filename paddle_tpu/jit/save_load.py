@@ -69,7 +69,8 @@ def save(layer, path, input_spec=None, **configs):
                                        s.value.dtype) for s in input_spec]
 
     def pure(params, buffers, *xs):
-        return functional_call(layer, params, buffers, xs, training=False)
+        return functional_call(layer, params, buffers, xs, training=False,
+                               convert=True)
 
     exported = jax_export.export(jax.jit(pure))(
         jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),

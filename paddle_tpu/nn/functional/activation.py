@@ -100,7 +100,7 @@ def softmax(x, axis=-1, dtype=None, name=None):
         if dt is not None:
             a = a.astype(dt)
         return jax.nn.softmax(a, axis=axis)
-    return apply_op(fn, x)
+    return apply_op(fn, x, op_name="softmax")
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
@@ -116,7 +116,7 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
         if dt is not None:
             a = a.astype(dt)
         return jax.nn.log_softmax(a, axis=axis)
-    return apply_op(fn, x)
+    return apply_op(fn, x, op_name="log_softmax")
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):

@@ -10,21 +10,17 @@ from ...framework.random import split_key
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b, W shaped [in, out] (paddle layout). Pure MXU work;
-    under amp.auto_cast the matmul runs in the policy dtype (bf16)."""
-    from ...amp import get_amp_dtype
-
+    under amp.auto_cast the matmul runs in the policy dtype (bf16) — the
+    cast is baked at record time by apply_op(op_name=...) so backward
+    replays with identical dtypes."""
     def fn(a, w, *rest):
-        dt = get_amp_dtype()
-        if dt is not None and jnp.issubdtype(a.dtype, jnp.floating):
-            out = a.astype(dt) @ w.astype(dt)
-        else:
-            out = a @ w
+        out = a @ w
         if rest:
             out = out + rest[0].astype(out.dtype)
         return out
     if bias is None:
-        return apply_op(fn, x, weight)
-    return apply_op(fn, x, weight, bias)
+        return apply_op(fn, x, weight, op_name="linear")
+    return apply_op(fn, x, weight, bias, op_name="linear")
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",

@@ -79,8 +79,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
         return out
 
     if bias is not None:
-        return apply_op(fn, x, weight, bias)
-    return apply_op(fn, x, weight)
+        return apply_op(fn, x, weight, bias, op_name="conv")
+    return apply_op(fn, x, weight, op_name="conv")
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,

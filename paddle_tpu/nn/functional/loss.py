@@ -88,7 +88,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             return jnp.sum(loss) / n
         return _reduce(loss, reduction)
     args = [input, label] + ([weight] if weight is not None else [])
-    return apply_op(fn, *args)
+    return apply_op(fn, *args, op_name="cross_entropy")
 
 
 softmax_with_cross_entropy = None  # defined below

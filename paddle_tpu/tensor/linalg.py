@@ -16,7 +16,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
         return jnp.matmul(a, b)
-    return apply_op(fn, x, y)
+    return apply_op(fn, x, y, op_name="matmul")
 
 
 def dot(x, y, name=None):
@@ -28,7 +28,7 @@ def dot(x, y, name=None):
 
 
 def bmm(x, y, name=None):
-    return apply_op(jnp.matmul, x, y)
+    return apply_op(jnp.matmul, x, y, op_name="bmm")
 
 
 def mv(x, vec, name=None):
@@ -36,7 +36,7 @@ def mv(x, vec, name=None):
 
 
 def mm(input, mat2, name=None):
-    return apply_op(jnp.matmul, input, mat2)
+    return apply_op(jnp.matmul, input, mat2, op_name="mm")
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):

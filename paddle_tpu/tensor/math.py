@@ -25,11 +25,11 @@ def _wrap_binary(jfn):
     return op
 
 
-def _wrap_unary(jfn):
+def _wrap_unary(jfn, amp_name=None):
     def op(x, name=None):
         if not isinstance(x, Tensor):
             x = Tensor(x)
-        return apply_op(jfn, x)
+        return apply_op(jfn, x, op_name=amp_name)
     return op
 
 
@@ -59,9 +59,9 @@ ldexp = _wrap_binary(jnp.ldexp)
 
 # -- elementwise unary --------------------------------------------------
 abs = _wrap_unary(jnp.abs)
-exp = _wrap_unary(jnp.exp)
+exp = _wrap_unary(jnp.exp, amp_name="exp")
 expm1 = _wrap_unary(jnp.expm1)
-log = _wrap_unary(jnp.log)
+log = _wrap_unary(jnp.log, amp_name="log")
 log2 = _wrap_unary(jnp.log2)
 log10 = _wrap_unary(jnp.log10)
 log1p = _wrap_unary(jnp.log1p)
@@ -159,7 +159,7 @@ def multiplex(inputs, index, name=None):
 
 
 # -- reductions ---------------------------------------------------------
-def _reduce(jfn):
+def _reduce(jfn, amp_name=None):
     def op(x, axis=None, keepdim=False, name=None, dtype=None):
         if isinstance(axis, (list, tuple)):
             axis = tuple(axis)
@@ -167,14 +167,14 @@ def _reduce(jfn):
         def fn(a):
             out = jfn(a, axis=axis, keepdims=keepdim)
             return out.astype(dt) if dt is not None else out
-        return apply_op(fn, x)
+        return apply_op(fn, x, op_name=amp_name)
     return op
 
 
-sum = _reduce(jnp.sum)
+sum = _reduce(jnp.sum, amp_name="sum")
 nansum = _reduce(jnp.nansum)
 prod = _reduce(jnp.prod)
-mean = _reduce(jnp.mean)
+mean = _reduce(jnp.mean, amp_name="mean")
 nanmean = _reduce(jnp.nanmean)
 amax = _reduce(jnp.max)
 amin = _reduce(jnp.min)

@@ -110,7 +110,7 @@ class TestPyLayer:
 
             @staticmethod
             def backward(ctx, g):
-                (a,) = ctx.saved_tensor
+                (a,) = ctx.saved_tensor()  # method, per reference py_layer.py:88
                 return g * 3 * a * a
 
         x = leaf([2.0])

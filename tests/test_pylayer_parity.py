@@ -1,0 +1,136 @@
+"""PyLayer reference-contract parity + higher-order grad.
+
+Locks the two round-3 breaks: `ctx.saved_tensor` must be a METHOD
+(reference python/paddle/autograd/py_layer.py:88, used as
+`y, = ctx.saved_tensor()` at :42), and create_graph=True must work through
+a PyLayer's custom backward (the user backward is run on the tape).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+class _Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, a):
+        ctx.save_for_backward(a)
+        return a * a * a
+
+    @staticmethod
+    def backward(ctx, g):
+        (a,) = ctx.saved_tensor()
+        return g * 3 * a * a
+
+
+def test_saved_tensor_is_callable():
+    """Reference user code calls ctx.saved_tensor() — must not be a property."""
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    _Cube.apply(x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_reference_doc_example_tanh():
+    """Verbatim reference docstring example (py_layer.py:31-46)."""
+    class cus_tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            y, = ctx.saved_tensor()
+            grad = dy * (1 - paddle.square(y))
+            return grad
+
+    data = paddle.randn([2, 3], dtype="float32")
+    data.stop_gradient = False
+    z = cus_tanh.apply(data)
+    z.sum().backward()
+    expect = 1 - np.tanh(data.numpy()) ** 2
+    np.testing.assert_allclose(data.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_pylayer_double_grad():
+    x = paddle.to_tensor([2.0, -1.5], stop_gradient=False)
+    y = _Cube.apply(x)
+    (gx,) = paddle.grad(y, [x], grad_outputs=[paddle.ones_like(y)],
+                        create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    (ggx,) = paddle.grad(gx, [x], grad_outputs=[paddle.ones_like(gx)])
+    np.testing.assert_allclose(ggx.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+
+def test_gradient_penalty_matches_finite_differences():
+    """WGAN-GP-style: d/dx of |grad_x f(x)|^2 through a custom PyLayer."""
+    class Sq(PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * a
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return g * 2 * a
+
+    def penalty(x):
+        y = Sq.apply(x)
+        (gx,) = paddle.grad(y, [x], grad_outputs=[paddle.ones_like(y)],
+                            create_graph=True)
+        return (gx * gx).sum()
+
+    x0 = np.array([0.7, -1.2, 2.0], dtype=np.float32)
+    x = paddle.to_tensor(x0, stop_gradient=False)
+    p = penalty(x)
+    p.backward()
+    got = x.grad.numpy()
+
+    eps = 1e-3
+    fd = np.zeros_like(x0)
+    for i in range(len(x0)):
+        xp, xm = x0.copy(), x0.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        pp = float(penalty(paddle.to_tensor(xp, stop_gradient=False)))
+        pm = float(penalty(paddle.to_tensor(xm, stop_gradient=False)))
+        fd[i] = (pp - pm) / (2 * eps)
+    np.testing.assert_allclose(got, fd, rtol=1e-2, atol=1e-2)
+
+
+def test_pylayer_multiple_inputs_selective_grad():
+    class Mul(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b
+
+        @staticmethod
+        def backward(ctx, g):
+            a, b = ctx.saved_tensor()
+            return g * b, g * a
+
+    a = paddle.to_tensor([3.0], stop_gradient=False)
+    b = paddle.to_tensor([4.0], stop_gradient=False)
+    Mul.apply(a, b).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])
+    np.testing.assert_allclose(b.grad.numpy(), [3.0])
+
+
+def test_pylayer_context_attribute_stash():
+    """Reference allows arbitrary attrs on ctx (py_layer.py doc examples)."""
+    class Scale(PyLayer):
+        @staticmethod
+        def forward(ctx, x, k):
+            ctx.k = k
+            return x * k
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * ctx.k
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    Scale.apply(x, 5.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
