@@ -54,16 +54,37 @@ def get_amp_dtype():
     return _state.dtype if _state.enabled else None
 
 
+# reference kernel names -> our op_name vocabulary, so user code written
+# against paddle's custom_white_list/custom_black_list works verbatim
+_OP_NAME_ALIASES = {
+    "conv2d": "conv", "conv3d": "conv", "conv1d": "conv",
+    "conv2d_transpose": "conv", "matmul_v2": "matmul",
+    "elementwise_add": "add", "elementwise_sub": "subtract",
+    "elementwise_mul": "multiply", "elementwise_div": "divide",
+    "softmax_with_cross_entropy": "cross_entropy",
+    "reduce_mean": "mean", "reduce_sum": "sum",
+}
+
+
+def _normalize_ops(names):
+    return {(_OP_NAME_ALIASES.get(str(n).lower(), str(n).lower()))
+            for n in (names or [])}
+
+
 class auto_cast:
-    """Context manager: `with paddle.amp.auto_cast(level='O2'):`"""
+    """Context manager: `with paddle.amp.auto_cast(level='O2'):`
+
+    TPU-native deviation: `dtype` defaults to bfloat16 (the MXU-native
+    type, full fp32 range, no loss scaling needed) where the reference
+    defaults to float16; pass dtype='float16' for reference semantics."""
 
     def __init__(self, enable=True, custom_white_list=None,
                  custom_black_list=None, level="O1", dtype="bfloat16"):
         self.enable = enable
         self.level = level
         self.dtype = jnp.bfloat16 if "b" in str(dtype) else jnp.float16
-        self.white = set(custom_white_list or [])
-        self.black = set(custom_black_list or [])
+        self.white = _normalize_ops(custom_white_list)
+        self.black = _normalize_ops(custom_black_list)
 
     def __enter__(self):
         self.prev = (_state.enabled, _state.dtype, _state.level,
@@ -146,7 +167,7 @@ class GradScaler:
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
-                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
         self._enable = enable
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
@@ -221,8 +242,43 @@ class GradScaler:
     def get_loss_scaling(self):
         return self._scale
 
+    # getter/setter surface, parity: grad_scaler.py:78 + loss_scaler.py:40
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
     def set_init_loss_scaling(self, v):
         self._scale = float(v)
+
+    def get_incr_ratio(self):
+        return self._incr_ratio
+
+    def set_incr_ratio(self, v):
+        if v <= 1.0:
+            raise ValueError("incr_ratio must be > 1")
+        self._incr_ratio = float(v)
+
+    def get_decr_ratio(self):
+        return self._decr_ratio
+
+    def set_decr_ratio(self, v):
+        if not 0.0 < v < 1.0:
+            raise ValueError("decr_ratio must be in (0, 1)")
+        self._decr_ratio = float(v)
+
+    def get_incr_every_n_steps(self):
+        return self._incr_every
+
+    def set_incr_every_n_steps(self, v):
+        self._incr_every = int(v)
+
+    def get_decr_every_n_nan_or_inf(self):
+        return self._decr_every
+
+    def set_decr_every_n_nan_or_inf(self, v):
+        self._decr_every = int(v)
 
     def state_dict(self):
         return {"scale": self._scale, "good_steps": self._good_steps,

@@ -29,8 +29,13 @@ _real = "paddle_tpu.distributed.meta_parallel"
 for _m in _pkgutil.walk_packages(meta_parallel.__path__, _real + "."):
     try:
         _importlib.import_module(_m.name)
-    except Exception:  # a broken leaf shouldn't break `import fleet`
-        pass
+    except Exception as _e:  # a broken leaf shouldn't break `import fleet`,
+        # but vanishing silently makes the later ModuleNotFoundError
+        # undiagnosable — say which module failed and why
+        import warnings as _warnings
+        _warnings.warn(f"fleet: meta_parallel submodule {_m.name} failed "
+                       f"to import and will be missing from the alias "
+                       f"tree: {_e!r}")
 for _name in [n for n in _sys.modules if n.startswith(_real)]:
     _sys.modules[_name.replace(_real, __name__ + ".meta_parallel", 1)] = \
         _sys.modules[_name]
@@ -136,15 +141,38 @@ def build_train_step(model, loss_fn, optimizer, recompute=None,
     wrapper markers on the model/optimizer > strategy.sharding_configs
     ["stage"] > 1."""
     strat = _state["strategy"] or DistributedStrategy()
-    for flag in ("dgc", "localsgd", "asp"):
+    for flag in ("dgc", "asp"):
         if getattr(strat, flag, False):
             # refuse rather than silently ignore: a no-op strategy flag
-            # corrupts experiments (ref fleet/meta_optimizers/ has real
-            # dgc/localsgd/asp passes; they are out of scope here)
+            # corrupts experiments. Scope rationale (SURVEY.md §3): dgc is
+            # a gradient-compression hack for bandwidth-starved GPU
+            # clusters — on TPU the dp psum rides ICI and XLA already
+            # overlaps it with compute; asp (2:4 structured sparsity) targets
+            # NVIDIA sparse tensor cores, which the MXU does not have.
             raise NotImplementedError(
-                f"DistributedStrategy.{flag} is not implemented in "
-                f"paddle_tpu; unset it or use supported strategies "
-                f"(amp/recompute/sharding/gradient_merge/lars/lamb)")
+                f"DistributedStrategy.{flag} is out of scope on TPU (see "
+                f"SURVEY.md §3); unset it or use supported strategies "
+                f"(amp/recompute/sharding/localsgd/gradient_merge/"
+                f"lars/lamb)")
+    if strat.localsgd:
+        # same honesty policy as dgc/asp: composing localsgd with other
+        # strategy mechanisms is not implemented — refuse rather than
+        # silently run a step that ignores them
+        combo = [f for f in ("amp", "recompute", "sharding", "pipeline",
+                             "tensor_parallel", "gradient_merge", "lamb",
+                             "lars") if getattr(strat, f, False)]
+        if combo:
+            raise NotImplementedError(
+                f"DistributedStrategy.localsgd cannot be combined with "
+                f"{combo} in paddle_tpu — run localsgd alone (pure dp)")
+        from .localsgd import LocalSGDTrainStep
+        hcg_ = get_hybrid_communicate_group()
+        cfg = strat.localsgd_configs
+        return LocalSGDTrainStep(model if not isinstance(
+            model, _DistributedModel) else model.wrapped,
+            loss_fn, optimizer, hcg_.mesh,
+            k_steps=cfg.get("k_steps", 4),
+            begin_step=cfg.get("begin_step", 1))
     if strat.lamb:
         from ...optimizer import Adam, AdamW, Lamb
         if isinstance(optimizer, Adam) and not isinstance(optimizer, Lamb):

@@ -49,9 +49,10 @@ class DistributedStrategy:
         self.lamb_configs = _Cfg({"lamb_weight_decay": 0.01})
         self.lars = False
         self.lars_configs = _Cfg({})
-        self.dgc = False
-        self.localsgd = False
-        self.asp = False
+        self.dgc = False                      # out of scope (SURVEY §3)
+        self.localsgd = False                 # K local steps, then pmean
+        self.localsgd_configs = _Cfg({"k_steps": 4, "begin_step": 1})
+        self.asp = False                      # out of scope (SURVEY §3)
         self.fuse_all_reduce_ops = True       # XLA fuses automatically
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

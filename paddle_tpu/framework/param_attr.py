@@ -25,5 +25,9 @@ class ParamAttr:
             return ParamAttr(name=attr)
         if attr is False:
             return False
+        if attr is True:
+            # bias_attr=True: "create the param with defaults" (ref
+            # param_attr.py _to_attr treats non-False truthy the same)
+            return ParamAttr()
         # an initializer instance
         return ParamAttr(initializer=attr)

@@ -332,6 +332,12 @@ class DataLoader:
             self.batch_size = batch_size
             self.drop_last = drop_last
 
+    def __call__(self):
+        """Legacy fluid idiom `for batch in loader():` (reference
+        docstring examples use it; DataLoader.__call__ returns the
+        iterator, same as iterating the loader directly)."""
+        return iter(self)
+
     def __len__(self):
         if self._iterable_mode:
             raise TypeError("IterableDataset has no fixed length")

@@ -60,17 +60,27 @@ def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
     kwargs = kwargs or {}
     arrays = dict(params)
     arrays.update(buffers)
-    conv_prev, conv_had = None, False
-    if convert:
-        import types as _types
-        from .dy2static import convert_to_static
-        conv = convert_to_static(type(layer).forward)
-        conv_had = "forward" in layer.__dict__
-        conv_prev = layer.__dict__.get("forward")
-        layer.__dict__["forward"] = _types.MethodType(conv, layer)
-    saved = _bind(layer, arrays)
+    conv_prev, conv_had, conv_set = None, False, False
+    saved = []
     prev_training = layer.training
     try:
+        if convert:
+            import types as _types
+            from .dy2static import convert_to_static
+            # convert may name the specific decorated method (e.g. a
+            # @to_static `predict`); True means the layer's forward
+            fwd = convert if callable(convert) and convert is not True \
+                else type(layer).forward
+            # @to_static on the method itself leaves a StaticFunction as
+            # the class attribute — unwrap to the underlying function
+            if isinstance(fwd, StaticFunction):
+                fwd = fwd._obj
+            conv = convert_to_static(fwd)
+            conv_had = "forward" in layer.__dict__
+            conv_prev = layer.__dict__.get("forward")
+            layer.__dict__["forward"] = _types.MethodType(conv, layer)
+            conv_set = True
+        saved = _bind(layer, arrays)
         if training is not None:
             layer.train() if training else layer.eval()
         wrapped_args = [Tensor(a) if not isinstance(a, Tensor) else a
@@ -84,7 +94,7 @@ def functional_call(layer, params, buffers, args, kwargs=None, rng_key=None,
     finally:
         _restore(saved)
         layer.train() if prev_training else layer.eval()
-        if convert:
+        if conv_set:
             if conv_had:
                 layer.__dict__["forward"] = conv_prev
             else:
@@ -127,11 +137,14 @@ class StaticFunction:
     Parity: TranslatedLayer / StaticFunction in the reference."""
 
     def __init__(self, obj, input_spec=None, build_strategy=None,
-                 training=None):
+                 training=None, method_fn=None):
         self._obj = obj
         self._input_spec = input_spec
         self._training = training
         self._cache = {}
+        # when bound via the descriptor protocol: the specific decorated
+        # method (may not be `forward`) the compile must execute
+        self._method_fn = method_fn
         from ..nn.layer.layers import Layer
         self._is_layer = isinstance(obj, Layer)
 
@@ -148,10 +161,13 @@ class StaticFunction:
             # dy2static: convert the forward's Python control flow so
             # tensor-dependent if/while lowers onto lax under the trace
             # (falls back to the original on unsupported constructs)
+            conv_target = self._method_fn if self._method_fn is not None \
+                else True
+
             def pure(params, buffers, key, *xs):
                 return functional_call(layer, params, buffers, xs,
                                        rng_key=key, training=training,
-                                       convert=True)
+                                       convert=conv_target)
             jitted = jax.jit(pure)
         else:
             fn = convert_to_static(self._obj)
@@ -167,6 +183,7 @@ class StaticFunction:
         return jitted
 
     def __call__(self, *args, **kwargs):
+        from ..framework.core import apply_op, is_grad_enabled
         arrays = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
                   for a in args]
         sig = self._sig(arrays)
@@ -175,11 +192,48 @@ class StaticFunction:
             jitted = self._compile(sig, arrays)
         key = split_key()
         if self._is_layer:
-            params, buffers = state_arrays(self._obj)
+            named = list(self._obj.named_parameters())
+            buffers = {k: b.value for k, b in self._obj.named_buffers()}
+            # train-through-to_static (reference StaticFunction records
+            # grads): when the tape is live, run the jitted program AS a
+            # taped op over the Parameters + inputs so loss.backward()
+            # reaches them; jax.vjp differentiates through jax.jit
+            if is_grad_enabled() and any(
+                    not p.stop_gradient for _, p in named):
+                names = [k for k, _ in named]
+                n = len(names)
+
+                def fn(*flat, _names=tuple(names), _n=n, _j=jitted,
+                       _b=buffers, _k=key):
+                    pd = dict(zip(_names, flat[:_n]))
+                    return _j(pd, _b, _k, *flat[_n:])
+
+                tensor_args = [a if isinstance(a, Tensor) else Tensor(a)
+                               for a in args]
+                return apply_op(fn, *[p for _, p in named], *tensor_args)
+            params = {k: p.value for k, p in named}
             out = jitted(params, buffers, key, *arrays)
         else:
             out = jitted(key, *arrays)
         return jax.tree.map(Tensor, out)
+
+    def __get__(self, instance, owner=None):
+        """Descriptor protocol: `@to_static` directly on a method (the
+        reference's most common idiom) must bind like a method. Accessed
+        through an instance we return a per-layer StaticFunction that
+        compiles through the functional layer path."""
+        if instance is None:
+            return self
+        name = getattr(self._obj, "__name__", "forward")
+        key = f"_jit_static_{name}"
+        bound = instance.__dict__.get(key)
+        if bound is None:
+            bound = StaticFunction(instance, self._input_spec, None,
+                                   self._training, method_fn=self._obj)
+            instance.__dict__[key] = bound
+            if name == "forward":  # jit.save looks here for spec inference
+                instance.__dict__["_jit_static_forward"] = bound
+        return bound
 
     # Layer-protocol passthroughs so a converted layer still acts like one
     def __getattr__(self, name):
@@ -297,7 +351,9 @@ class TrainStep:
                   for b in batch]
         if data_per_step:
             for a in arrays:
-                if a.shape[0] != n:
+                # ndim check first: a 0-d scalar has no shape[0] and must
+                # hit this friendly error, not an IndexError
+                if a.ndim == 0 or a.shape[0] != n:
                     raise ValueError(
                         f"data_per_step=True needs a leading dim of n={n} "
                         f"on every batch array, got shape {a.shape} — a "

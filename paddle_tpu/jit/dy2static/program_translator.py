@@ -15,7 +15,6 @@ import ast
 import functools
 import inspect
 import textwrap
-import threading
 import types
 import warnings
 
@@ -30,7 +29,6 @@ _code_cache = {}  # code object -> (compiled module code, fn name) for
 # closure-bearing functions: the expensive getsource+parse+transform runs
 # once; per-call work is just exec with the current closure values
 _fail_cache = set()  # code objects whose conversion failed: don't retry
-_state = threading.local()
 
 
 def conversion_enabled():
@@ -38,8 +36,6 @@ def conversion_enabled():
     jit-compilation (paddle.jit.ProgramTranslator, jit/debug.py) — one
     source of truth, matching the reference where ProgramTranslator.enable
     gates both."""
-    if not getattr(_state, "enabled", True):
-        return False
     from ..debug import ProgramTranslator as _PT
     return bool(getattr(_PT, "enable_to_static", True))
 

@@ -54,14 +54,84 @@ class InputSpec:
         return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
 
 
+def _save_function(sf, path, input_spec):
+    """Save a @to_static-decorated plain FUNCTION (reference
+    dygraph/jit.py 'example 2: save function'). RNG ops inside would bake
+    a fixed key — saved functions are deterministic transforms."""
+    from ..framework.core import no_grad
+    from ..framework.random import rng_scope
+    from .dy2static import convert_to_static
+    fn = convert_to_static(sf._obj if isinstance(sf, StaticFunction)
+                           else sf)
+    if input_spec is None:
+        if isinstance(sf, StaticFunction) and sf._input_spec:
+            input_spec = list(sf._input_spec)
+        elif isinstance(sf, StaticFunction) and sf._cache:
+            input_spec = [
+                InputSpec([None] + list(shape)[1:] if len(shape) >= 1
+                          else [], dtype)
+                for shape, dtype in list(sf._cache)[-1]]
+        else:
+            raise ValueError(
+                "jit.save on a function requires input_spec (or at least "
+                "one prior call to record shapes)")
+    specs = [s.to_shape_dtype() if isinstance(s, InputSpec)
+             else jax.ShapeDtypeStruct(tuple(s.shape), s.value.dtype)
+             for s in input_spec]
+    fixed_key = jax.random.PRNGKey(0)
+
+    def pure(*xs):
+        with no_grad(), rng_scope(fixed_key):
+            out = fn(*[Tensor(x) for x in xs])
+        return jax.tree.map(
+            lambda t: t.value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    exported = jax_export.export(jax.jit(pure))(*specs)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"params": {}, "buffers": {}}, f, protocol=4)
+    meta = {"kind": "function",
+            "input_specs": [(tuple(str(dd) for dd in s.shape),
+                             str(s.dtype)) for s in specs]}
+    with open(path + ".meta", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
 def save(layer, path, input_spec=None, **configs):
     from ..nn.layer.layers import Layer
     if isinstance(layer, StaticFunction):
+        if not layer._is_layer:
+            return _save_function(layer, path, input_spec)
         layer = layer.wrapped
+    if callable(layer) and not isinstance(layer, Layer) and \
+            hasattr(layer, "__code__"):
+        return _save_function(layer, path, input_spec)
     if not isinstance(layer, Layer):
         raise TypeError("jit.save expects a Layer (or converted Layer)")
     if input_spec is None:
-        raise ValueError("jit.save requires input_spec on first save")
+        # reference behavior (dygraph/jit.py example 1): a layer whose
+        # forward was @to_static-decorated can be saved without specs —
+        # infer from the decorator's input_spec or the signatures its
+        # compiled cache recorded during training
+        fwd = type(layer).forward
+        sf = layer.__dict__.get("_jit_static_forward")
+        if isinstance(fwd, StaticFunction) and fwd._input_spec:
+            input_spec = list(fwd._input_spec)
+        elif sf is not None and sf._cache:
+            last_sig = list(sf._cache)[-1]
+            input_spec = [
+                InputSpec([None] + list(shape)[1:] if len(shape) >= 1
+                          else [], dtype)
+                for shape, dtype in last_sig]
+        else:
+            raise ValueError(
+                "jit.save requires input_spec on first save (or a "
+                "@to_static forward that has been called at least once)")
 
     params, buffers = state_arrays(layer)
     specs = [s.to_shape_dtype() if isinstance(s, InputSpec)
@@ -78,7 +148,10 @@ def save(layer, path, input_spec=None, **configs):
         jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                      buffers),
         *specs)
-    blob = exported.serialize()
+    # vjp_order=1: the serialized StableHLO carries its VJP, so jit.load
+    # supports fine-tune training (reference TranslatedLayer train mode,
+    # fluid/dygraph/jit.py 'example 3: load & fine-tune')
+    blob = exported.serialize(vjp_order=1)
 
     d = os.path.dirname(path)
     if d:
@@ -96,36 +169,76 @@ def save(layer, path, input_spec=None, **configs):
 
 
 class TranslatedLayer:
-    """A loaded inference computation. Callable like the original Layer."""
+    """A loaded computation, callable like the original Layer. When the
+    artifact was saved with a VJP (the default), it also FINE-TUNES: the
+    call runs as a taped op over its live Parameters, so loss.backward()
+    + optimizer.step() train it (reference TranslatedLayer semantics,
+    fluid/dygraph/jit.py 'example 3: load & fine-tune')."""
 
     def __init__(self, exported, params, buffers, meta):
+        from ..framework.core import Parameter
         self._exported = exported
-        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._param_names = list(params)
+        self._param_t = {k: Parameter(jnp.asarray(v), name=k)
+                         for k, v in params.items()}
         self._buffers = {k: jnp.asarray(v) for k, v in buffers.items()}
         self._meta = meta
         self._call = jax.jit(exported.call)
+        self._training = False
 
     def __call__(self, *args):
+        from ..framework.core import apply_op, is_grad_enabled
+        if self._meta.get("kind") == "function":
+            arrays = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
+                      for a in args]
+            return jax.tree.map(Tensor, self._call(*arrays))
+        named = [(k, self._param_t[k]) for k in self._param_names]
+        if is_grad_enabled() and any(not p.stop_gradient
+                                     for _, p in named):
+            n = len(named)
+
+            def fn(*flat, _names=tuple(self._param_names), _n=n,
+                   _c=self._call, _b=self._buffers):
+                pd = dict(zip(_names, flat[:_n]))
+                return _c(pd, _b, *flat[_n:])
+
+            tensor_args = [a if isinstance(a, Tensor) else Tensor(a)
+                           for a in args]
+            return apply_op(fn, *[p for _, p in named], *tensor_args)
         arrays = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
                   for a in args]
-        out = self._call(self._params, self._buffers, *arrays)
+        out = self._call({k: p.value for k, p in named}, self._buffers,
+                         *arrays)
         return jax.tree.map(Tensor, out)
 
     forward = __call__
 
     def eval(self):
+        self._training = False
         return self
 
     def train(self):
-        raise RuntimeError("TranslatedLayer is inference-only")
+        if not self._exported.has_vjp():
+            raise RuntimeError(
+                "this artifact was serialized without a VJP "
+                "(vjp_order=0) — re-save it to fine-tune")
+        self._training = True
+        return self
 
     def parameters(self):
-        return [Tensor(v) for v in self._params.values()]
+        return [self._param_t[k] for k in self._param_names]
+
+    def named_parameters(self):
+        return [(k, self._param_t[k]) for k in self._param_names]
 
     def state_dict(self):
-        out = {k: Tensor(v) for k, v in self._params.items()}
+        out = {k: Tensor(p.value) for k, p in self._param_t.items()}
         out.update({k: Tensor(v) for k, v in self._buffers.items()})
         return out
+
+    def clear_gradients(self):
+        for p in self._param_t.values():
+            p.clear_grad()
 
 
 def load(path, **configs):

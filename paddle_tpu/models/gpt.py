@@ -463,6 +463,14 @@ class GPTForCausalLM(nn.Layer):
         scatter-written in batch) — the host only plans page ids; the
         per-layer host loop remains for prefill, where T varies."""
         B, T = input_ids.shape
+        # poisoned-cache guard hoisted here so BOTH paths (T>1 prefill and
+        # T==1 decode) fail with the explicit message instead of an opaque
+        # NoneType error from the prefill slot plumbing
+        if cache.k is None:
+            raise RuntimeError(
+                "this PagedKVCache was poisoned by an earlier failed "
+                "step — rebuild it with make_paged_cache() and "
+                "re-prefill in-flight sequences")
         # context-limit guard (both paths): inside jit the wpe gather
         # silently clamps out-of-range positions to the last row
         # (generate() raises for the same condition)

@@ -1,6 +1,9 @@
 """paddle.nn namespace. Parity: python/paddle/nn/__init__.py."""
 from . import initializer
 from . import functional
+# reference keeps `paddle.nn.loss` as a module alias of nn.layer.loss
+# ("keep it for too many used in unitests", ref nn/__init__.py:145)
+from .layer import loss
 from .layer.layers import Layer
 from .layer.container import Sequential, LayerList, ParameterList, LayerDict
 from .layer.common import (Identity, Linear, Embedding, Flatten, Dropout,

@@ -64,12 +64,14 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
              "OI" + "DHW"[3 - n:],
              ("N" + "DHW"[3 - n:] + "C") if channel_last
              else ("NC" + "DHW"[3 - n:])))
+        # no preferred_element_type=f32 here: the conv transpose rule
+        # rejects mixed-dtype operands (bf16 residual x f32 cotangent) so
+        # it breaks backward under amp; TPU convs accumulate in f32 in
+        # hardware regardless, which is the precision that flag bought
         out = lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad,
             rhs_dilation=dil, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if a.dtype == jnp.bfloat16 else None)
+            feature_group_count=groups)
         out = out.astype(a.dtype)
         if rest:
             b = rest[0]

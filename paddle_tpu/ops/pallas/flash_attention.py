@@ -183,7 +183,13 @@ def _choose_blocks(t_q, t_k, d):
     bq = min(1024, t_q)
     while t_q % bq:
         bq //= 2
-    bk = min(1024 * 64 // max(d, 64), t_k)
+    # round the bk seed DOWN to a power of two first: for d=96/80 the
+    # VMEM-budget quotient (682/819) is not a power of two, and the
+    # halving loop would otherwise never land on a divisor of a
+    # power-of-two t_k until bk collapsed to 1
+    seed = 1024 * 64 // max(d, 64)
+    seed = 1 << (seed.bit_length() - 1)
+    bk = min(seed, t_k)
     while t_k % bk:
         bk //= 2
     return max(bq, 1), max(bk, 1)

