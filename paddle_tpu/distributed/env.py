@@ -59,10 +59,15 @@ def init_parallel_env():
     coord = os.environ.get("PADDLE_TPU_COORDINATOR")
     nproc = os.environ.get("PADDLE_TPU_NUM_PROCESSES")
     pid = os.environ.get("PADDLE_TPU_PROCESS_ID")
-    if coord and nproc and not jax.process_count() > 1:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=int(nproc),
-                                   process_id=int(pid or 0))
+    if coord and nproc:
+        # probe for an existing distributed client WITHOUT jax.process_count()
+        # — that call initializes the XLA backend, after which
+        # jax.distributed.initialize refuses to run
+        from jax._src import distributed as _jdist
+        if _jdist.global_state.client is None:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=int(nproc),
+                                       process_id=int(pid or 0))
     _state["initialized"] = True
     get_mesh()
     return ParallelEnv()

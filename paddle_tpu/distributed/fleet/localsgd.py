@@ -71,47 +71,71 @@ class LocalSGDTrainStep:
         opt = optimizer
 
         def loss_of(ps, bufs, key, batch):
+            from ...jit.api import reset_aux_losses, collect_aux_losses
+            reset_aux_losses(model_ref)
             out = functional_call(model_ref, ps, bufs, batch[:-1],
                                   rng_key=key, training=True)
             l = loss_fn(out if isinstance(out, Tensor) else Tensor(out),
                         Tensor(batch[-1]))
-            return l.value if isinstance(l, Tensor) else l
+            l = l.value if isinstance(l, Tensor) else l
+            aux = collect_aux_losses(model_ref)
+            return l if aux is None else l + aux.astype(l.dtype)
 
-        def local_step(params_, opt_state_, bufs, key, lr, step_i, sync,
-                       *batch):
-            # inside shard_map: arrays are the PER-DEVICE block — params
-            # carry their leading replica axis of size 1; drop it
-            ps = jax.tree.map(lambda a: a[0], params_)
-            st = jax.tree.map(lambda a: a[0], opt_state_)
-            loss, grads = jax.value_and_grad(
-                lambda p: loss_of(p, bufs, key, batch))(ps)
-            # NO psum here — this is the point of LocalSGD
-            new_ps, new_st = opt.apply_gradients_tree(ps, grads, st, lr,
-                                                      step_i)
-            sync_ps = jax.tree.map(
-                lambda a: jax.lax.pmean(a, "dp"), new_ps)
-            sync_st = jax.tree.map(
-                lambda a: jax.lax.pmean(a, "dp"), new_st)
-            new_ps = jax.tree.map(
-                lambda s, n: jnp.where(sync, s, n), sync_ps, new_ps)
-            new_st = jax.tree.map(
-                lambda s, n: jnp.where(sync, s, n), sync_st, new_st)
-            # mean loss across replicas for logging
-            loss = jax.lax.pmean(loss, "dp")
-            return (loss,
-                    jax.tree.map(lambda a: a[None], new_ps),
-                    jax.tree.map(lambda a: a[None], new_st))
+        def _clip(grads):
+            clip = opt._grad_clip
+            if clip is None:
+                return grads
+            from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+            if isinstance(clip, ClipGradByGlobalNorm):
+                gn = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)))
+                f = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12),
+                                1.0)
+                return jax.tree.map(
+                    lambda g: (g * f).astype(g.dtype), grads)
+            if isinstance(clip, ClipGradByValue):
+                return jax.tree.map(
+                    lambda g: jnp.clip(g, clip.min, clip.max), grads)
+            return grads
 
-        self._local_step = local_step
+        def make_local_step(sync):
+            # `sync` is STATIC: the k-1 local-step program contains no
+            # collective at all (the point of LocalSGD); the sync-step
+            # program appends ONE pmean over params+state
+            def local_step(params_, opt_state_, bufs, key, lr, step_i,
+                           *batch):
+                # inside shard_map: arrays are the PER-DEVICE block —
+                # params carry their replica axis of size 1; drop it
+                ps = jax.tree.map(lambda a: a[0], params_)
+                st = jax.tree.map(lambda a: a[0], opt_state_)
+                loss, grads = jax.value_and_grad(
+                    lambda p: loss_of(p, bufs, key, batch))(ps)
+                grads = _clip(grads)
+                new_ps, new_st = opt.apply_gradients_tree(
+                    ps, grads, st, lr, step_i)
+                if sync:
+                    new_ps = jax.tree.map(
+                        lambda a: jax.lax.pmean(a, "dp"), new_ps)
+                    new_st = jax.tree.map(
+                        lambda a: jax.lax.pmean(a, "dp"), new_st)
+                # mean loss across replicas for logging
+                loss = jax.lax.pmean(loss, "dp")
+                return (loss,
+                        jax.tree.map(lambda a: a[None], new_ps),
+                        jax.tree.map(lambda a: a[None], new_st))
+            return local_step
+
+        self._make_local_step = make_local_step
         self._donate = donate
-        self._jit_cache = {}  # n_batch_arrays -> jitted program
+        self._jit_cache = {}  # (n_batch_arrays, sync) -> jitted program
 
-    def _build(self, n_batch):
+    def _build(self, n_batch, sync):
         rep_spec = jax.tree.map(lambda _: P("dp"), self.params)
         st_spec = jax.tree.map(lambda _: P("dp"), self.opt_state)
         smapped = shard_map(
-            self._local_step, mesh=self.mesh,
-            in_specs=(rep_spec, st_spec, P(), P(), P(), P(), P())
+            self._make_local_step(sync), mesh=self.mesh,
+            in_specs=(rep_spec, st_spec, P(), P(), P(), P())
             + tuple(P("dp") for _ in range(n_batch)),
             out_specs=(P(), rep_spec, st_spec),
             check_vma=False)
@@ -121,18 +145,19 @@ class LocalSGDTrainStep:
     def __call__(self, *batch):
         arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
-        jitted = self._jit_cache.get(len(arrays))
-        if jitted is None:
-            jitted = self._jit_cache[len(arrays)] = self._build(len(arrays))
         self._call_i += 1
-        sync = jnp.asarray(self._call_i <= self.begin_step
-                           or self._call_i % self.k_steps == 0)
+        sync = bool(self._call_i <= self.begin_step
+                    or self._call_i % self.k_steps == 0)
+        key = (len(arrays), sync)
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            jitted = self._jit_cache[key] = self._build(*key)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch_sh = NamedSharding(self.mesh, P("dp"))
         arrays = [jax.device_put(a, batch_sh) for a in arrays]
         loss, self.params, self.opt_state = jitted(
             self.params, self.opt_state, self.buffers, split_key(), lr,
-            jnp.asarray(self._call_i, jnp.float32), sync, *arrays)
+            jnp.asarray(self._call_i, jnp.float32), *arrays)
         return Tensor(loss)
 
     def replica_spread(self):

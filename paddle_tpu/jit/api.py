@@ -277,10 +277,15 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
-                 in_shardings=None, donate=True):
+                 in_shardings=None, donate=True, model_returns_loss=False):
+        """model_returns_loss=True: the model's forward(*batch) IS the
+        scalar loss (e.g. GPTForCausalLM.fused_loss via a wrapper) —
+        loss_fn is ignored. Lets memory-fused loss formulations (chunked
+        vocab xent) run under the same jitted step."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self._model_returns_loss = model_returns_loss
         params, self.buffers = state_arrays(model)
         # buffers are donated every step; take a private copy so the
         # model's own Parameters stay valid for eager use
@@ -294,12 +299,19 @@ class TrainStep:
         def step_fn(params, opt_state, buffers, key, lr, step_i, *batch):
             def loss_of(ps):
                 reset_aux_losses(model)
-                out = functional_call(model, ps, buffers, batch[:-1],
-                                      rng_key=key, training=True)
-                tgt = Tensor(batch[-1])
-                loss_t = loss_fn(
-                    out if isinstance(out, Tensor) else Tensor(out), tgt)
-                l = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+                if model_returns_loss:
+                    out = functional_call(model, ps, buffers, batch,
+                                          rng_key=key, training=True)
+                    l = out.value if isinstance(out, Tensor) else out
+                else:
+                    out = functional_call(model, ps, buffers, batch[:-1],
+                                          rng_key=key, training=True)
+                    tgt = Tensor(batch[-1])
+                    loss_t = loss_fn(
+                        out if isinstance(out, Tensor) else Tensor(out),
+                        tgt)
+                    l = loss_t.value if isinstance(loss_t, Tensor) \
+                        else loss_t
                 aux = collect_aux_losses(model)
                 return l if aux is None else l + aux.astype(l.dtype)
 

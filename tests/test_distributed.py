@@ -448,3 +448,35 @@ class TestFleetPipelineRouting:
         o = opt.SGD(parameters=pipe.parameters())
         with pytest.raises(ValueError, match="pp"):
             fleet.build_train_step(pipe, None, o)
+
+
+class TestAutoParallelPlanner:
+    """Measured planner (VERDICT r3 #8): plan(search=True) must pick a
+    sharded input layout over replicated for a big matmul — ranked by
+    XLA's own cost_analysis, the role of the reference's
+    auto_parallel/planner.py + cost_model.py."""
+
+    def test_search_picks_sharded_over_replicated(self):
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.distributed.auto_parallel import Planner
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        planner = Planner(mesh)
+        a = jnp.ones((1024, 512), jnp.float32)
+        b = jnp.ones((512, 256), jnp.float32)
+
+        result = planner.plan(lambda x, y: x @ y, a, b, search=True)
+        # the chosen plan shards at least one operand over dp
+        assert any("dp" in str(s) for s in result.chosen_specs), \
+            result.chosen_specs
+        # and beats fully-replicated in the measured ranking
+        rep_cost = dict((tuple(str(x) for x in specs), c)
+                        for specs, c in result.search_report)
+        rep_key = (str(P()), str(P()))
+        assert rep_key in rep_cost
+        best_specs, best_cost = result.search_report[0]
+        assert best_cost < rep_cost[rep_key], result.search_report[:3]
+        # the winning plan actually executes
+        out = result(a, b)
+        np.testing.assert_allclose(np.asarray(out)[:2, :2], 512.0)

@@ -1,0 +1,108 @@
+"""Elastic failure drill: crash -> restart -> exact-resume, + watchdog.
+
+VERDICT r3 #6: ElasticController must be PROVEN — a training process is
+hard-killed mid-run (os._exit, simulating TPU host preemption), a fresh
+process resumes from the async checkpoint via maybe_resume(), and the
+resumed loss trajectory must be numerically identical to an uninterrupted
+baseline. Parity: python/paddle/distributed/elastic/ (the agent's
+restart-and-resume contract).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_elastic_worker.py")
+
+
+def _run(mode, arg, ckpt, out, expect_rc=0):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    p = subprocess.run(
+        [sys.executable, WORKER, mode, str(arg), str(ckpt), str(out)],
+        env=env, cwd=REPO, capture_output=True, timeout=300)
+    assert p.returncode == expect_rc, \
+        f"rc={p.returncode}\n{p.stdout.decode()[-2000:]}" \
+        f"\n{p.stderr.decode()[-2000:]}"
+
+
+def test_crash_restart_exact_resume(tmp_path):
+    base_out = tmp_path / "baseline.json"
+    res_out = tmp_path / "resumed.json"
+
+    # 1. uninterrupted baseline: 8 steps (fresh ckpt dir, never read)
+    _run("baseline", 8, tmp_path / "ckpt_base", base_out)
+    baseline = json.load(open(base_out))
+    assert baseline["start"] == 0
+    assert len(baseline["losses"]) == 8
+
+    # 2. train under the controller and DIE after step 5 (checkpoints
+    #    landed at steps 2 and 4)
+    _run("crash", 5, tmp_path / "ckpt", tmp_path / "unused.json",
+         expect_rc=17)
+    saved = sorted(os.listdir(tmp_path / "ckpt"))
+    assert any(d.startswith("step_") for d in saved), saved
+
+    # 3. restart: a fresh process resumes from the newest checkpoint and
+    #    finishes the run
+    _run("resume", 8, tmp_path / "ckpt", res_out)
+    resumed = json.load(open(res_out))
+    assert resumed["start"] == 4, resumed["start"]  # newest async ckpt
+
+    # 4. the resumed trajectory must REPLAY the baseline exactly
+    for s, loss in resumed["losses"].items():
+        assert baseline["losses"][s] == pytest.approx(loss, abs=1e-6), \
+            (s, baseline["losses"][s], loss)
+    # and the loop made progress to completion
+    assert max(int(s) for s in resumed["losses"]) == 8
+
+
+def test_watchdog_fires_on_stall():
+    """No on_step() feeding -> the watchdog SIGTERMs the process so the
+    scheduler can restart it; caught here via a handler."""
+    from paddle_tpu.distributed.elastic import ElasticController
+
+    class Dummy:
+        _step_i = 0
+
+    fired = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: fired.append(True))
+    try:
+        ctl = ElasticController(Dummy(), "/tmp/nonexistent-ckpt",
+                                watchdog_timeout_s=0.4)
+        ctl.start_watchdog()
+        deadline = time.time() + 10
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+        ctl.stop()
+        assert fired, "watchdog did not fire within 10s of a stall"
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_watchdog_quiet_while_progressing():
+    from paddle_tpu.distributed.elastic import ElasticController
+
+    class Dummy:
+        _step_i = 0
+
+    fired = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: fired.append(True))
+    try:
+        ctl = ElasticController(Dummy(), "/tmp/nonexistent-ckpt",
+                                save_every_steps=10 ** 9,
+                                watchdog_timeout_s=0.8)
+        ctl.start_watchdog()
+        for _ in range(6):  # keep feeding faster than the timeout
+            time.sleep(0.25)
+            ctl._last_progress = time.time()
+        ctl.stop()
+        assert not fired, "watchdog fired despite steady progress"
+    finally:
+        signal.signal(signal.SIGTERM, prev)
