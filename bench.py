@@ -140,67 +140,13 @@ def _run():
             with open(base_path, "w") as f:
                 json.dump({"tokens_per_sec": tokens_per_sec,
                            "mfu": mfu, "n_params": n_params}, f)
-    # flagship-scale side metric (VERDICT r3 #4): GPT-1.3B on this one
-    # chip — scan + full remat, bf16 velocity + stochastic rounding
-    # (master-weight-grade precision without the f32 copies; see
-    # tests/test_stochastic_rounding.py). Best-effort: a compile failure
-    # here must not kill the headline metric.
-    p13_tps, p13_mfu, p13_err = 0.0, 0.0, None
-    if on_tpu and os.environ.get("BENCH_1P3B", "1") == "1":
-        # bounded: XLA compile of the 1.3B scanned program takes ~4 min
-        # normally but has been observed to exceed 15 min when the remote
-        # compile helper is congested — never let it starve the headline
-        budget13 = int(os.environ.get("BENCH_1P3B_TIMEOUT", "600"))
-
-        def _to13(signum, frame):
-            raise TimeoutError("1.3B side-bench exceeded budget")
-
-        signal.signal(signal.SIGALRM, _to13)
-        signal.alarm(budget13)
-        try:
-            from paddle_tpu.models.gpt import gpt_1p3b
-            from paddle_tpu.optimizer import Momentum
-            cfg13 = gpt_1p3b()
-            cfg13.max_position_embeddings = 1024
-            cfg13.dropout = 0.0
-            cfg13.scan_layers = True
-            cfg13.scan_remat = True
-            paddle.seed(0)
-            m13 = GPTForCausalLM(cfg13)
-            m13.bfloat16()
-            o13 = Momentum(learning_rate=1e-4, momentum=0.9,
-                           parameters=m13.parameters())
-            o13._stochastic_rounding = True
-            o13._state_dtype = jnp.bfloat16
-            n13 = sum(int(np.prod(p.shape)) for p in m13.parameters())
-            s13 = TrainStep(m13, loss_fn, o13)
-            ids13 = paddle.to_tensor(rng.randint(
-                0, cfg13.vocab_size, size=(4, 1024)).astype(np.int32))
-            for _ in range(2):
-                l13 = s13(ids13, ids13)
-            float(l13.item())
-            t0 = time.perf_counter()
-            for _ in range(8):
-                l13 = s13(ids13, ids13)
-            float(l13.item())
-            p13_tps = 4 * 1024 * 8 / (time.perf_counter() - t0)
-            p13_mfu = 6.0 * n13 * p13_tps / peak
-            del s13, m13, o13
-        except Exception as e13:
-            # best-effort, but never silent: a 0.0 value carries its why
-            p13_err = f"{type(e13).__name__}: {str(e13)[:160]}"
-        finally:
-            signal.alarm(0)
-
     print(json.dumps({
         "metric": "gpt_medium_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(vs, 3),
+        "on_tpu": on_tpu,
         "mfu": round(mfu, 4),
-        "gpt_1p3b_tokens_per_sec": round(p13_tps, 1),
-        "gpt_1p3b_mfu": round(p13_mfu, 4),
-        **({"gpt_1p3b_error": p13_err} if p13_err else {}),
         # mfu uses the v5e nominal 197 TFLOP/s; mfu_vs_measured_peak uses
         # the sustained bf16 matmul rate calibrated above (~100 TFLOP/s on
         # this chip/tunnel) — the honest utilization ceiling
@@ -214,37 +160,146 @@ def _run():
     }))
 
 
+
+
+def _run_1p3b():
+    """Child task (BENCH_TASK=1p3b): flagship-scale side metric (VERDICT
+    r3 #4) — GPT-1.3B on this one chip, scan + full remat, bf16 velocity
+    + stochastic rounding (master-weight-grade precision without the f32
+    copies; tests/test_stochastic_rounding.py). Runs in its OWN
+    subprocess so a congested compile can never starve the headline
+    metric (the parent already holds that line)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_1p3b
+    from paddle_tpu.optimizer import Momentum
+
+    cfg13 = gpt_1p3b()
+    cfg13.max_position_embeddings = 1024
+    cfg13.dropout = 0.0
+    cfg13.scan_layers = True
+    cfg13.scan_remat = True
+    paddle.seed(0)
+    m13 = GPTForCausalLM(cfg13)
+    m13.bfloat16()
+    o13 = Momentum(learning_rate=1e-4, momentum=0.9,
+                   parameters=m13.parameters())
+    o13._stochastic_rounding = True
+    o13._state_dtype = jnp.bfloat16
+    n13 = sum(int(np.prod(p.shape)) for p in m13.parameters())
+
+    def loss_fn(logits, labels):
+        V = logits.shape[-1]
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+    s13 = TrainStep(m13, loss_fn, o13)
+    rng = np.random.RandomState(0)
+    ids13 = paddle.to_tensor(rng.randint(
+        0, cfg13.vocab_size, size=(4, 1024)).astype(np.int32))
+    for _ in range(2):
+        l13 = s13(ids13, ids13)
+    float(l13.item())
+    t0 = time.perf_counter()
+    for _ in range(8):
+        l13 = s13(ids13, ids13)
+    float(l13.item())
+    tps = 4 * 1024 * 8 / (time.perf_counter() - t0)
+    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
+             "v6e": 918e12}
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next((v for k, v in peaks.items() if k in kind), 197e12)
+    print(json.dumps({"gpt_1p3b_tokens_per_sec": round(tps, 1),
+                      "gpt_1p3b_mfu": round(6.0 * n13 * tps / peak, 4)}))
+
 def main():
-    first_tb = None
-    try:
+    """Parent: run each attempt in a SUBPROCESS with a hard wall-clock
+    timeout — SIGALRM cannot interrupt a GIL-holding C++ compile RPC
+    (observed 2026-07-30: a congested remote compile helper stretched the
+    normally-60s compile past 30 min and in-process alarms never fired).
+    The child (BENCH_CHILD=1) does the real work and prints the one JSON
+    line; the parent relays it verbatim, so the driver contract holds."""
+    if os.environ.get("BENCH_CHILD") == "1":
         try:
+            if os.environ.get("BENCH_TASK") == "1p3b":
+                _run_1p3b()
+                return
             _run()
+        except Exception as e:
+            tb = traceback.format_exc()
+            print(json.dumps({
+                "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {str(e)[:400]}",
+                "traceback_tail": tb[-800:]}))
+            raise SystemExit(1)
+        return
+
+    import subprocess
+    import sys
+    attempt_budget = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "900"))
+    pinned = "BENCH_REMAT" in os.environ or "BENCH_SCAN" in os.environ
+    attempts = [{}] if pinned else [
+        {},  # fastest measured config (unrolled, no remat)
+        {"BENCH_REMAT": "names", "BENCH_SCAN": "1"},  # compile fallback
+    ]
+    failures = []
+    for extra in attempts:
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = "1"
+        env.update(extra)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                timeout=attempt_budget, capture_output=True)
+        except subprocess.TimeoutExpired:
+            failures.append(f"attempt {extra or 'default'}: killed after "
+                            f"{attempt_budget}s (compile hung)")
+            continue
+        out = proc.stdout.decode(errors="replace")
+        line = next((l for l in reversed(out.splitlines())
+                     if l.startswith("{")), None)
+        if proc.returncode == 0 and line:
+            result = json.loads(line)
+            # flagship side metric in its OWN bounded subprocess: the
+            # headline line above is already safe in hand
+            if result.get("value", 0) > 0 and result.get("on_tpu") and \
+                    os.environ.get("BENCH_1P3B", "1") == "1":
+                b13 = int(os.environ.get("BENCH_1P3B_TIMEOUT", "600"))
+                env13 = dict(os.environ)
+                env13["BENCH_CHILD"] = "1"
+                env13["BENCH_TASK"] = "1p3b"
+                try:
+                    p13 = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__)],
+                        env=env13, timeout=b13, capture_output=True)
+                    l13 = next((l for l in reversed(
+                        p13.stdout.decode(errors="replace").splitlines())
+                        if l.startswith("{")), None)
+                    if p13.returncode == 0 and l13:
+                        result.update(json.loads(l13))
+                    else:
+                        result["gpt_1p3b_error"] = (
+                            l13 or p13.stderr.decode(
+                                errors="replace")[-200:])[:300]
+                except subprocess.TimeoutExpired:
+                    result["gpt_1p3b_error"] = f"timeout {b13}s"
+            result.setdefault("gpt_1p3b_tokens_per_sec", 0.0)
+            result.setdefault("gpt_1p3b_mfu", 0.0)
+            print(json.dumps(result))
             return
-        except Exception:
-            # the unrolled program is large — if its compile fails
-            # through the remote compile helper, fall back to the
-            # scan+selective-remat config; skip when the operator pinned
-            # a config explicitly
-            if "BENCH_REMAT" in os.environ or "BENCH_SCAN" in os.environ:
-                raise
-            first_tb = traceback.format_exc()
-            os.environ["BENCH_REMAT"] = "names"
-            os.environ["BENCH_SCAN"] = "1"
-        _run()
-    except Exception as e:  # diagnostic JSON line, never a bare traceback
-        tb = traceback.format_exc()
-        out = {
-            "metric": "gpt_medium_train_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/s/chip",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {str(e)[:400]}",
-            "traceback_tail": tb[-800:],
-        }
-        if first_tb is not None:
-            out["first_attempt_traceback_tail"] = first_tb[-600:]
-        print(json.dumps(out))
-        raise SystemExit(1)
+        failures.append(
+            f"attempt {extra or 'default'}: rc={proc.returncode} "
+            f"{(line or proc.stderr.decode(errors='replace')[-300:])[:400]}")
+    print(json.dumps({
+        "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+        "error": " | ".join(failures)[:900]}))
+    raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -210,6 +210,18 @@ class Planner:
         if best is None:
             raise RuntimeError("auto_parallel search: no candidate "
                                "sharding compiled successfully")
+        if best[1] == float("inf"):
+            # cost_analysis unavailable everywhere: a "measured" winner
+            # would be arbitrary — fall back to replicated, loudly
+            import warnings
+            warnings.warn(
+                "auto_parallel search: XLA cost_analysis unavailable for "
+                "every candidate; returning the fully-replicated plan")
+            rep = tuple(PartitionSpec() for _ in arrays)
+            compiled = jax.jit(fn, in_shardings=tuple(
+                NamedSharding(self.mesh, s) for s in rep)) \
+                .lower(*arrays).compile()
+            best = (rep, float("inf"), compiled)
         result = PlanResult(best[2])
         result.chosen_specs = best[0]
         result.search_report = sorted(report, key=lambda t: t[1])

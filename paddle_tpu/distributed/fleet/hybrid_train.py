@@ -203,20 +203,8 @@ class HybridTrainStep:
                 grads = jax.lax.with_sharding_constraint(grads,
                                                          zero_shardings)
 
-            clip = opt._grad_clip
-            if clip is not None:
-                from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
-                if isinstance(clip, ClipGradByGlobalNorm):
-                    gn = jnp.sqrt(sum(
-                        jnp.sum(jnp.square(g.astype(jnp.float32)))
-                        for g in jax.tree.leaves(grads)))
-                    f = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12),
-                                    1.0)
-                    grads = jax.tree.map(
-                        lambda g: (g * f).astype(g.dtype), grads)
-                elif isinstance(clip, ClipGradByValue):
-                    grads = jax.tree.map(
-                        lambda g: jnp.clip(g, clip.min, clip.max), grads)
+            from ...nn.clip import clip_grads_tree
+            grads = clip_grads_tree(grads, opt._grad_clip)
             new_params, new_state = opt.apply_gradients_tree(
                 params_, grads, opt_state_, lr, step_i)
             return loss, new_params, new_state

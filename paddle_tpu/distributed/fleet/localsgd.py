@@ -81,23 +81,10 @@ class LocalSGDTrainStep:
             aux = collect_aux_losses(model_ref)
             return l if aux is None else l + aux.astype(l.dtype)
 
+        from ...nn.clip import clip_grads_tree
+
         def _clip(grads):
-            clip = opt._grad_clip
-            if clip is None:
-                return grads
-            from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
-            if isinstance(clip, ClipGradByGlobalNorm):
-                gn = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g.astype(jnp.float32)))
-                    for g in jax.tree.leaves(grads)))
-                f = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12),
-                                1.0)
-                return jax.tree.map(
-                    lambda g: (g * f).astype(g.dtype), grads)
-            if isinstance(clip, ClipGradByValue):
-                return jax.tree.map(
-                    lambda g: jnp.clip(g, clip.min, clip.max), grads)
-            return grads
+            return clip_grads_tree(grads, opt._grad_clip)
 
         def make_local_step(sync):
             # `sync` is STATIC: the k-1 local-step program contains no
@@ -119,9 +106,9 @@ class LocalSGDTrainStep:
                         lambda a: jax.lax.pmean(a, "dp"), new_ps)
                     new_st = jax.tree.map(
                         lambda a: jax.lax.pmean(a, "dp"), new_st)
-                # mean loss across replicas for logging
-                loss = jax.lax.pmean(loss, "dp")
-                return (loss,
+                # loss stays per-replica (shape [1] per shard): averaging
+                # happens on host, so local steps carry NO collective
+                return (loss[None],
                         jax.tree.map(lambda a: a[None], new_ps),
                         jax.tree.map(lambda a: a[None], new_st))
             return local_step
@@ -137,7 +124,7 @@ class LocalSGDTrainStep:
             self._make_local_step(sync), mesh=self.mesh,
             in_specs=(rep_spec, st_spec, P(), P(), P(), P())
             + tuple(P("dp") for _ in range(n_batch)),
-            out_specs=(P(), rep_spec, st_spec),
+            out_specs=(P("dp"), rep_spec, st_spec),
             check_vma=False)
         return jax.jit(smapped,
                        donate_argnums=(0, 1) if self._donate else ())
@@ -155,10 +142,10 @@ class LocalSGDTrainStep:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch_sh = NamedSharding(self.mesh, P("dp"))
         arrays = [jax.device_put(a, batch_sh) for a in arrays]
-        loss, self.params, self.opt_state = jitted(
+        losses, self.params, self.opt_state = jitted(
             self.params, self.opt_state, self.buffers, split_key(), lr,
             jnp.asarray(self._call_i, jnp.float32), *arrays)
-        return Tensor(loss)
+        return Tensor(jnp.mean(losses))  # host-side mean over replicas
 
     def replica_spread(self):
         """Max abs deviation across replicas (0 right after a sync step) —

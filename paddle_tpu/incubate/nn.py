@@ -8,9 +8,111 @@ FusedFeedForward = _inc._FusedFeedForward
 MoELayer = _inc._MoELayer
 
 
-def fused_multi_head_attention(*a, **k):
-    raise NotImplementedError(
-        "use nn.functional.scaled_dot_product_attention")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-05,
+                               qkv_bias=None, linear_bias=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-05,
+                               training=True, mode="upscale_in_train",
+                               name=None):
+    """Self-attention block as ONE taped op. Parity:
+    python/paddle/incubate/nn/functional/fused_transformer.py:215 (the
+    fused_attention CUDA kernel's semantics: optional pre/post layernorm,
+    packed [3, n_head, d_head, embed] qkv projection, residual add).
+    TPU-native: a single jnp composition — XLA fuses it into the same
+    few MXU calls the hand-written kernel makes."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import Tensor, apply_op
+    from ..framework.random import split_key
+
+    use_attn_drop = training and attn_dropout_rate > 0.0
+    use_out_drop = training and dropout_rate > 0.0
+    # downscale_in_infer: no upscale at train time, multiply by (1-p) at
+    # inference (reference dropout mode semantics)
+    infer_attn_scale = (1.0 - attn_dropout_rate) \
+        if (not training and mode == "downscale_in_infer"
+            and attn_dropout_rate > 0.0) else None
+    infer_out_scale = (1.0 - dropout_rate) \
+        if (not training and mode == "downscale_in_infer"
+            and dropout_rate > 0.0) else None
+    k1 = split_key() if use_attn_drop else None
+    k2 = split_key() if use_out_drop else None
+
+    opt = [t for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias,
+                       qkv_bias, linear_bias, attn_mask)
+           if t is not None]
+    flags = dict(pre_s=pre_ln_scale is not None,
+                 pre_b=pre_ln_bias is not None,
+                 ln_s=ln_scale is not None, ln_b=ln_bias is not None,
+                 qb=qkv_bias is not None, lb=linear_bias is not None,
+                 mask=attn_mask is not None)
+
+    def fn(xv, qkvw, lw, *rest):
+        it = iter(rest)
+        pre_s = next(it) if flags["pre_s"] else None
+        pre_b = next(it) if flags["pre_b"] else None
+        ln_s = next(it) if flags["ln_s"] else None
+        ln_b = next(it) if flags["ln_b"] else None
+        qb = next(it) if flags["qb"] else None
+        lb = next(it) if flags["lb"] else None
+        mask = next(it) if flags["mask"] else None
+
+        def _ln(h, s, b, eps):
+            mu = jnp.mean(h.astype(jnp.float32), -1, keepdims=True)
+            var = jnp.var(h.astype(jnp.float32), -1, keepdims=True)
+            o = (h.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+            if s is not None:
+                o = o * s.astype(jnp.float32)
+            if b is not None:
+                o = o + b.astype(jnp.float32)
+            return o.astype(h.dtype)
+
+        h = _ln(xv, pre_s, pre_b, pre_ln_epsilon) if pre_layer_norm \
+            else xv
+        # qkvw: [3, n_head, d_head, embed] -> qkv [3, B, n_head, S, d]
+        qkv = jnp.einsum("bse,knde->kbnsd", h, qkvw)
+        if qb is not None:
+            qkv = qkv + qb[:, None, :, None, :]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        d = q.shape[-1]
+        scores = jnp.einsum("bnsd,bntd->bnst", q, k) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)).astype(q.dtype)
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                scores = jnp.where(mask, scores, -1e9)
+            elif jnp.issubdtype(mask.dtype, jnp.integer):
+                scores = scores + (mask.astype(scores.dtype) - 1) * 1e9
+            else:
+                scores = scores + mask.astype(scores.dtype)
+        p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+        if use_attn_drop:
+            keep = jax.random.bernoulli(k1, 1.0 - attn_dropout_rate,
+                                        p.shape)
+            p = jnp.where(keep, p / (1.0 - attn_dropout_rate)
+                          if mode == "upscale_in_train" else p, 0.0
+                          ).astype(p.dtype)
+        elif infer_attn_scale is not None:
+            p = (p * infer_attn_scale).astype(p.dtype)
+        o = jnp.einsum("bnst,bntd->bnsd", p, v)
+        B, N, S, D = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, N * D)
+        o = o @ lw
+        if lb is not None:
+            o = o + lb
+        if use_out_drop:
+            keep = jax.random.bernoulli(k2, 1.0 - dropout_rate, o.shape)
+            o = jnp.where(keep, o / (1.0 - dropout_rate)
+                          if mode == "upscale_in_train" else o, 0.0
+                          ).astype(o.dtype)
+        elif infer_out_scale is not None:
+            o = (o * infer_out_scale).astype(o.dtype)
+        res = xv + o
+        return res if pre_layer_norm else _ln(res, ln_s, ln_b, ln_epsilon)
+
+    return apply_op(fn, x, qkv_weight, linear_weight, *opt)
 
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "MoELayer",

@@ -316,21 +316,8 @@ class TrainStep:
                 return l if aux is None else l + aux.astype(l.dtype)
 
             loss, grads = jax.value_and_grad(loss_of)(params)
-            clip = self.optimizer._grad_clip
-            if clip is not None:
-                from ..nn.clip import ClipGradByGlobalNorm, ClipGradByNorm, \
-                    ClipGradByValue
-                if isinstance(clip, ClipGradByGlobalNorm):
-                    gn = jnp.sqrt(sum(
-                        jnp.sum(jnp.square(g.astype(jnp.float32)))
-                        for g in jax.tree.leaves(grads)))
-                    factor = jnp.minimum(
-                        clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
-                    grads = jax.tree.map(
-                        lambda g: (g * factor).astype(g.dtype), grads)
-                elif isinstance(clip, ClipGradByValue):
-                    grads = jax.tree.map(
-                        lambda g: jnp.clip(g, clip.min, clip.max), grads)
+            from ..nn.clip import clip_grads_tree
+            grads = clip_grads_tree(grads, self.optimizer._grad_clip)
             new_params, new_state = self.optimizer.apply_gradients_tree(
                 params, grads, opt_state, lr, step_i)
             return loss, new_params, new_state

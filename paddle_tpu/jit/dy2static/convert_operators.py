@@ -122,6 +122,11 @@ def not_returned(flag):
     return convert_logical_not(flag)
 
 
+def not_interrupted(brk, cont):
+    """Guard after a break/continue site inside a converted loop body."""
+    return convert_logical_not(convert_logical_or(brk, lambda: cont))
+
+
 def _select_leaf(pred_arr, tv, fv, name):
     """Merge one carried local across the two branches of a converted if."""
     # identical object / equal value: nothing to select

@@ -48,10 +48,11 @@ def _const(v):
 
 def _carry_names(names):
     """Drop transformer-generated helper names (nested converted
-    constructs' defs/accessors) from a carry; the return-machinery slots
-    (__jst_ret/__jst_did_return) DO carry."""
+    constructs' defs/accessors) from a carry; the return-machinery and
+    break/continue flag slots DO carry."""
     return [n for n in names
-            if not n.startswith("__jst_") or n in (_RET, _FLAG)]
+            if not n.startswith("__jst_") or n in (_RET, _FLAG)
+            or n.startswith(("__jst_brk", "__jst_cont"))]
 
 
 def assigned_names(stmts):
@@ -258,6 +259,56 @@ class ReturnTransformer:
         return out, False
 
 
+class _InterruptRewrite:
+    """break/continue -> flag assignments with guarded continuations,
+    scoped to ONE loop body (nested loops keep their own interrupts).
+    Mirrors ReturnTransformer's guard discipline."""
+
+    def __init__(self, brk, cont):
+        self.brk = brk
+        self.cont = cont
+
+    def _set(self, name):
+        return ast.Assign(targets=[_name(name, ast.Store())],
+                          value=_const(True))
+
+    def block(self, stmts):
+        """Returns (new_stmts, may_interrupt)."""
+        out = []
+        for k, st in enumerate(stmts):
+            if isinstance(st, ast.Break):
+                out.append(self._set(self.brk))
+                return out, True  # rest is dead
+            if isinstance(st, ast.Continue):
+                out.append(self._set(self.cont))
+                return out, True
+            if not _contains(st, (ast.Break, ast.Continue),
+                             into_loops=False):
+                out.append(st)
+                continue
+            if isinstance(st, ast.If):
+                b, bi = self.block(st.body)
+                o, oi = self.block(st.orelse) if st.orelse else ([], False)
+                st.body = b
+                st.orelse = o
+                out.append(st)
+                rest, _ = self.block(stmts[k + 1:]) \
+                    if k + 1 < len(stmts) else ([], False)
+                if rest:
+                    # skip the rest once EITHER flag fired
+                    guard = ast.If(
+                        test=_jst_call("not_interrupted",
+                                       [_name(self.brk),
+                                        _name(self.cont)]),
+                        body=rest, orelse=[])
+                    out.append(guard)
+                return out, True
+            raise UnsupportedConversion(
+                f"break/continue nested in {type(st).__name__} inside a "
+                "converted loop")
+        return out, False
+
+
 # ----------------------------------------- control-flow (stmt) transformer
 class ControlFlowTransformer(ast.NodeTransformer):
     """Rewrites If/While/For statements into `_jst.convert_*` dispatch.
@@ -308,19 +359,40 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return stmts
 
     def visit_While(self, node):
-        if node.orelse or _contains(node.body, (ast.Break, ast.Continue),
-                                    into_loops=False):
-            # while/else or break/continue: leave as Python (eager works;
-            # a traced condition will fail loudly at the bool() coercion)
+        if node.orelse:
+            # while/else: leave as Python (eager works; a traced
+            # condition will fail loudly at the bool() coercion)
             node.body = self._convert_block(node.body)
             return node
         uid = self._uid()
-        body = self._convert_block(node.body)
+        test = node.test
+        pre = []
+        raw_body = node.body
+        if _contains(raw_body, (ast.Break, ast.Continue),
+                     into_loops=False):
+            # break/continue become flags (ref loop_transformer.py):
+            #   break    -> __jst_brk_N = True  (+ guards on the rest)
+            #   continue -> __jst_cont_N = True (reset each iteration)
+            # and the loop condition gains `and not __jst_brk_N`
+            brk, cont = f"__jst_brk_{uid}", f"__jst_cont_{uid}"
+            raw_body, _ = _InterruptRewrite(brk, cont).block(raw_body)
+            raw_body = [ast.Assign(targets=[_name(cont, ast.Store())],
+                                   value=_const(False))] + raw_body
+            pre = [ast.Assign(targets=[_name(brk, ast.Store())],
+                              value=_const(False))]
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=test)
+            test = _jst_call("convert_logical_and",
+                             [_jst_call("convert_logical_not",
+                                        [_name(brk)]), thunk])
+        body = self._convert_block(raw_body)
         names = _carry_names(assigned_names(body))
         c, b = f"__jst_cond_{uid}", f"__jst_body_{uid}"
         g, s = f"__jst_get_{uid}", f"__jst_set_{uid}"
-        stmts = [_undef_probe(n) for n in names]
-        stmts.append(_def(c, [ast.Return(value=node.test)]))
+        stmts = pre + [_undef_probe(n) for n in names]
+        stmts.append(_def(c, [ast.Return(value=test)]))
         stmts.append(_def(b, _nonlocal_or_pass(names) + body))
         stmts.append(_getter(g, names))
         stmts.append(_setter(s, names))

@@ -107,3 +107,28 @@ def clip_grad_value_(parameters, clip_value):
             if p.grad is not None:
                 p.grad = Tensor(jnp.clip(p.grad.value, -clip_value,
                                          clip_value))
+
+
+def clip_grads_tree(grads, clip):
+    """Apply a grad-clip config to a pytree of RAW jax arrays (the shared
+    jit-path implementation for TrainStep / HybridTrainStep /
+    LocalSGDTrainStep — one source of truth for the clip math)."""
+    if clip is None:
+        return grads
+    import jax
+    import jax.numpy as jnp
+    if isinstance(clip, ClipGradByGlobalNorm):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for g in jax.tree.leaves(grads)))
+        f = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
+        return jax.tree.map(lambda g: (g * f).astype(g.dtype), grads)
+    if isinstance(clip, ClipGradByNorm):
+        def per_leaf(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            f = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            return (g * f).astype(g.dtype)
+        return jax.tree.map(per_leaf, grads)
+    if isinstance(clip, ClipGradByValue):
+        return jax.tree.map(lambda g: jnp.clip(g, clip.min, clip.max),
+                            grads)
+    return grads

@@ -268,3 +268,60 @@ def test_one_sided_assignment_errors_clearly():
 
     with pytest.raises(Exception, match="only the true branch|assignment"):
         f(paddle.to_tensor([1.0]))
+
+
+def test_while_with_break():
+    """break lowers to a loop-condition flag (loop_transformer parity)."""
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.zeros([], dtype="float32")
+        i = paddle.zeros([], dtype="int32")
+        while i < 100:
+            s = s + paddle.sum(x)
+            i = i + 1
+            if s > 5.0:
+                break
+        return s, i
+
+    x = paddle.to_tensor(np.full((2,), 1.0, np.float32))  # sum=2/iter
+    s, i = f(x)
+    assert float(s) == 6.0  # 2, 4, 6 -> stop
+    assert int(i) == 3
+    # data-dependent: smaller values loop longer, same compiled program
+    y = paddle.to_tensor(np.full((2,), 0.5, np.float32))
+    s2, i2 = f(y)
+    assert float(s2) == 6.0 and int(i2) == 6
+    assert len(f.concrete_program()) == 1
+
+
+def test_while_with_continue():
+    @paddle.jit.to_static
+    def f(x):
+        total = paddle.zeros([], dtype="float32")
+        i = paddle.zeros([], dtype="int32")
+        while i < paddle.sum(x):
+            i = i + 1
+            if (i % 2) == 0:
+                continue
+            total = total + i.astype("float32")
+        return total
+
+    x = paddle.to_tensor(np.full((6,), 1.0, np.float32))  # bound 6
+    # odd i in 1..6 -> 1+3+5 = 9
+    assert float(f(x)) == 9.0
+
+
+def test_break_in_eager_loop_unchanged():
+    """Concrete condition: the flagged loop still behaves like Python."""
+    @paddle.jit.to_static
+    def f(x, n):
+        out = x
+        i = 0
+        while i < n:
+            out = out + 1
+            i += 1
+            if i >= 2:
+                break
+        return out
+
+    assert float(f(paddle.to_tensor([0.0]), 5)[0]) == 2.0
