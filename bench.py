@@ -13,6 +13,15 @@ import traceback
 import numpy as np
 
 
+def _peak_flops(jax_mod):
+    """bf16 peak for the attached chip generation (MFU denominator)."""
+    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
+             "v6e": 918e12}
+    kind = jax_mod.devices()[0].device_kind.lower()
+    return next((v for k, v in peaks.items() if k in kind), 197e12)
+
+
 def _run():
     import signal
 
@@ -123,10 +132,7 @@ def _run():
         mm_tflops = 100 * 2 * 4096**3 / mm_dt / 1e12
     # MFU: train step ~ 6*N flops/token (fwd 2N + bwd 4N), against the
     # chip generation's bf16 peak.  Context only; headline stays tokens/s.
-    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
-             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12, "v6e": 918e12}
-    kind = jax.devices()[0].device_kind.lower() if on_tpu else ""
-    peak = next((v for k, v in peaks.items() if k in kind), 197e12)
+    peak = _peak_flops(jax) if on_tpu else 197e12
     mfu = 6.0 * n_params * tokens_per_sec / peak if on_tpu else 0.0
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_BASE.json")
@@ -208,11 +214,7 @@ def _run_1p3b():
         l13 = s13(ids13, ids13)
     float(l13.item())
     tps = 4 * 1024 * 8 / (time.perf_counter() - t0)
-    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
-             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
-             "v6e": 918e12}
-    kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in peaks.items() if k in kind), 197e12)
+    peak = _peak_flops(jax)
     print(json.dumps({"gpt_1p3b_tokens_per_sec": round(tps, 1),
                       "gpt_1p3b_mfu": round(6.0 * n13 * tps / peak, 4)}))
 

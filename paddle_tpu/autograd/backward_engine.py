@@ -213,9 +213,8 @@ def run_backward(tensor, grad_tensor=None, retain_graph=False):
     if tensor.stop_gradient:
         raise RuntimeError("backward() on a tensor with stop_gradient=True")
     if grad_tensor is None:
-        if tensor.size != 1:
-            raise RuntimeError(
-                "grad_tensor must be provided for non-scalar backward()")
+        # reference semantics (varbase_patch_methods.py backward): ANY
+        # shape backpropagates with an implicit all-ones cotangent
         seed = jnp.ones_like(tensor.value)
     else:
         seed = grad_tensor.value if isinstance(

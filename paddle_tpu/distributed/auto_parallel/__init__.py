@@ -193,8 +193,10 @@ class Planner:
         for cands in per_arg:
             total *= len(cands)
         truncated = total > len(combos)
+        rep = tuple(PartitionSpec() for _ in arrays)
         report = []
         best = None
+        rep_compiled = None  # kept so an all-inf fallback needs no recompile
         for specs in combos[:max_candidates]:
             try:
                 shardings = tuple(NamedSharding(self.mesh, s)
@@ -203,6 +205,8 @@ class Planner:
                     .lower(*arrays).compile()
             except Exception:
                 continue  # invalid combination for this fn
+            if specs == rep:
+                rep_compiled = compiled
             cost = self._cost_of(compiled)
             report.append((specs, cost))
             if best is None or cost < best[1]:
@@ -210,18 +214,15 @@ class Planner:
         if best is None:
             raise RuntimeError("auto_parallel search: no candidate "
                                "sharding compiled successfully")
-        if best[1] == float("inf"):
+        if best[1] == float("inf") and rep_compiled is not None \
+                and best[0] != rep:
             # cost_analysis unavailable everywhere: a "measured" winner
-            # would be arbitrary — fall back to replicated, loudly
+            # would be arbitrary — prefer the fully-replicated plan, loudly
             import warnings
             warnings.warn(
                 "auto_parallel search: XLA cost_analysis unavailable for "
-                "every candidate; returning the fully-replicated plan")
-            rep = tuple(PartitionSpec() for _ in arrays)
-            compiled = jax.jit(fn, in_shardings=tuple(
-                NamedSharding(self.mesh, s) for s in rep)) \
-                .lower(*arrays).compile()
-            best = (rep, float("inf"), compiled)
+                "every candidate; preferring the fully-replicated plan")
+            best = (rep, float("inf"), rep_compiled)
         result = PlanResult(best[2])
         result.chosen_specs = best[0]
         result.search_report = sorted(report, key=lambda t: t[1])

@@ -196,5 +196,8 @@ class Model:
 
     def summary(self, input_size=None, dtype=None):
         from .model_summary import summary
+        ins = self._inputs
+        if ins is not None and not isinstance(ins, (list, tuple)):
+            ins = [ins]  # single InputSpec is valid (ref hapi/model.py)
         return summary(self.network, input_size or
-                       [tuple(s.shape) for s in (self._inputs or [])])
+                       [tuple(s.shape) for s in (ins or [])])

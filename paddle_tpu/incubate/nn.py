@@ -8,6 +8,18 @@ FusedFeedForward = _inc._FusedFeedForward
 MoELayer = _inc._MoELayer
 
 
+def _fused_ln(jnp, jax, h, s, b, eps):
+    """Shared f32 layernorm core for the fused ops below."""
+    mu = jnp.mean(h.astype(jnp.float32), -1, keepdims=True)
+    var = jnp.var(h.astype(jnp.float32), -1, keepdims=True)
+    o = (h.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
+    if s is not None:
+        o = o * s.astype(jnp.float32)
+    if b is not None:
+        o = o + b.astype(jnp.float32)
+    return o.astype(h.dtype)
+
+
 def fused_multi_head_attention(x, qkv_weight, linear_weight,
                                pre_layer_norm=False, pre_ln_scale=None,
                                pre_ln_bias=None, ln_scale=None,
@@ -61,14 +73,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         mask = next(it) if flags["mask"] else None
 
         def _ln(h, s, b, eps):
-            mu = jnp.mean(h.astype(jnp.float32), -1, keepdims=True)
-            var = jnp.var(h.astype(jnp.float32), -1, keepdims=True)
-            o = (h.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)
-            if s is not None:
-                o = o * s.astype(jnp.float32)
-            if b is not None:
-                o = o + b.astype(jnp.float32)
-            return o.astype(h.dtype)
+            return _fused_ln(jnp, jax, h, s, b, eps)
 
         h = _ln(xv, pre_s, pre_b, pre_ln_epsilon) if pre_layer_norm \
             else xv
@@ -117,3 +122,91 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "MoELayer",
            "fused_multi_head_attention"]
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", name=None):
+    """Transformer FFN block as ONE taped op. Parity:
+    python/paddle/incubate/nn/functional/fused_transformer.py:31 —
+    residual + (pre|post) layernorm + linear/act/dropout/linear/dropout.
+    TPU-native: one jnp composition, XLA fuses the elementwise chain into
+    the two MXU matmuls."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import Tensor, apply_op
+    from ..framework.random import split_key
+
+    use_d1 = training and dropout1_rate > 0.0
+    use_d2 = training and dropout2_rate > 0.0
+    k1 = split_key() if use_d1 else None
+    k2 = split_key() if use_d2 else None
+    down1 = (1.0 - dropout1_rate) if (not training and dropout1_rate > 0.0
+                                      and mode == "downscale_in_infer") \
+        else None
+    down2 = (1.0 - dropout2_rate) if (not training and dropout2_rate > 0.0
+                                      and mode == "downscale_in_infer") \
+        else None
+
+    opt = [t for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias,
+                       ln2_scale, ln2_bias) if t is not None]
+    flags = dict(b1=linear1_bias is not None, b2=linear2_bias is not None,
+                 s1=ln1_scale is not None, lb1=ln1_bias is not None,
+                 s2=ln2_scale is not None, lb2=ln2_bias is not None)
+
+    def fn(xv, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if flags["b1"] else None
+        b2 = next(it) if flags["b2"] else None
+        s1 = next(it) if flags["s1"] else None
+        lb1 = next(it) if flags["lb1"] else None
+        s2 = next(it) if flags["s2"] else None
+        lb2 = next(it) if flags["lb2"] else None
+
+        def _ln(h, s, b, eps):
+            return _fused_ln(jnp, jax, h, s, b, eps)
+
+        def _drop(h, rate, key, use, down):
+            if use:
+                keep = jax.random.bernoulli(key, 1.0 - rate, h.shape)
+                return jnp.where(
+                    keep, h / (1.0 - rate)
+                    if mode == "upscale_in_train" else h, 0.0
+                ).astype(h.dtype)
+            if down is not None:
+                return (h * down).astype(h.dtype)
+            return h
+
+        h = _ln(xv, s1, lb1, ln1_epsilon) if pre_layer_norm else xv
+        h = h @ w1
+        if b1 is not None:
+            h = h + b1
+        h = getattr(jax.nn, activation)(h) if hasattr(jax.nn, activation) \
+            else jax.nn.relu(h)
+        h = _drop(h, dropout1_rate, k1, use_d1, down1)
+        h = h @ w2
+        if b2 is not None:
+            h = h + b2
+        h = _drop(h, dropout2_rate, k2, use_d2, down2)
+        out = xv + h
+        return out if pre_layer_norm else _ln(out, s2, lb2, ln2_epsilon)
+
+    return apply_op(fn, x, linear1_weight, linear2_weight, *opt)
+
+
+# reference namespace: paddle.incubate.nn.functional.{fused_*}
+import types as _types
+
+functional = _types.ModuleType(__name__ + ".functional")
+functional.fused_multi_head_attention = fused_multi_head_attention
+functional.fused_feedforward = fused_feedforward
+functional.__all__ = ["fused_multi_head_attention", "fused_feedforward"]
+import sys as _sys
+
+_sys.modules[functional.__name__] = functional
+
+__all__ += ["fused_feedforward", "functional"]

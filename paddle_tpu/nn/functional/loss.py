@@ -115,6 +115,8 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
     def fn(logp, lab, *rest):
         lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim and lab_i.shape[-1] == 1:
+            lab_i = jnp.squeeze(lab_i, -1)  # [N,1] labels (ref accepts)
         valid = lab_i != ignore_index
         safe = jnp.where(valid, lab_i, 0)
         picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)

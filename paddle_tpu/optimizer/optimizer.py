@@ -140,6 +140,23 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        import paddle_tpu as _pd
+        if not _pd.in_dynamic_mode():
+            # static graph: minimize DECLARES the objective — no update
+            # happens at build time. Executor.run executes one optimizer
+            # step per call (reference executor semantics): the hook
+            # carries the build-time param slots so the updated values
+            # can be synced back into the recorded tape for the next
+            # replay.
+            from ..static import default_main_program
+            prog = default_main_program()
+            if not any(o is loss for o in prog.outputs):  # identity, not
+                prog.outputs.append(loss)                 # Tensor.__eq__
+            if not self._parameters:
+                self._parameters = list(prog._params)
+            prog._train_hooks.append(
+                (loss, self, [(p, p._slot) for p in self._parameters]))
+            return None, [(p, None) for p in self._parameters]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameters]
@@ -338,9 +355,12 @@ class Adam(Optimizer):
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
-        self._beta1 = beta1
-        self._beta2 = beta2
-        self._epsilon = epsilon
+        # reference accepts Tensor betas (adamw.py doc example); the
+        # update math is jnp — coerce to python floats
+        self._beta1 = float(beta1) if hasattr(beta1, "numpy") else beta1
+        self._beta2 = float(beta2) if hasattr(beta2, "numpy") else beta2
+        self._epsilon = float(epsilon) if hasattr(epsilon, "numpy") \
+            else epsilon
 
     def _init_state(self, v):
         return (self._f32_zeros(v), self._f32_zeros(v))

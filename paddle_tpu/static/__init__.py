@@ -61,6 +61,8 @@ class Program:
         self.random_seed = 0
         self._builder = None
         self._params = []  # params created by static.nn under this program
+        self._train_hooks = []  # (loss, optimizer, [(param, build_slot)])
+        # registered by optimizer.minimize; Executor.run steps them
 
     def global_block(self):
         return self
@@ -144,13 +146,33 @@ class name_scope:
 
 
 class Executor:
-    """Trace-and-compile executor. run() re-binds feeds into the
-    placeholders, replays the python graph-building (captured as the value
-    flow from placeholders to fetch vars), and jits it per feed-shape."""
+    """Recorded-trace executor. run() rebinds feeds into the placeholder
+    slots, replays the recorded op tape forward to the fetches (each op
+    is an XLA-compiled jnp call; the per-op python dispatch is the cost
+    of eager-static parity — the performant path is jit/TrainStep), and
+    executes one optimizer step per call for every minimize()-declared
+    objective."""
 
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+
+    @staticmethod
+    def _replay(fetch_tensors):
+        """Recompute fetch values forward through the recorded tape —
+        producers before consumers — so fresh feed values flow to the
+        fetches (the recorded-trace analogue of the reference executor
+        re-running the Program's ops)."""
+        from ..autograd.backward_engine import _topo_nodes
+        nodes = _topo_nodes([t._slot for t in fetch_tensors
+                             if isinstance(t, Tensor)])
+        for node in nodes:
+            if node.fn is None:
+                continue
+            vals = node.fn(*[s.val for s in node.in_slots])
+            outs = vals if isinstance(vals, (tuple, list)) else [vals]
+            for s, v in zip(node.out_slots, outs):
+                s.val = v
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
@@ -160,15 +182,36 @@ class Executor:
         if not isinstance(fetch_list, (list, tuple)):
             fetch_list = [fetch_list]
 
-        # bind feeds eagerly into placeholder tensors and re-execute the
-        # recorded builder (if registered) or rely on eager value flow
+        # bind feeds by MUTATING the placeholder's slot value in place:
+        # recorded tape nodes reference these slot objects, so the replay
+        # below sees fresh values (a rebind would orphan them)
         for name, value in feed.items():
             ph = program.placeholders.get(name)
             if ph is None:
                 continue
             arr = value.value if isinstance(value, Tensor) else \
                 jnp.asarray(np.asarray(value))
-            ph._bind(Tensor(arr)._slot)
+            ph._slot.val = arr
+        # resolve fetch entries: Tensor | placeholder name | unnamed
+        # (None, e.g. `fetch_list=loss.name` on an auto-created var).
+        # Unnamed entries map positionally onto the program's declared
+        # outputs (populated by optimizer.minimize/save_inference_model);
+        # anything unresolvable raises — silent garbage corrupts runs.
+        resolved = []
+        unnamed_i = 0
+        for e in fetch_list:
+            if isinstance(e, Tensor):
+                resolved.append(e)
+            elif isinstance(e, str) and e in program.placeholders:
+                resolved.append(program.placeholders[e])
+            elif e is None and unnamed_i < len(program.outputs):
+                resolved.append(program.outputs[unnamed_i])
+                unnamed_i += 1
+            else:
+                raise ValueError(
+                    f"Executor.run cannot resolve fetch entry {e!r}: pass "
+                    "the Tensor itself, a placeholder name, or declare "
+                    "outputs via optimizer.minimize")
         if program._builder is not None:
             outs = program._builder(
                 **{k: program.placeholders[k] for k in program.placeholders})
@@ -176,7 +219,23 @@ class Executor:
                 outs = [outs]
             results = outs
         else:
-            results = fetch_list
+            if feed:
+                # replay fetches AND the registered train losses forward
+                replay_roots = list(resolved) + [h[0] for h in
+                                                 program._train_hooks]
+                self._replay(replay_roots)
+            # one optimizer step per run() over each minimize()-declared
+            # objective (reference executor semantics), then sync the
+            # updated params back into the recorded tape's slots so the
+            # NEXT replay computes with the new weights
+            if feed and program._train_hooks:
+                for loss_t, opt, slots in program._train_hooks:
+                    loss_t.backward(retain_graph=True)
+                    opt.step()
+                    for p, build_slot in slots:
+                        build_slot.val = p.value
+                        p.clear_grad()
+            results = resolved
         out_vals = []
         for r in results:
             v = r.numpy() if isinstance(r, Tensor) else np.asarray(r)

@@ -41,17 +41,15 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = []
     for xi in xs:
-        shape = xi.shape
-        if num_flatten_dims > 1:
-            lead = int(np.prod(shape[:num_flatten_dims]))
-            feat = int(np.prod(shape[num_flatten_dims:]))
-            xi = xi.reshape([*shape[:num_flatten_dims], feat]) \
-                if feat != shape[-1] or len(shape) != num_flatten_dims + 1 \
-                else xi
         lin = _track(Linear(
             int(np.prod(xi.shape[num_flatten_dims:])), size,
             weight_attr=weight_attr, bias_attr=bias_attr))
-        flat = xi.reshape([*xi.shape[:num_flatten_dims], -1])
+        # flatten from the RUNTIME shape, not the build-time one: the
+        # Executor replays this op with feeds whose batch dim may differ
+        # from the placeholder's build-time size
+        from ...framework.core import apply_op
+        nfd = num_flatten_dims
+        flat = apply_op(lambda a: a.reshape(a.shape[:nfd] + (-1,)), xi)
         outs.append(lin(flat))
     out = outs[0]
     for o in outs[1:]:

@@ -68,11 +68,15 @@ class TestBackward:
         z = x * 2
         assert not z.stop_gradient
 
-    def test_non_scalar_backward_needs_grad_tensor(self):
+    def test_non_scalar_backward_implicit_ones(self):
+        """Reference semantics (varbase_patch_methods.py backward): ANY
+        shape backpropagates with an implicit all-ones cotangent — the
+        adamw docstring example calls out.backward() on a [10,10]."""
         x = leaf([1.0, 2.0])
         y = x * 2
-        with pytest.raises(RuntimeError):
-            y.backward()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+        x.clear_grad()
         y2 = x * 2
         y2.backward(grad_tensor=paddle.to_tensor([1.0, 10.0]))
         np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])

@@ -212,3 +212,45 @@ class TestFusedMHAFunctional:
         np.testing.assert_allclose(
             scaled.numpy() - x.numpy(),
             (base.numpy() - x.numpy()) * 0.5, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedFFNFunctional:
+    """incubate.nn.functional.fused_feedforward parity
+    (ref fused_transformer.py:31 pseudo code)."""
+
+    def test_matches_manual_pre_ln(self):
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+        rs = np.random.RandomState(3)
+        x = paddle.to_tensor(rs.randn(2, 4, 8).astype("float32"),
+                             stop_gradient=False)
+        w1 = paddle.to_tensor(rs.randn(8, 16).astype("float32") * .1)
+        w2 = paddle.to_tensor(rs.randn(16, 8).astype("float32") * .1)
+        out = fused_feedforward(x, w1, w2, pre_layer_norm=True,
+                                dropout1_rate=0.0, dropout2_rate=0.0)
+        h = x.numpy()
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        hn = (h - mu) / np.sqrt(var + 1e-5)
+        want = np.maximum(hn @ w1.numpy(), 0) @ w2.numpy() + h
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+        out.sum().backward()
+        assert x.grad is not None
+
+    def test_post_ln_and_gelu(self):
+        from paddle_tpu.incubate.nn.functional import fused_feedforward
+        rs = np.random.RandomState(4)
+        x = paddle.to_tensor(rs.randn(1, 3, 8).astype("float32"))
+        w1 = paddle.to_tensor(rs.randn(8, 16).astype("float32") * .1)
+        w2 = paddle.to_tensor(rs.randn(16, 8).astype("float32") * .1)
+        out = fused_feedforward(x, w1, w2, activation="gelu",
+                                dropout1_rate=0.0, dropout2_rate=0.0)
+        # post-LN output is normalized: per-position mean ~0, var ~1
+        o = out.numpy()
+        np.testing.assert_allclose(o.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(o.var(-1), 1.0, atol=1e-3)
+
+    def test_functional_namespace(self):
+        import paddle_tpu.incubate.nn.functional as F
+        assert callable(F.fused_multi_head_attention)
+        assert callable(F.fused_feedforward)

@@ -383,3 +383,55 @@ class TestIncubate:
             la.step()
             la.clear_grad()
         assert p.numpy()[0] < 1.0
+
+
+class TestStaticExecutorTraining:
+    """Executor.run executes ONE optimizer step per call (reference
+    executor semantics): params update, loss decreases across run()
+    calls — the round-4 review repro showed loss frozen before this."""
+
+    def test_loss_decreases_across_runs(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            main_prog = static.Program()
+            start_prog = static.Program()
+            with static.program_guard(main_prog, start_prog):
+                x = static.data(name="x", shape=[None, 8])
+                y = static.data(name="y", shape=[None, 1])
+                pred = static.nn.fc(x, 1)
+                loss = paddle.mean(
+                    paddle.nn.functional.square_error_cost(pred, y))
+                sgd = opt.SGD(learning_rate=0.1)
+                sgd.minimize(loss)
+
+            exe = static.Executor()
+            exe.run(start_prog)
+            rs = np.random.RandomState(0)
+            X = rs.randn(16, 8).astype("float32")
+            Y = (X @ rs.randn(8, 1)).astype("float32")
+            losses = [float(exe.run(main_prog, feed={"x": X, "y": Y},
+                                    fetch_list=[loss])[0])
+                      for _ in range(10)]
+            assert losses[-1] < losses[0] * 0.7, losses
+            # fetch-by-unnamed-name resolves to the minimized loss
+            out = exe.run(main_prog, feed={"x": X, "y": Y},
+                          fetch_list=loss.name)
+            assert np.asarray(out[0]).shape == ()
+        finally:
+            paddle.disable_static()
+
+    def test_unresolvable_fetch_raises(self):
+        import paddle_tpu.static as static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data(name="x", shape=[None, 4])
+                z = static.nn.fc(x, 2)
+            exe = static.Executor()
+            with pytest.raises(ValueError, match="cannot resolve"):
+                exe.run(prog, feed={"x": np.zeros((2, 4), np.float32)},
+                        fetch_list=["not_a_var"])
+        finally:
+            paddle.disable_static()
