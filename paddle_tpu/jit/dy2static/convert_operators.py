@@ -29,7 +29,9 @@ __all__ = [
     "UNDEFINED", "convert_ifelse", "convert_ifexp", "convert_while_loop",
     "convert_for", "convert_for_range", "convert_logical_and",
     "convert_logical_or", "convert_logical_not", "convert_var_to_bool",
-    "convert_call", "not_returned",
+    "convert_call", "not_returned", "convert_assert", "convert_print",
+    "range_continues", "seq_continues", "seq_get",
+    "materialize_seq",
 ]
 
 
@@ -428,3 +430,104 @@ def convert_call(fn):
         return convert_to_static(fn)
     except Exception:
         return fn
+
+
+def convert_assert(cond, *msg):
+    """`assert` inside converted code (ref convert_operators.convert_assert
+    -> Assert op). Concrete conditions keep Python semantics; a traced
+    condition cannot halt tracing, so it lowers to a device-side
+    checkify-style debug check (prints on failure, does not abort —
+    matching the reference Assert op's deferred-runtime nature)."""
+    if isinstance(cond, Tensor) and _is_traced(cond):
+        if msg and isinstance(msg[0], Tensor):
+            # tensor message: print its runtime value as a second field
+            jax.debug.print("Assert over traced value {ok}: {m}",
+                            ok=_pred(cond), m=msg[0].value)
+        else:
+            # static message: brace-escape so str.format never sees it
+            suffix = (": " + str(msg[0]).replace("{", "{{")
+                      .replace("}", "}}")) if msg else ""
+            jax.debug.print("Assert over traced value {ok}" + suffix,
+                            ok=_pred(cond))
+        return
+    if isinstance(cond, Tensor):
+        cond = bool(cond.numpy().reshape(())) if cond.size == 1 \
+            else bool(cond.numpy().all())
+    assert cond, (msg[0] if msg else "")
+
+
+def convert_print(*args, **kwargs):
+    """`print` inside converted code (ref convert_operators.convert_print
+    -> Print op): traced tensors print their runtime VALUES via
+    jax.debug.print instead of tracer reprs. sep/end are honored; file/
+    flush cannot be (the print happens device-side at run time)."""
+    if any(isinstance(a, Tensor) and _is_traced(a) for a in args):
+        if kwargs.get("file") is not None:
+            import warnings
+            warnings.warn("print(file=...) is ignored for traced tensors "
+                          "(device-side jax.debug.print)")
+        sep = kwargs.get("sep", " ")
+
+        def esc(x):
+            return str(x).replace("{", "{{").replace("}", "}}")
+
+        parts, values, vi = [], {}, 0
+        for a in args:
+            if isinstance(a, Tensor):
+                key = f"v{vi}"
+                vi += 1
+                parts.append("{" + key + "}")
+                values[key] = a.value
+            else:
+                parts.append(esc(a))
+        jax.debug.print(esc(sep).join(parts), **values)
+        return
+    print(*[a.numpy() if isinstance(a, Tensor) else a for a in args],
+          **kwargs)
+
+
+def range_continues(i, stop, step):
+    """Loop test for a for-range desugared to while (interrupt support):
+    sign-aware, tensor-aware."""
+    ti = _is_tensorish(i) or _is_tensorish(stop) or _is_tensorish(step)
+    if not ti:
+        return i < stop if step > 0 else i > stop
+    iv, sv, st = (_raw(i), _raw(stop), _raw(step))
+    return Tensor(jnp.where(jnp.asarray(st) > 0,
+                            jnp.asarray(iv) < jnp.asarray(sv),
+                            jnp.asarray(iv) > jnp.asarray(sv)))
+
+
+def materialize_seq(it):
+    """Normalize a for-iterable for the interrupt desugar: Tensors and
+    len()-able sequences pass through; one-shot iterables (zip,
+    generators, dict views) materialize to a list so the counter-while
+    can index them."""
+    if isinstance(it, Tensor) or hasattr(it, "__len__"):
+        return it
+    return list(it)
+
+
+def seq_continues(i, seq):
+    """Loop test for a for-over-sequence desugared to while."""
+    n = seq.shape[0] if isinstance(seq, Tensor) else len(seq)
+    if _is_tensorish(i):
+        return Tensor(jnp.asarray(_raw(i)) < n)
+    return i < n
+
+
+def seq_get(seq, i):
+    """Indexed access for the desugared for: Tensors accept a traced
+    index; a PYTHON sequence cannot be indexed by a traced counter (the
+    loop went data-dependent) — fail with guidance instead of a cryptic
+    list-index TypeError."""
+    if isinstance(seq, Tensor):
+        return seq[i]
+    if _is_tensorish(i):
+        if _is_traced(i):
+            raise TypeError(
+                "a `for` over a python sequence became data-dependent "
+                "(its break/continue condition is traced); stack the "
+                "sequence into one Tensor so the loop can lower to lax")
+        i = int(jnp.asarray(_raw(i)))
+    return seq[i]

@@ -52,7 +52,7 @@ def _carry_names(names):
     break/continue flag slots DO carry."""
     return [n for n in names
             if not n.startswith("__jst_") or n in (_RET, _FLAG)
-            or n.startswith(("__jst_brk", "__jst_cont"))]
+            or n.startswith(("__jst_brk", "__jst_cont", "__jst_fw"))]
 
 
 def assigned_names(stmts):
@@ -358,6 +358,65 @@ class ControlFlowTransformer(ast.NodeTransformer):
             ast.copy_location(st, node)
         return stmts
 
+    def _for_to_while(self, node):
+        """`for TGT in X: BODY` -> counter-while with TGT bound per
+        iteration; X is either range(...) (counter IS the target source)
+        or a sequence (indexed per iteration)."""
+        uid = self._uid()
+        i_n = f"__jst_fwi_{uid}"
+        it = node.iter
+        pre = []
+        starred = (isinstance(it, ast.Call)
+                   and any(isinstance(a, ast.Starred) for a in it.args))
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range" and not it.keywords \
+                and not starred:
+            a = list(it.args)
+            start = a[0] if len(a) >= 2 else _const(0)
+            stop = a[1] if len(a) >= 2 else a[0]
+            step = a[2] if len(a) >= 3 else _const(1)
+            stop_n, step_n = f"__jst_fws_{uid}", f"__jst_fwp_{uid}"
+            pre = [ast.Assign(targets=[_name(i_n, ast.Store())],
+                              value=start),
+                   ast.Assign(targets=[_name(stop_n, ast.Store())],
+                              value=stop),
+                   ast.Assign(targets=[_name(step_n, ast.Store())],
+                              value=step)]
+            test = _jst_call("range_continues",
+                             [_name(i_n), _name(stop_n), _name(step_n)])
+            bind = ast.Assign(targets=[node.target], value=_name(i_n))
+            bump = ast.AugAssign(target=_name(i_n, ast.Store()),
+                                 op=ast.Add(), value=_name(step_n))
+        else:
+            seq_n = f"__jst_fwq_{uid}"
+            # materialize one-shot iterables (zip/generators); a range
+            # object from range(*args) passes through (len+getitem work)
+            pre = [ast.Assign(targets=[_name(seq_n, ast.Store())],
+                              value=_jst_call("materialize_seq", [it])),
+                   ast.Assign(targets=[_name(i_n, ast.Store())],
+                              value=_const(0))]
+            test = _jst_call("seq_continues", [_name(i_n), _name(seq_n)])
+            bind = ast.Assign(
+                targets=[node.target],
+                value=_jst_call("seq_get", [_name(seq_n), _name(i_n)]))
+            bump = ast.AugAssign(target=_name(i_n, ast.Store()),
+                                 op=ast.Add(), value=_const(1))
+        # bind + bump run BEFORE the body: `continue` must still advance
+        # the counter (Python for semantics), and the interrupt rewrite
+        # only guards statements after the continue site
+        w = ast.While(test=test, body=[bind, bump] + node.body,
+                      orelse=[])
+        mod = ast.Module(body=pre + [w], type_ignores=[])
+        for st in ast.walk(mod):
+            ast.copy_location(st, node)
+        # the caller visits the returned statements; hand back the list
+        out = []
+        for st in pre:
+            out.append(st)
+        r = self.visit(w)
+        out.extend(r if isinstance(r, list) else [r])
+        return out
+
     def visit_While(self, node):
         if node.orelse:
             # while/else: leave as Python (eager works; a traced
@@ -404,10 +463,15 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return stmts
 
     def visit_For(self, node):
-        if node.orelse or _contains(node.body, (ast.Break, ast.Continue),
-                                    into_loops=False):
+        if node.orelse:
             node.body = self._convert_block(node.body)
             return node
+        if _contains(node.body, (ast.Break, ast.Continue),
+                     into_loops=False):
+            # desugar to a while loop (counter + explicit target bind) so
+            # the while machinery's interrupt-flag lowering applies
+            # (ref loop_transformer.py for->while normalization)
+            return self._for_to_while(node)
         uid = self._uid()
         body = self._convert_block(node.body)
         # loop-target names are assigned by iteration itself
@@ -478,9 +542,18 @@ class ExprTransformer(ast.NodeTransformer):
             _jst_call("convert_ifexp",
                       [node.test, mk(node.body), mk(node.orelse)]), node)
 
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test] + ([node.msg] if node.msg is not None else [])
+        return ast.copy_location(
+            ast.Expr(value=_jst_call("convert_assert", args)), node)
+
     def visit_Call(self, node):
         self.generic_visit(node)
         f = node.func
+        if isinstance(f, ast.Name) and f.id == "print":
+            node.func = ast.copy_location(_jst_attr("convert_print"), f)
+            return node
         if isinstance(f, ast.Name) and (
                 f.id.startswith("__jst_") or f.id in ("super", "locals",
                                                       "globals", "range")):

@@ -325,3 +325,127 @@ def test_break_in_eager_loop_unchanged():
         return out
 
     assert float(f(paddle.to_tensor([0.0]), 5)[0]) == 2.0
+
+
+def test_assert_and_print_convert():
+    """assert/print over traced tensors convert (ref convert_operators
+    convert_assert/convert_print -> Assert/Print ops) instead of dying
+    on tracer coercion."""
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.sum(x)
+        assert s > -1e9, "always true"
+        if s > 0:
+            print("positive sum:", s)
+            return x * 2
+        return x
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor([1.0, 2.0])).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor([-1.0, -2.0])).numpy(), [-1.0, -2.0])
+
+
+def test_for_range_with_break():
+    """for-range + break desugars to an interrupt-flagged while
+    (ref loop_transformer.py for->while normalization)."""
+    @paddle.jit.to_static
+    def f(x):
+        acc = paddle.zeros([], dtype="float32")
+        for i in range(100):
+            acc = acc + paddle.sum(x)
+            if acc > 5.0:
+                break
+        return acc
+
+    assert float(f(paddle.to_tensor(np.full((2,), 1.0, np.float32)))) \
+        == 6.0  # 2, 4, 6 -> stop
+    assert float(f(paddle.to_tensor(np.full((2,), 4.0, np.float32)))) \
+        == 8.0  # one iteration
+    assert len(f.concrete_program()) == 1
+
+
+def test_for_range_with_continue():
+    @paddle.jit.to_static
+    def f(x):
+        total = paddle.zeros([], dtype="float32")
+        for i in range(6):
+            if (i % 2) == 1:
+                continue
+            total = total + paddle.sum(x) * i
+        return total
+
+    # even i: 0+2+4 = 6, times sum(x)=1
+    assert float(f(paddle.to_tensor(np.full((1,), 1.0, np.float32)))) \
+        == 6.0
+
+
+def test_for_tensor_with_break():
+    @paddle.jit.to_static
+    def f(rows):
+        acc = paddle.zeros([], dtype="float32")
+        for r in rows:
+            acc = acc + paddle.sum(r)
+            if acc > 4.0:
+                break
+        return acc
+
+    rows = paddle.to_tensor(
+        np.array([[1.0, 1.0], [2.0, 2.0], [9.0, 9.0]], np.float32))
+    assert float(f(rows)) == 6.0  # 2, then 6 -> stop before the 9s
+
+
+def test_for_zip_with_break_eager():
+    """Interrupted for over zip: materialized and unrolled while the
+    break condition stays Python-static; a traced condition raises
+    actionable guidance (stack the list into a Tensor)."""
+    STOP = 2  # static closure constant — a jit ARG would be traced
+
+    @paddle.jit.to_static
+    def f(x):
+        acc = x
+        for i, k in zip(range(5), [1, 2, 3, 4, 5]):
+            acc = acc + k
+            if i >= STOP:
+                break
+        return acc
+
+    # static stop: 1+2+3 added
+    assert float(f(paddle.to_tensor([0.0]))[0]) == 6.0
+
+    def g(x, stop_at):
+        acc = x
+        for i, k in zip(range(5), [1, 2, 3, 4, 5]):
+            acc = acc + k
+            if i >= stop_at:  # stop_at traced -> loop is data-dependent
+                break
+        return acc
+
+    cg = paddle.jit.to_static(g)
+    with pytest.raises(Exception, match="stack the sequence|sequence"):
+        cg(paddle.to_tensor([0.0]), 2)
+
+
+def test_assert_message_with_braces():
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.sum(x)
+        assert s > -1e9, "value {not a format field}"
+        if s > 0:
+            return x + 1
+        return x
+
+    np.testing.assert_allclose(f(paddle.to_tensor([1.0])).numpy(), [2.0])
+
+
+def test_print_sep_kwarg_under_trace(capfd):
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.sum(x)
+        if s > 0:
+            print("sum", s, sep="|")
+            return x * 2
+        return x
+
+    out = f(paddle.to_tensor([3.0]))
+    np.testing.assert_allclose(out.numpy(), [6.0])
