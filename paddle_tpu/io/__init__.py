@@ -338,6 +338,31 @@ class DataLoader:
         iterator, same as iterating the loader directly)."""
         return iter(self)
 
+    @staticmethod
+    def from_generator(feed_list=None, capacity=None,
+                       use_double_buffer=True, iterable=True,
+                       return_list=True, use_multiprocess=False,
+                       drop_last=True):
+        """Legacy fluid API (reference python/paddle/fluid/reader.py
+        DataLoader.from_generator): returns a loader whose data source is
+        attached afterwards via set_sample_generator /
+        set_sample_list_generator / set_batch_generator."""
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer,
+                                iterable, return_list, use_multiprocess,
+                                drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Legacy fluid API: iterate a (possibly distributed ps-style)
+        dataset directly."""
+        loader = _GeneratorLoader(return_list=True, drop_last=drop_last)
+
+        def gen():
+            for item in dataset:
+                yield item if isinstance(item, (list, tuple)) else (item,)
+        loader.set_sample_generator(gen, batch_size=1, drop_last=drop_last)
+        return loader
+
     def __len__(self):
         if self._iterable_mode:
             raise TypeError("IterableDataset has no fixed length")
@@ -412,3 +437,83 @@ class DataLoader:
             index_iter, self._make_batch, self.num_workers,
             self.num_workers * self.prefetch_factor, self.timeout,
             self.worker_init_fn)
+
+
+class _GeneratorLoader:
+    """Loader built by DataLoader.from_generator (legacy fluid API,
+    parity: python/paddle/fluid/reader.py GeneratorLoader). The three
+    source setters mirror the reference: per-sample generator (batched
+    here), per-sample-list generator (collated), per-batch generator
+    (passed through). Iterating yields Tensor lists (return_list=True,
+    the dygraph default) or name->Tensor dicts for the static feed."""
+
+    def __init__(self, feed_list=None, capacity=None,
+                 use_double_buffer=True, iterable=True, return_list=True,
+                 use_multiprocess=False, drop_last=True):
+        self._feed_list = feed_list or []
+        self._iterable = iterable
+        self._return_list = return_list
+        self._drop_last = drop_last
+        self._gen = None
+        self._mode = None
+        self._batch_size = None
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        self._gen, self._mode = reader, "sample"
+        self._batch_size = batch_size
+        self._drop_last = drop_last
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        self._gen, self._mode = reader, "sample_list"
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._gen, self._mode = reader, "batch"
+        return self
+
+    def _wrap(self, fields):
+        ts = [Tensor(np.asarray(f)) if not isinstance(f, Tensor) else f
+              for f in fields]
+        if self._return_list:
+            return ts
+        names = [getattr(v, "name", None) or f"f{i}"
+                 for i, v in enumerate(self._feed_list)]
+        # never truncate: fields beyond feed_list get generated names
+        names += [f"f{i}" for i in range(len(names), len(ts))]
+        return {n: t for n, t in zip(names, ts)}
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "set a data source first: set_sample_generator / "
+                "set_sample_list_generator / set_batch_generator")
+        if self._mode == "batch":
+            for batch in self._gen():
+                yield self._wrap(list(batch))
+            return
+        if self._mode == "sample_list":
+            for samples in self._gen():
+                fields = list(zip(*samples))
+                yield self._wrap([np.stack(f) for f in fields])
+            return
+        buf = []
+        for sample in self._gen():
+            buf.append(sample if isinstance(sample, (list, tuple))
+                       else (sample,))
+            if len(buf) == self._batch_size:
+                fields = list(zip(*buf))
+                yield self._wrap([np.stack(f) for f in fields])
+                buf = []
+        if buf and not self._drop_last:
+            fields = list(zip(*buf))
+            yield self._wrap([np.stack(f) for f in fields])
+
+    __call__ = __iter__  # legacy `for batch in loader():`
+
+    def start(self):  # non-iterable (start/reset) mode parity: no-op —
+        pass          # iteration drives the generator directly
+
+    def reset(self):
+        pass

@@ -439,16 +439,24 @@ def convert_assert(cond, *msg):
     checkify-style debug check (prints on failure, does not abort —
     matching the reference Assert op's deferred-runtime nature)."""
     if isinstance(cond, Tensor) and _is_traced(cond):
+        ok = jnp.all(jnp.asarray(_raw(cond)))  # any shape, like the
+        # concrete path's .all()
+
         if msg and isinstance(msg[0], Tensor):
-            # tensor message: print its runtime value as a second field
-            jax.debug.print("Assert over traced value {ok}: {m}",
-                            ok=_pred(cond), m=msg[0].value)
+            mv = msg[0].value
+
+            def _report():
+                jax.debug.print("Assertion failed: {m}", m=mv)
         else:
             # static message: brace-escape so str.format never sees it
-            suffix = (": " + str(msg[0]).replace("{", "{{")
-                      .replace("}", "}}")) if msg else ""
-            jax.debug.print("Assert over traced value {ok}" + suffix,
-                            ok=_pred(cond))
+            text = ("Assertion failed" +
+                    (": " + str(msg[0]).replace("{", "{{")
+                     .replace("}", "}}") if msg else ""))
+
+            def _report():
+                jax.debug.print(text)
+        # print ONLY on failure (deferred runtime check)
+        jax.lax.cond(ok, lambda: None, _report)
         return
     if isinstance(cond, Tensor):
         cond = bool(cond.numpy().reshape(())) if cond.size == 1 \
@@ -467,6 +475,7 @@ def convert_print(*args, **kwargs):
             warnings.warn("print(file=...) is ignored for traced tensors "
                           "(device-side jax.debug.print)")
         sep = kwargs.get("sep", " ")
+        end = kwargs.get("end", "")
 
         def esc(x):
             return str(x).replace("{", "{{").replace("}", "}}")
@@ -480,7 +489,9 @@ def convert_print(*args, **kwargs):
                 values[key] = a.value
             else:
                 parts.append(esc(a))
-        jax.debug.print(esc(sep).join(parts), **values)
+        # a non-default `end` is appended (debug.print still emits its
+        # own trailing newline — device-side prints are line-based)
+        jax.debug.print(esc(sep).join(parts) + esc(end), **values)
         return
     print(*[a.numpy() if isinstance(a, Tensor) else a for a in args],
           **kwargs)
@@ -500,10 +511,12 @@ def range_continues(i, stop, step):
 
 def materialize_seq(it):
     """Normalize a for-iterable for the interrupt desugar: Tensors and
-    len()-able sequences pass through; one-shot iterables (zip,
-    generators, dict views) materialize to a list so the counter-while
-    can index them."""
-    if isinstance(it, Tensor) or hasattr(it, "__len__"):
+    integer-indexable sequences (list/tuple/range/str) pass through;
+    everything else (zip, generators, dict/set/dict-views, loaders)
+    materializes to a list — iteration ORDER semantics are preserved
+    (a dict materializes to its keys), and the counter-while can index
+    the result."""
+    if isinstance(it, Tensor) or isinstance(it, (list, tuple, range, str)):
         return it
     return list(it)
 

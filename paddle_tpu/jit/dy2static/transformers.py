@@ -410,9 +410,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
         for st in ast.walk(mod):
             ast.copy_location(st, node)
         # the caller visits the returned statements; hand back the list
-        out = []
-        for st in pre:
-            out.append(st)
+        out = list(pre)
         r = self.visit(w)
         out.extend(r if isinstance(r, list) else [r])
         return out

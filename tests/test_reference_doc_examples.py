@@ -243,3 +243,46 @@ def test_hapi_model_doc_examples(paddle_alias):
                 filter_fn=lambda b: "paddle.Model" in b
                 and "MNIST" not in b and "hub" not in b,
                 skip_if=("download", "flowers"), min_ran=1)
+
+
+def test_nn_common_layer_doc_examples(paddle_alias):
+    """nn/layer/common.py: all 18 layer examples (Linear/Upsample/Pad/
+    Dropout/Embedding/Unfold/Fold...) run verbatim."""
+    _run_blocks("nn/layer/common.py", paddle_alias, min_ran=15)
+
+
+def test_dataloader_from_generator_doc_example(paddle_alias):
+    """fluid/reader.py block 0 (dygraph from_generator workflow);
+    remaining blocks use the legacy paddle.fluid namespace (out of
+    scope, SURVEY §3) or the static pipe reader."""
+    _run_blocks("fluid/reader.py", paddle_alias,
+                filter_fn=lambda b: "fluid" not in b
+                and "from_generator" not in b and "from_dataset" not in b,
+                min_ran=1)
+
+
+def test_from_generator_api():
+    """DataLoader.from_generator: all three source setters (legacy fluid
+    reader.py API surface)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    loader = paddle.io.DataLoader.from_generator(capacity=10)
+
+    def reader():
+        for i in range(10):
+            yield np.full((4,), i, np.float32), np.array([i], np.int64)
+
+    loader.set_sample_generator(reader, batch_size=4)
+    batches = list(loader())
+    assert len(batches) == 2  # drop_last on the tail of 10
+    assert batches[0][0].shape == [4, 4]
+
+    loader2 = paddle.io.DataLoader.from_generator()
+
+    def breader():
+        for i in range(3):
+            yield (np.ones((2, 4), np.float32) * i,
+                   np.zeros((2, 1), np.int64))
+
+    loader2.set_batch_generator(breader)
+    assert len(list(loader2)) == 3
