@@ -101,7 +101,15 @@ def _run(block, extra=None):
         f.write(block)
     ns = {"__name__": "__main__", "__file__": path}
     ns.update(extra or {})
-    exec(compile(block, path, "exec"), ns)
+    # run from a FRESH per-block dir: reference examples write relative
+    # paths (e.g. hapi's model.save('checkpoint/test')) and must not
+    # dirty the repo working tree or leak artifacts between blocks
+    cwd = os.getcwd()
+    os.chdir(tempfile.mkdtemp(dir=_tmpdir))
+    try:
+        exec(compile(block, path, "exec"), ns)
+    finally:
+        os.chdir(cwd)
     return ns
 
 
@@ -139,11 +147,12 @@ def test_grad_scaler_doc_examples(paddle_alias):
     assert ran >= 5, f"only {ran} grad_scaler examples were runnable"
 
 
-def test_to_static_doc_examples(paddle_alias, tmp_path, monkeypatch):
+def test_to_static_doc_examples(paddle_alias):
     """fluid/dygraph/jit.py examples: to_static decoration, save, load.
     Blocks touching TranslatedLayer training or ProgramTranslator
-    internals are filtered to the save/load/core subset."""
-    monkeypatch.chdir(tmp_path)  # examples write model files to CWD
+    internals are filtered to the save/load/core subset. (_run execs
+    each block in its own fresh tmpdir, so save/load artifacts are
+    isolated per block.)"""
     blocks = _harvest("fluid/dygraph/jit.py")
     ran = 0
     for b in blocks:
