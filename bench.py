@@ -170,11 +170,15 @@ def _run():
 
 def _run_1p3b():
     """Child task (BENCH_TASK=1p3b): flagship-scale side metric (VERDICT
-    r3 #4) — GPT-1.3B on this one chip, scan + full remat, bf16 velocity
-    + stochastic rounding (master-weight-grade precision without the f32
-    copies; tests/test_stochastic_rounding.py). Runs in its OWN
-    subprocess so a congested compile can never starve the headline
-    metric (the parent already holds that line)."""
+    r3 #4) — GPT-1.3B on this one chip, bf16 velocity + stochastic
+    rounding (master-weight-grade precision without the f32 copies;
+    tests/test_stochastic_rounding.py). Round-4 sweep winner: scan +
+    SELECTIVE remat ("dots": save matmul outputs, recompute elementwise)
+    + the chunked vocab xent (fused_loss) — the chunked xent frees the
+    [B*T, V] logits, which is exactly what lets the "dots" policy fit
+    on the 16 GB chip (full remat: 11.0k tok/s; this config: 11.9k,
+    +7.5%). Runs in its OWN subprocess so a congested compile can never
+    starve the headline metric (the parent already holds that line)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -187,7 +191,9 @@ def _run_1p3b():
     cfg13.max_position_embeddings = 1024
     cfg13.dropout = 0.0
     cfg13.scan_layers = True
-    cfg13.scan_remat = True
+    cfg13.scan_remat = os.environ.get("BENCH_1P3B_REMAT", "dots")
+    if cfg13.scan_remat in ("true", "false"):
+        cfg13.scan_remat = cfg13.scan_remat == "true"
     paddle.seed(0)
     m13 = GPTForCausalLM(cfg13)
     m13.bfloat16()
@@ -197,12 +203,16 @@ def _run_1p3b():
     o13._state_dtype = jnp.bfloat16
     n13 = sum(int(np.prod(p.shape)) for p in m13.parameters())
 
-    def loss_fn(logits, labels):
-        V = logits.shape[-1]
-        return nn.functional.cross_entropy(
-            logits.reshape([-1, V]), labels.reshape([-1]))
+    class _FusedLossWrapper(nn.Layer):
+        def __init__(self, lm):
+            super().__init__()
+            self.lm = lm
 
-    s13 = TrainStep(m13, loss_fn, o13)
+        def forward(self, ids, labels):
+            return self.lm.fused_loss(ids, labels, chunk=2048)
+
+    s13 = TrainStep(_FusedLossWrapper(m13), None, o13,
+                    model_returns_loss=True)
     rng = np.random.RandomState(0)
     ids13 = paddle.to_tensor(rng.randint(
         0, cfg13.vocab_size, size=(4, 1024)).astype(np.int32))
@@ -272,24 +282,35 @@ def main():
             if result.get("value", 0) > 0 and result.get("on_tpu") and \
                     os.environ.get("BENCH_1P3B", "1") == "1":
                 b13 = int(os.environ.get("BENCH_1P3B_TIMEOUT", "600"))
-                env13 = dict(os.environ)
-                env13["BENCH_CHILD"] = "1"
-                env13["BENCH_TASK"] = "1p3b"
-                try:
-                    p13 = subprocess.run(
-                        [sys.executable, os.path.abspath(__file__)],
-                        env=env13, timeout=b13, capture_output=True)
-                    l13 = next((l for l in reversed(
-                        p13.stdout.decode(errors="replace").splitlines())
-                        if l.startswith("{")), None)
-                    if p13.returncode == 0 and l13:
-                        result.update(json.loads(l13))
-                    else:
+                # "dots" (the sweep winner) first; full remat as the
+                # fallback — its compile is more robust when the remote
+                # compile helper is congested (observed 2026-07-31:
+                # the identical dots config compiled in 118 s at one
+                # hour and hung >12 min the next)
+                for remat13 in ("dots", "true"):
+                    env13 = dict(os.environ)
+                    env13["BENCH_CHILD"] = "1"
+                    env13["BENCH_TASK"] = "1p3b"
+                    env13.setdefault("BENCH_1P3B_REMAT", remat13)
+                    try:
+                        p13 = subprocess.run(
+                            [sys.executable, os.path.abspath(__file__)],
+                            env=env13, timeout=b13, capture_output=True)
+                        l13 = next((l for l in reversed(
+                            p13.stdout.decode(errors="replace")
+                            .splitlines()) if l.startswith("{")), None)
+                        if p13.returncode == 0 and l13:
+                            result.update(json.loads(l13))
+                            result.pop("gpt_1p3b_error", None)
+                            break
                         result["gpt_1p3b_error"] = (
                             l13 or p13.stderr.decode(
                                 errors="replace")[-200:])[:300]
-                except subprocess.TimeoutExpired:
-                    result["gpt_1p3b_error"] = f"timeout {b13}s"
+                    except subprocess.TimeoutExpired:
+                        result["gpt_1p3b_error"] = \
+                            f"timeout {b13}s (remat={remat13})"
+                    if "BENCH_1P3B_REMAT" in os.environ:
+                        break  # pinned by the operator: no fallback
             result.setdefault("gpt_1p3b_tokens_per_sec", 0.0)
             result.setdefault("gpt_1p3b_mfu", 0.0)
             print(json.dumps(result))

@@ -19,9 +19,13 @@ Measured on a v5e-class chip (seq 1024):
   batch 4 (f32 vel):  336 ms/step, 12.2k tokens/s (~49% nominal MFU)
   batch 8 (bf16 vel):  fits (11.9k tok/s) — remat recompute keeps
                        batch 4 the best operating point
-Selective remat ('dots'/'names') and unrolled blocks were also swept at
-this size: all OOM with f32 state or exceed 15-minute XLA compiles —
-scan + full remat is the single-chip sweet spot.
+Round-4 re-sweep with the chunked vocab xent (fused_loss): freeing the
+[B*T, V] logits lets scan + SELECTIVE remat ('dots' — save matmul
+outputs, recompute elementwise only) fit where it previously OOMed:
+  batch 4, full remat, fused loss: 371 ms/step, 11.0k tok/s
+  batch 4, 'dots' remat, fused loss: 345 ms/step, 11.9k tok/s  <- best
+  batch 8 (either remat): exceeds the 15-min compile budget
+bench.py's 1p3b child runs the winner (BENCH_1P3B_REMAT overrides).
 """
 import json
 import time

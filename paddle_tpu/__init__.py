@@ -113,6 +113,17 @@ class NPUPlace(CUDAPlace):
 class TPUPlace(CUDAPlace):
     pass
 
+
+def _memcpy(x, place=None):
+    """Copy a tensor, optionally "to" a place. XLA manages device
+    residency, so every place maps to a plain copy; a CPUPlace target
+    forces a host round-trip like the reference's memcpy op
+    (tensor/creation.py _memcpy doc example)."""
+    if isinstance(place, CPUPlace) and not isinstance(place, CUDAPlace):
+        return to_tensor(x.numpy())
+    return x.clone()
+
+
 # paddle.disable_static / enable_static (dygraph is the default, like 2.x)
 _static_mode = [False]
 
@@ -172,11 +183,20 @@ def set_flags(flags):
             set_nan_inf_check(True if v else False)
 
 
-def set_printoptions(**kwargs):
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None, **kwargs):
+    """Reference signature (tensor/to_string.py set_printoptions):
+    positional precision/threshold/edgeitems; sci_mode maps to numpy's
+    suppress flag."""
     import numpy as np
-    np.set_printoptions(**{k: v for k, v in kwargs.items()
-                           if k in ("precision", "threshold", "edgeitems",
-                                    "linewidth")})
+    opts = dict(precision=precision, threshold=threshold,
+                edgeitems=edgeitems, linewidth=linewidth)
+    opts.update({k: v for k, v in kwargs.items()
+                 if k in ("precision", "threshold", "edgeitems",
+                          "linewidth")})
+    np.set_printoptions(**{k: v for k, v in opts.items() if v is not None})
+    if sci_mode is not None:
+        np.set_printoptions(suppress=not sci_mode)
 
 
 def batch(reader, batch_size, drop_last=False):

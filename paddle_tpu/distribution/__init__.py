@@ -103,45 +103,62 @@ class Uniform(Distribution):
         lp = -jnp.log(self.high - self.low)
         return Tensor(jnp.where(inside, lp, -jnp.inf))
 
+    def probs(self, value):
+        """Density at `value` (reference uniform.py probs)."""
+        return self.prob(value)
+
     def entropy(self):
         return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
                                        self.batch_shape))
 
 
 class Categorical(Distribution):
+    """Reference contract (categorical.py), mirrored exactly — it is
+    deliberately split-brained about the constructor argument:
+    `probs(value)`/`log_prob(value)` treat it as unnormalized
+    probability WEIGHTS (divide by the sum; the probs doc example's
+    expected values pin this down), while `sample`/`entropy`/
+    `kl_divergence` treat it as LOGITS (softmax via _logits_to_probs
+    in sample, max-shift + exp/z in entropy/kl). We store the raw
+    input, like the reference's self.logits."""
+
     def __init__(self, logits=None, probs=None, name=None):
-        if logits is not None and probs is None:
-            arr = _arr(logits)
-            self.logits = arr - jax.scipy.special.logsumexp(
-                arr, -1, keepdims=True)
-        else:
-            p = _arr(probs if probs is not None else logits)
-            p = p / jnp.sum(p, -1, keepdims=True)
-            self.logits = jnp.log(jnp.maximum(p, 1e-38))
+        self.logits = _arr(logits if logits is not None else probs)
         super().__init__(self.logits.shape[:-1])
 
-    @property
-    def probs(self):
-        return Tensor(jnp.exp(self.logits))
+    def probs(self, value):
+        """Probability of the selected category indices: weights/sum
+        (a METHOD taking `value`; for a single 1-D distribution the
+        result has value's shape)."""
+        w = self.logits / jnp.sum(self.logits, -1, keepdims=True)
+        idx = _arr(value).astype(jnp.int32)
+        if not self.batch_shape:  # one distribution: index categories
+            return Tensor(w[idx])
+        return Tensor(jnp.take_along_axis(w, idx[..., None], -1)[..., 0])
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(self.probs(value).value))
 
     def sample(self, shape=()):
+        # jax.random.categorical samples ∝ exp(logit) — exactly the
+        # reference's multinomial(softmax(logits)) path
         shape = tuple(shape)
         out = jax.random.categorical(split_key(), self.logits,
                                      shape=shape + self.batch_shape)
         return Tensor(out.astype(jnp.int64))
 
-    def log_prob(self, value):
-        idx = _arr(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(
-            self.logits, idx[..., None], -1)[..., 0])
+    def _log_softmax(self):
+        return self.logits - jax.scipy.special.logsumexp(
+            self.logits, -1, keepdims=True)
 
     def entropy(self):
-        p = jnp.exp(self.logits)
-        return Tensor(-jnp.sum(p * self.logits, -1))
+        lp = self._log_softmax()
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, -1))
 
     def kl_divergence(self, other):
-        p = jnp.exp(self.logits)
-        return Tensor(jnp.sum(p * (self.logits - other.logits), -1))
+        lp = self._log_softmax()
+        lq = other._log_softmax()
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
 
 
 class Beta(Distribution):
@@ -154,6 +171,11 @@ class Beta(Distribution):
     @property
     def mean(self):
         return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s ** 2 * (s + 1)))
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape

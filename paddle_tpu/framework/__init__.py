@@ -7,3 +7,15 @@ from .dtype import (dtype, float16, bfloat16, float32, float64, int8, int16,
                     iinfo, finfo)
 from .random import seed, get_rng_state, set_rng_state, rng_scope, split_key
 from . import io
+
+
+def __getattr__(name):
+    # the reference re-exports Places + mode helpers at paddle.framework
+    # (python/paddle/framework/__init__.py); resolve them lazily to
+    # avoid a circular import with the package root
+    if name in ("CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "NPUPlace",
+                "in_dygraph_mode", "in_dynamic_mode", "get_flags",
+                "set_flags"):
+        import paddle_tpu
+        return getattr(paddle_tpu, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -159,9 +159,37 @@ class Tensor:
             self._slot = _Slot(arr)
         self._slot.tensor_ref = weakref.ref(self)
         self.stop_gradient = stop_gradient
-        self.name = name
+        self._name = name
+        if name is not None:
+            self._register_name()
         self.grad = None
         self._retain_grad = False
+
+    _name_counter = [0]
+    _name_registry = None  # weak name -> Tensor map, built on demand
+
+    @property
+    def name(self):
+        """Reference tensors always carry a name (auto-generated when
+        not user-set) — static doc examples fetch by `z.name`. Generate
+        lazily so eager tensors stay cheap; generated/assigned names go
+        in a weak registry so Executor.run can fetch by name."""
+        if self._name is None:
+            Tensor._name_counter[0] += 1
+            self._name = f"generated_tensor_{Tensor._name_counter[0]}"
+            self._register_name()
+        return self._name
+
+    @name.setter
+    def name(self, value):
+        self._name = value
+        if value is not None:
+            self._register_name()
+
+    def _register_name(self):
+        if Tensor._name_registry is None:
+            Tensor._name_registry = weakref.WeakValueDictionary()
+        Tensor._name_registry[self._name] = self
 
     # -- value plumbing -------------------------------------------------
     @property
@@ -277,6 +305,11 @@ class Tensor:
             self._grad_hooks = []
         self._grad_hooks.append(hook)
         return TensorHookRemoveHelper(self, hook)
+
+    def get_value(self, scope=None):
+        """Reference Variable.get_value parity (framework/io.py doc
+        example: `var.get_value()` then `paddle.save(tensor, ...)`)."""
+        return self
 
     # -- mutation (functional under the hood) ---------------------------
     def set_value(self, value):

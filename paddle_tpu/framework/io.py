@@ -55,6 +55,10 @@ class _TensorPayload:
 
 
 def save(obj, path, protocol=_PROTO, **configs):
+    if hasattr(path, "write"):  # file-like target (framework/io.py
+        # doc example 5 saves into a BytesIO)
+        pickle.dump(_to_serializable(obj), path, protocol=protocol)
+        return
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -63,6 +67,9 @@ def save(obj, path, protocol=_PROTO, **configs):
 
 
 def load(path, **configs):
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    if hasattr(path, "read"):
+        obj = pickle.load(path)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
     return _from_serializable(obj, configs.get("return_numpy", False))

@@ -34,6 +34,39 @@ class InputSpec:
         self.dtype = dtype
         self.name = name
 
+    def __repr__(self):
+        return (f"InputSpec(shape={list(self.shape)}, "
+                f"dtype={self.dtype}, name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        """Describe an existing Tensor (static/input.py from_tensor)."""
+        return cls(tuple(tensor.shape), str(np.dtype(tensor.dtype)),
+                   name or getattr(tensor, "name", None))
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        """Insert batch_size in front of shape, in place."""
+        if isinstance(batch_size, (list, tuple)):
+            if len(batch_size) != 1:
+                raise ValueError(
+                    f"Length of batch_size: {batch_size} shall be 1, "
+                    f"but received {len(batch_size)}.")
+            batch_size = batch_size[0]
+        self.shape = (int(batch_size),) + self.shape
+        return self
+
+    def unbatch(self):
+        """Drop the leading dim of shape, in place."""
+        if not self.shape:
+            raise ValueError(
+                "Not support to unbatch a InputSpec when len(shape) == 0.")
+        self.shape = self.shape[1:]
+        return self
+
     _sym_counter = [0]
 
     def to_shape_dtype(self):

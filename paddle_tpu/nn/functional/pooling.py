@@ -209,15 +209,68 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", "avg")
 
 
+def _adaptive_max_pool_mask(x, output_size, n):
+    """Adaptive max pool that ALSO returns argmax indices, flattened
+    over the input's spatial dims (the reference's return_mask=True
+    contract, nn/functional/pooling.py adaptive_max_pool1d/2d/3d).
+    Built per-output-bin: bins are static slices, so XLA sees a fixed
+    unrolled graph (return_mask sizes are small in practice)."""
+    import itertools
+    if not isinstance(output_size, (list, tuple)):
+        output_size = [output_size] * n
+    out_sz0 = [int(v) if v is not None else None for v in output_size]
+
+    def fn(a):
+        lead = a.ndim - n
+        in_sz = [a.shape[lead + k] for k in range(n)]
+        # None = keep the input size on that axis (same contract as
+        # _adaptive_pool and the reference's adaptive_max_pool2d)
+        out_sz = [in_sz[k] if out_sz0[k] is None else out_sz0[k]
+                  for k in range(n)]
+        outs, idxs = [], []
+        for combo in itertools.product(*[range(t) for t in out_sz]):
+            sl = [slice(None)] * a.ndim
+            starts, lsizes = [], []
+            for k in range(n):
+                st = (combo[k] * in_sz[k]) // out_sz[k]
+                en = ((combo[k] + 1) * in_sz[k] + out_sz[k] - 1) // out_sz[k]
+                sl[lead + k] = slice(st, en)
+                starts.append(st)
+                lsizes.append(en - st)
+            seg = a[tuple(sl)].reshape(a.shape[:lead] + (-1,))
+            outs.append(jnp.max(seg, axis=-1))
+            am = jnp.argmax(seg, axis=-1)
+            coords, rem = [], am
+            for lsz in reversed(lsizes):
+                coords.append(rem % lsz)
+                rem = rem // lsz
+            coords = coords[::-1]
+            flat = jnp.zeros_like(am)
+            for k in range(n):
+                flat = flat * in_sz[k] + (coords[k] + starts[k])
+            idxs.append(flat)
+        shape = a.shape[:lead] + tuple(out_sz)
+        out = jnp.stack(outs, axis=-1).reshape(shape).astype(a.dtype)
+        idx = jnp.stack(idxs, axis=-1).reshape(shape).astype(jnp.int32)
+        return out, idx
+    return apply_op(fn, x)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_mask(x, output_size, 1)
     return _adaptive_pool(x, output_size, 1, False, "max")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_mask(x, output_size, 2)
     return _adaptive_pool(x, output_size, 2, False, "max")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool_mask(x, output_size, 3)
     return _adaptive_pool(x, output_size, 3, False, "max")
 
 

@@ -77,17 +77,17 @@ class AdaptiveAvgPool3D(_AdaptivePoolNd):
 
 class AdaptiveMaxPool1D(_AdaptivePoolNd):
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self.output_size)
+        return F.adaptive_max_pool1d(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveMaxPool2D(_AdaptivePoolNd):
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size, **self.kwargs)
 
 
 class AdaptiveMaxPool3D(_AdaptivePoolNd):
     def forward(self, x):
-        return F.adaptive_max_pool3d(x, self.output_size)
+        return F.adaptive_max_pool3d(x, self.output_size, **self.kwargs)
 
 
 class MaxUnPool2D(Layer):

@@ -161,6 +161,25 @@ class Optimizer:
         self.step()
         return None, [(p, p.grad) for p in self._parameters]
 
+    def backward(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None, callbacks=None):
+        """First half of the reference's split minimize (optimizer.py
+        Optimizer.backward doc example): run autograd, return the
+        (param, grad) pairs for a later apply_gradients call."""
+        loss.backward()
+        params = parameters if parameters is not None else self._parameters
+        return [(p, p.grad) for p in params
+                if p.grad is not None and p.trainable]
+
+    def apply_gradients(self, params_grads):
+        """Apply pre-computed (param, grad) pairs (reference
+        optimizer.py apply_gradients): grads land on the params, then
+        the normal step() path (regularizer, clip, state) runs."""
+        from ..framework.core import Tensor as _T
+        for p, g in params_grads:
+            p.grad = g if isinstance(g, _T) or g is None else _T(g)
+        self.step()
+
     # -- state dict ----------------------------------------------------
     def state_dict(self):
         out = {"step": self._step_count}
@@ -396,7 +415,10 @@ class Adamax(Optimizer):
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          False, name)
-        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # betas may arrive as Tensors (reference adamax.py doc example)
+        self._beta1 = float(beta1) if hasattr(beta1, "numpy") else beta1
+        self._beta2 = float(beta2) if hasattr(beta2, "numpy") else beta2
+        self._epsilon = epsilon
 
     def _init_state(self, v):
         return (self._f32_zeros(v), self._f32_zeros(v))
@@ -482,7 +504,9 @@ class Lamb(Optimizer):
         super().__init__(learning_rate, parameters, None, grad_clip, False,
                          name)
         self._wd = lamb_weight_decay
-        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._beta1 = float(beta1) if hasattr(beta1, "numpy") else beta1
+        self._beta2 = float(beta2) if hasattr(beta2, "numpy") else beta2
+        self._epsilon = epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def _init_state(self, v):

@@ -67,12 +67,58 @@ class Program:
     def global_block(self):
         return self
 
+    def list_vars(self):
+        """All variables in the program: feed placeholders plus the
+        parameters created by static.nn layers (reference
+        framework/io.py doc example iterates these to pick a weight)."""
+        return list(self.placeholders.values()) + list(self._params)
+
+    def state_dict(self, mode="all", scope=None):
+        """Name → value for the program's parameters (reference
+        static Program.state_dict; mode 'param'/'opt'/'all' — optimizer
+        state lives in the Optimizer here, so 'opt' returns empty)."""
+        if mode == "opt":
+            return {}
+        return {p.name: p for p in self._params}
+
+    def set_state_dict(self, state_dict, scope=None):
+        by_name = {p.name: p for p in self._params}
+        for k, v in state_dict.items():
+            if k in by_name:
+                by_name[k].set_value(
+                    v.value if hasattr(v, "value") else v)
+
     def clone(self, for_test=False):
         import copy
         return self
 
     def set_builder(self, fn):
         self._builder = fn
+
+    # -- pickling (paddle.save(program, path)) -------------------------
+    # The reference serializes a ProgramDesc proto; our Program is a
+    # recorded trace whose build closures can't pickle. What round-trips
+    # is the program's DATA: feed specs + parameter values. Builders and
+    # train hooks are rebuilt by re-running the user's construction code.
+    def __getstate__(self):
+        import numpy as _np
+        return {
+            "placeholders": [(v.name, v.spec_shape,
+                              str(_np.dtype(v.dtype)))
+                             for v in self.placeholders.values()],
+            "params": [(p.name, _np.asarray(p.value))
+                       for p in self._params],
+            "random_seed": self.random_seed,
+        }
+
+    def __setstate__(self, st):
+        from ..framework.core import Parameter
+        self.__init__()
+        self.random_seed = st.get("random_seed", 0)
+        for name, spec_shape, dt in st.get("placeholders", []):
+            self.placeholders[name] = Variable(name, spec_shape, dt)
+        for name, arr in st.get("params", []):
+            self._params.append(Parameter(arr, name=name))
 
 
 _program_stack = [Program()]
@@ -204,6 +250,9 @@ class Executor:
                 resolved.append(e)
             elif isinstance(e, str) and e in program.placeholders:
                 resolved.append(program.placeholders[e])
+            elif isinstance(e, str) and Tensor._name_registry is not None \
+                    and e in Tensor._name_registry:
+                resolved.append(Tensor._name_registry[e])
             elif e is None and unnamed_i < len(program.outputs):
                 resolved.append(program.outputs[unnamed_i])
                 unnamed_i += 1

@@ -49,7 +49,8 @@ _METHOD_NAMES = [
     # inplace math
     "add_", "subtract_", "multiply_", "scale_", "clip_", "ceil_", "floor_",
     "round_", "exp_", "sqrt_", "rsqrt_", "reciprocal_", "tanh_", "zero_",
-    "fill_", "fill_diagonal_", "uniform_", "bernoulli_", "exponential_",
+    "fill_", "fill_diagonal_", "fill_diagonal_tensor",
+    "fill_diagonal_tensor_", "uniform_", "bernoulli_", "exponential_",
     # linalg
     "matmul", "dot", "bmm", "mv", "mm", "cross", "norm", "dist", "cholesky",
     "qr", "svd", "eig", "eigvals", "inv", "pinv", "solve", "lstsq",

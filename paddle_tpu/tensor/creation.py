@@ -15,7 +15,7 @@ __all__ = [
 
 def _shape(shape):
     if isinstance(shape, Tensor):
-        return tuple(int(v) for v in shape.numpy())
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
@@ -39,6 +39,10 @@ def ones(shape, dtype=None, name=None):
 def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
+    if isinstance(fill_value, str):
+        # reference accepts string fill_values (creation.py full doc
+        # example passes fill_value="0.5")
+        fill_value = float(fill_value)
     if dtype is None:
         if isinstance(fill_value, bool):
             dtype = np.bool_

@@ -18,12 +18,18 @@ def _axes(a):
 
 def _static_shape(shape):
     if isinstance(shape, Tensor):
-        return tuple(int(v) for v in shape.numpy())
+        arr = shape.numpy().reshape(-1)  # 0-d shape tensor = one dim
+        return tuple(int(v) for v in arr)
     return tuple(int(s.item() if isinstance(s, Tensor) else s) for s in shape)
 
 
 def reshape(x, shape, name=None):
     shape = _static_shape(shape)
+    # reference semantics (tensor/manipulation.py reshape): a 0 in
+    # `shape` copies the dimension from the input at the same position
+    if 0 in shape:
+        shape = tuple(x.shape[i] if s == 0 else s
+                      for i, s in enumerate(shape))
     return apply_op(lambda a: jnp.reshape(a, shape), x)
 
 
@@ -130,8 +136,11 @@ def expand_as(x, y, name=None):
     return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
 
 
-def broadcast_tensors(inputs, name=None):
-    outs = apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs)
+def broadcast_tensors(input=None, name=None, inputs=None):
+    # reference signature names the list `input`; accept the older
+    # positional `inputs` spelling too
+    tensors = input if input is not None else inputs
+    outs = apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *tensors)
     return list(outs)
 
 
@@ -349,14 +358,29 @@ def as_real(x, name=None):
 
 
 def tensordot(x, y, axes=2, name=None):
+    """Reference semantics (tensor/manipulation.py tensordot): an int
+    contracts the last n axes of x with the first n of y; a flat list
+    contracts the SAME axes on both operands; a pair of lists applies
+    the first to x and the second to y, with the shorter list extended
+    by the tail of the longer one (axes expansion), and an empty second
+    list meaning "same as the first"."""
     ax = axes
     if isinstance(ax, Tensor):
         ax = ax.tolist()
-    if isinstance(ax, (list, tuple)):
-        ax = tuple(tuple(a.tolist() if isinstance(a, Tensor) else a)
-                   if isinstance(a, (list, tuple, Tensor)) else a
-                   for a in ax)
-    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+    if isinstance(ax, int):
+        return apply_op(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+    ax = [a.tolist() if isinstance(a, Tensor) else a for a in ax]
+    if ax and not isinstance(ax[0], (list, tuple)):
+        xa = ya = [int(v) for v in ax]  # flat list: same axes both sides
+    else:
+        xa = [int(v) for v in (ax[0] if len(ax) >= 1 else [])]
+        ya = [int(v) for v in (ax[1] if len(ax) >= 2 else [])]
+        if len(xa) < len(ya):
+            xa = xa + ya[len(xa):]
+        elif len(ya) < len(xa):
+            ya = ya + xa[len(ya):]
+    return apply_op(
+        lambda a, b: jnp.tensordot(a, b, axes=(tuple(xa), tuple(ya))), x, y)
 
 
 def slice(input, axes, starts, ends):
@@ -407,6 +431,36 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
         c = i + (offset if offset > 0 else 0)
         return a.at[..., r, c].set(value)
     x._bind(apply_op(fn, x)._slot)
+    return x
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Fill the (dim1, dim2) diagonal of x with tensor y. y's shape is
+    x's shape with dim1/dim2 removed and the diagonal length appended
+    (for 2-d x, just [diag_len]). Parity: reference
+    tensor/manipulation.py fill_diagonal_tensor."""
+    def fn(a, b):
+        nd = a.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        # move the diagonal plane to the last two axes
+        rest = [i for i in range(nd) if i not in (d1, d2)]
+        perm = rest + [d1, d2]
+        ap = jnp.transpose(a, perm)
+        h, w = ap.shape[-2], ap.shape[-1]
+        n = min(h + min(offset, 0), w - max(offset, 0))
+        i = jnp.arange(n)
+        r = i + (-offset if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        out = ap.at[..., r, c].set(b.astype(a.dtype))
+        inv = [0] * nd
+        for pos, axis in enumerate(perm):
+            inv[axis] = pos
+        return jnp.transpose(out, inv)
+    return apply_op(fn, x, y)
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    x._bind(fill_diagonal_tensor(x, y, offset, dim1, dim2)._slot)
     return x
 
 

@@ -1,5 +1,6 @@
 """paddle.vision.transforms. Parity: python/paddle/vision/transforms/.
 Numpy/HWC-based functional + class transforms (CHW output via ToTensor)."""
+import collections.abc
 import numbers
 import random
 
@@ -191,11 +192,38 @@ def erase(img, i, j, h, w, v, inplace=False):
 
 # ---------------- class transforms ----------------
 class BaseTransform:
+    """Reference protocol (vision/transforms/transforms.py
+    BaseTransform): multi-field transforms dispatch per `keys` entry to
+    `_apply_<key>`, with `self.params = self._get_params(inputs)` set
+    before the per-key application so custom subclasses can share
+    randomness across fields (the CustomRandomFlip doc example)."""
+
     def __init__(self, keys=None):
+        if keys is None:
+            keys = ("image",)
+        elif not isinstance(keys, collections.abc.Sequence):
+            raise ValueError(f"keys should be a sequence, got {keys!r}")
         self.keys = keys
 
+    def _get_params(self, inputs):
+        return None
+
     def __call__(self, inputs):
-        return self._apply_image(inputs)
+        if isinstance(inputs, tuple):
+            args = inputs
+        else:
+            args = (inputs,)
+        self.params = self._get_params(args)
+        outputs = []
+        for i in range(min(len(args), len(self.keys))):
+            apply_func = getattr(self, f"_apply_{self.keys[i]}",
+                                 None)
+            outputs.append(args[i] if apply_func is None
+                           else apply_func(args[i]))
+        outputs.extend(args[len(self.keys):])
+        if len(outputs) == 1:
+            return outputs[0]
+        return tuple(outputs)
 
     def _apply_image(self, img):
         raise NotImplementedError

@@ -1,0 +1,33 @@
+"""Functional image transforms as a real submodule.
+
+Parity: python/paddle/vision/transforms/functional.py — reference users
+write `import paddle.vision.transforms.functional as F` (the transforms.py
+doc examples do exactly this), so the functional API must resolve as a
+module, not just as names inside the package __init__.
+"""
+import numpy as np
+
+from . import (_hwc, to_tensor, resize, crop, center_crop, hflip, vflip,
+               pad, rotate, normalize, to_grayscale, adjust_brightness,
+               adjust_contrast, adjust_hue, erase)
+
+__all__ = ["to_tensor", "resize", "crop", "center_crop", "hflip", "vflip",
+           "pad", "rotate", "normalize", "to_grayscale",
+           "adjust_brightness", "adjust_contrast", "adjust_hue", "erase"]
+
+
+def _is_pil_image(img):
+    try:
+        from PIL import Image
+    except ImportError:
+        return False
+    return isinstance(img, Image.Image)
+
+
+def _is_numpy_image(img):
+    return isinstance(img, np.ndarray) and img.ndim in (2, 3)
+
+
+def _is_tensor_image(img):
+    from ...framework.core import Tensor
+    return isinstance(img, Tensor)

@@ -295,7 +295,9 @@ class TestDistribution:
 
     def test_categorical_uniform(self):
         from paddle_tpu.distribution import Categorical, Uniform
-        c = Categorical(logits=paddle.to_tensor([0.0, 0.0]))
+        # reference Categorical takes unnormalized probability WEIGHTS
+        # (categorical.py probs doc example), so uniform = equal weights
+        c = Categorical(logits=paddle.to_tensor([1.0, 1.0]))
         np.testing.assert_allclose(c.entropy().numpy(), np.log(2),
                                    rtol=1e-5)
         u = Uniform(0.0, 2.0)
