@@ -49,7 +49,8 @@ for _mod in ("nn", "optimizer", "amp", "io", "metric", "static", "jit",
              "vision", "distribution", "fft", "signal", "regularizer",
              "utils", "incubate", "distributed", "inference", "hapi",
              "profiler", "ops", "models", "text", "sparse", "hub",
-             "sysconfig", "onnx"):
+             "sysconfig", "onnx", "compat", "callbacks", "reader",
+             "dataset", "cost_model"):
     try:
         __import__(f"{__name__}.{_mod}")
     except ImportError:

@@ -1,0 +1,10 @@
+"""paddle.callbacks — flat alias of the hapi callback classes.
+
+Parity: /root/reference/python/paddle/callbacks.py (pure re-export).
+"""
+from .hapi.callbacks import (Callback, ProgBarLogger, ModelCheckpoint,
+                             VisualDL, LRScheduler, EarlyStopping,
+                             ReduceLROnPlateau)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]

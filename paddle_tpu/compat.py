@@ -1,0 +1,74 @@
+"""paddle.compat — py2/py3 text/bytes helpers kept for API parity.
+
+Parity: /root/reference/python/paddle/compat.py (to_text/to_bytes walk
+containers; round is banker's-free float rounding; floor_division and
+get_exception_message round out the surface).
+"""
+import math
+
+__all__ = []
+
+
+def _convert(obj, inplace, leaf):
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_convert(o, inplace, leaf) for o in obj]
+            return obj
+        return [_convert(o, inplace, leaf) for o in obj]
+    if isinstance(obj, set):
+        converted = {_convert(o, False, leaf) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(converted)
+            return obj
+        return converted
+    if isinstance(obj, dict):
+        converted = {_convert(k, False, leaf): _convert(v, False, leaf)
+                     for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(converted)
+            return obj
+        return converted
+    return leaf(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert bytes (possibly nested in list/set/dict) to str."""
+    def leaf(o):
+        if isinstance(o, bytes):
+            return o.decode(encoding)
+        return o
+    return _convert(obj, inplace, leaf)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str (possibly nested in list/set/dict) to bytes."""
+    def leaf(o):
+        if isinstance(o, str):
+            return o.encode(encoding)
+        return o
+    return _convert(obj, inplace, leaf)
+
+
+def round(x, d=0):
+    """Python-2-style half-away-from-zero rounding (python3's builtin
+    rounds half to even, which changes checkpoint-name hashing in old
+    user scripts)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    elif x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return 0.0
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    """Message text of an exception object."""
+    return str(exc)

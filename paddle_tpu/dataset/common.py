@@ -1,0 +1,96 @@
+"""paddle.dataset.common — DATA_HOME, file integrity, reader sharding.
+
+Parity: /root/reference/python/paddle/dataset/common.py. `download` is
+a zero-egress shim: it returns the path when the file is already on
+disk and raises a clear placement instruction otherwise (this
+environment has no network; see vision/datasets for the same contract).
+"""
+import errno
+import glob
+import hashlib
+import os
+import pickle
+
+__all__ = []
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def must_mkdirs(path):
+    try:
+        os.makedirs(path)
+    except OSError as exc:
+        if exc.errno != errno.EEXIST:
+            raise
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname,
+        url.split("/")[-1] if save_name is None else save_name)
+    if os.path.exists(filename) and (
+            not md5sum or md5file(filename) == md5sum):
+        return filename
+    raise FileNotFoundError(
+        f"{module_name}: no network access in this environment — place "
+        f"the official file from {url} at {filename} manually")
+
+
+def fetch_all():
+    raise NotImplementedError(
+        "fetch_all downloads every dataset; this environment is "
+        "zero-egress (see paddle_tpu.dataset.common.download)")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=pickle.dump):
+    """Split a reader's samples into chunked files of `line_count`
+    samples each; returns the written filenames."""
+    if not callable(reader):
+        raise TypeError("reader should be callable")
+    if not isinstance(line_count, int):
+        raise TypeError("line_count should be int")
+    import re
+    if not isinstance(suffix, str) or not re.search(r"%\d*d", suffix):
+        raise TypeError("suffix should be a str with a %d slot in it")
+    lines = []
+    indx_f = 0
+    written = []
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % indx_f, "wb") as f:
+                dumper(lines, f)
+                written.append(suffix % indx_f)
+                lines = []
+                indx_f += 1
+    if lines:
+        with open(suffix % indx_f, "wb") as f:
+            dumper(lines, f)
+            written.append(suffix % indx_f)
+    return written
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=pickle.load):
+    """Round-robin shard chunked files across trainers and replay their
+    samples."""
+    def reader():
+        file_list = glob.glob(files_pattern)
+        file_list.sort()
+        my_file_list = [f for i, f in enumerate(file_list)
+                        if i % trainer_count == trainer_id]
+        for fn in my_file_list:
+            with open(fn, "rb") as f:
+                for line in loader(f):
+                    yield line
+
+    return reader

@@ -1,0 +1,148 @@
+"""paddle.dataset.conll05 — CoNLL-2005 SRL test corpus, legacy reader
+API.
+
+Parity: /root/reference/python/paddle/dataset/conll05.py. The corpus
+tar holds gzipped words/props column files; props use the bracketed
+span notation ("(A0*", "*", "*)") which expands to BIO tags. Samples
+are the 9-column SRL feature tuple (word ids, 5 verb-context columns,
+predicate id, mark, label ids).
+"""
+import gzip
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = []
+
+UNK_IDX = 0
+
+_WORDDICT = "wordDict.txt"
+_VERBDICT = "verbDict.txt"
+_TRGDICT = "targetDict.txt"
+_EMB = "emb"
+_DATA = "conll05st-tests.tar.gz"
+
+
+def load_label_dict(filename):
+    """BIO label → id from a targetDict file listing B-*/I-* tags."""
+    tags = set()
+    with open(filename) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith(("B-", "I-")):
+                tags.add(line[2:])
+    d = {}
+    for tag in tags:
+        d["B-" + tag] = len(d)
+        d["I-" + tag] = len(d)
+    d["O"] = len(d)
+    return d
+
+
+def load_dict(filename):
+    with open(filename) as f:
+        return {line.strip(): i for i, line in enumerate(f)}
+
+
+def _expand_props(lbl):
+    """One predicate's bracket column → BIO sequence."""
+    out, cur, inside = [], "O", False
+    for l in lbl:
+        if l == "*":
+            out.append("I-" + cur if inside else "O")
+        elif l == "*)":
+            out.append("I-" + cur)
+            inside = False
+        elif "(" in l:
+            cur = l[1:l.find("*")]
+            out.append("B-" + cur)
+            inside = ")" not in l
+        else:
+            raise RuntimeError(f"Unexpected SRL label: {l}")
+    return out
+
+
+def corpus_reader(data_path, words_name, props_name):
+    """Yield (sentence words, predicate, BIO labels) per predicate."""
+    def reader():
+        with tarfile.open(data_path) as tf, \
+                gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wf, \
+                gzip.GzipFile(fileobj=tf.extractfile(props_name)) as pf:
+            sentence, columns = [], []
+            for word, prop in zip(wf, pf):
+                word = word.decode().strip()
+                prop = prop.decode().strip().split()
+                if not prop:  # blank line = end of sentence
+                    if columns:
+                        verbs = [v for v in (row[0] for row in columns)
+                                 if v != "-"]
+                        n_preds = len(columns[0]) - 1
+                        for i in range(n_preds):
+                            lbl = [row[i + 1] for row in columns]
+                            yield sentence, verbs[i], _expand_props(lbl)
+                    sentence, columns = [], []
+                else:
+                    sentence.append(word)
+                    columns.append(prop)
+
+    return reader
+
+
+def reader_creator(corpus_reader, word_dict=None, predicate_dict=None,
+                   label_dict=None):
+    def ctx_word(sentence, idx):
+        if idx < 0:
+            return "bos"
+        if idx >= len(sentence):
+            return "eos"
+        return sentence[idx]
+
+    def reader():
+        for sentence, predicate, labels in corpus_reader():
+            sen_len = len(sentence)
+            v = labels.index("B-V")
+            mark = [0] * sen_len
+            for off in (-2, -1, 0, 1, 2):
+                if 0 <= v + off < sen_len:
+                    mark[v + off] = 1
+            ctx = [ctx_word(sentence, v + off)
+                   for off in (-2, -1, 0, 1, 2)]
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctx_cols = [[word_dict.get(c, UNK_IDX)] * sen_len
+                        for c in ctx]
+            pred_idx = [predicate_dict.get(predicate)] * sen_len
+            label_idx = [label_dict.get(w) for w in labels]
+            yield (word_idx, ctx_cols[0], ctx_cols[1], ctx_cols[2],
+                   ctx_cols[3], ctx_cols[4], pred_idx, mark, label_idx)
+
+    return reader
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) from the local dict files."""
+    base = os.path.join(DATA_HOME, "conll05st")
+    word_dict = load_dict(os.path.join(base, _WORDDICT))
+    verb_dict = load_dict(os.path.join(base, _VERBDICT))
+    label_dict = load_label_dict(os.path.join(base, _TRGDICT))
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Path of the pre-trained embedding file."""
+    return os.path.join(DATA_HOME, "conll05st", _EMB)
+
+
+def test():
+    word_dict, verb_dict, label_dict = get_dict()
+    reader = corpus_reader(
+        os.path.join(DATA_HOME, "conll05st", _DATA),
+        words_name="conll05st-release/test.wsj/words/test.wsj.words.gz",
+        props_name="conll05st-release/test.wsj/props/test.wsj.props.gz")
+    return reader_creator(reader, word_dict, verb_dict, label_dict)
+
+
+def fetch():
+    from .common import download
+    download("http://paddlemodels.bj.bcebos.com/conll05st/"
+             "conll05st-tests.tar.gz", "conll05st", None)

@@ -1,0 +1,89 @@
+"""paddle.dataset.flowers — Oxford 102 Flowers, legacy reader API.
+
+Parity: /root/reference/python/paddle/dataset/flowers.py (102flowers.tgz
+of jpegs + imagelabels.mat + setid.mat; train uses the 'tstid' split,
+test 'trnid' — the reference's deliberate swap for more training data).
+"""
+import functools
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME
+from .image import load_image_bytes, simple_transform
+from ..reader import map_readers, xmap_readers
+
+__all__ = []
+
+TRAIN_FLAG = "tstid"
+TEST_FLAG = "trnid"
+VALID_FLAG = "valid"
+
+
+def _base():
+    return os.path.join(DATA_HOME, "flowers")
+
+
+def default_mapper(is_train, sample):
+    img, label = sample
+    img = load_image_bytes(img)
+    img = simple_transform(img, 256, 224, is_train,
+                           mean=[103.94, 116.78, 123.68])
+    return img.flatten().astype("float32"), label
+
+
+train_mapper = functools.partial(default_mapper, True)
+test_mapper = functools.partial(default_mapper, False)
+
+
+def reader_creator(data_file, label_file, setid_file, dataset_name,
+                   mapper, buffered_size=1024, use_xmap=True,
+                   cycle=False):
+    from scipy.io import loadmat
+    labels = loadmat(label_file)["labels"][0]
+    indexes = loadmat(setid_file)[dataset_name][0]
+
+    def reader():
+        while True:
+            with tarfile.open(data_file) as tf:
+                mems = {m.name: m for m in tf.getmembers() if m.isfile()}
+                for idx in indexes:
+                    name = f"jpg/image_{idx:05d}.jpg"
+                    img = tf.extractfile(mems[name]).read()
+                    yield img, int(labels[idx - 1]) - 1
+            if not cycle:
+                break
+
+    if use_xmap:
+        return xmap_readers(mapper, reader, min(4, os.cpu_count() or 1),
+                            buffered_size)
+    return map_readers(mapper, reader)
+
+
+def _make(flag, mapper, buffered_size, use_xmap, cycle=False):
+    return reader_creator(
+        os.path.join(_base(), "102flowers.tgz"),
+        os.path.join(_base(), "imagelabels.mat"),
+        os.path.join(_base(), "setid.mat"),
+        flag, mapper, buffered_size, use_xmap, cycle)
+
+
+def train(mapper=train_mapper, buffered_size=1024, use_xmap=True,
+          cycle=False):
+    return _make(TRAIN_FLAG, mapper, buffered_size, use_xmap, cycle)
+
+
+def test(mapper=test_mapper, buffered_size=1024, use_xmap=True,
+         cycle=False):
+    return _make(TEST_FLAG, mapper, buffered_size, use_xmap, cycle)
+
+
+def valid(mapper=test_mapper, buffered_size=1024, use_xmap=True):
+    return _make(VALID_FLAG, mapper, buffered_size, use_xmap)
+
+
+def fetch():
+    from .common import download
+    download("http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz",
+             "flowers", None)

@@ -1,0 +1,152 @@
+"""paddle.dataset.image — cv2-backed image utilities for the legacy
+reader pipelines.
+
+Parity: /root/reference/python/paddle/dataset/image.py (HWC uint8
+in-memory format, CHW conversion at the end of the pipeline).
+"""
+import tarfile
+
+import numpy as np
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - cv2 is baked into this image
+    cv2 = None
+
+__all__ = []
+
+
+def _check_cv2():
+    if cv2 is None:
+        raise ImportError(
+            "opencv-python is required for paddle.dataset.image")
+    return True
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pickle (image bytes, label) samples from a tar into batch files
+    next to the tar; returns the meta-file path."""
+    import pickle
+    import os
+    batch_dir = data_file + "_batch"
+    out_path = f"{batch_dir}/{dataset_name}"
+    meta_file = f"{batch_dir}/{dataset_name}_batch_master.txt"
+    if os.path.exists(out_path):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+    tf = tarfile.open(data_file)
+    mems = tf.getmembers()
+    data, labels = [], []
+    file_id = 0
+    names = []
+    for mem in mems:
+        if mem.name in img2label:
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                output = {"label": labels, "data": data}
+                name = f"{out_path}/batch_{file_id}"
+                with open(name, "wb") as f:
+                    pickle.dump(output, f, protocol=2)
+                names.append(name)
+                file_id += 1
+                data, labels = [], []
+    if data:
+        output = {"label": labels, "data": data}
+        name = f"{out_path}/batch_{file_id}"
+        with open(name, "wb") as f:
+            pickle.dump(output, f, protocol=2)
+        names.append(name)
+    with open(meta_file, "a") as meta:
+        for name in names:
+            meta.write(os.path.abspath(name) + "\n")
+    return meta_file
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode an encoded image buffer to an HWC (or HW) uint8 array."""
+    _check_cv2()
+    flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+    buf = np.frombuffer(bytes_, dtype="uint8")
+    return cv2.imdecode(buf, flag)
+
+
+def load_image(file, is_color=True):
+    _check_cv2()
+    flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+    return cv2.imread(file, flag)
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge becomes `size` (aspect preserved)."""
+    _check_cv2()
+    h, w = im.shape[:2]
+    if h > w:
+        h_new, w_new = size * h // w, size
+    else:
+        h_new, w_new = size, size * w // h
+    return cv2.resize(im, (w_new, h_new),
+                      interpolation=cv2.INTER_CUBIC)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = (h - size) // 2
+    w_start = (w - size) // 2
+    h_end, w_end = h_start + size, w_start + size
+    if is_color:
+        return im[h_start:h_end, w_start:w_end, :]
+    return im[h_start:h_end, w_start:w_end]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h_start = np.random.randint(0, h - size + 1)
+    w_start = np.random.randint(0, w - size + 1)
+    h_end, w_end = h_start + size, w_start + size
+    if is_color:
+        return im[h_start:h_end, w_start:w_end, :]
+    return im[h_start:h_end, w_start:w_end]
+
+
+def left_right_flip(im, is_color=True):
+    if len(im.shape) == 3 and is_color:
+        return im[:, ::-1, :]
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """resize_short → crop (random + flip when training) → CHW float32
+    → optional mean subtraction."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        elif mean.ndim == 1:
+            mean = mean
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    im = load_image(filename, is_color)
+    return simple_transform(im, resize_size, crop_size, is_train,
+                            is_color, mean)

@@ -1,0 +1,91 @@
+"""paddle.dataset.imdb — aclImdb sentiment corpus, legacy reader API.
+
+Parity: /root/reference/python/paddle/dataset/imdb.py (tar of
+aclImdb/{train,test}/{pos,neg}/*.txt; samples are ([word ids], 0|1)).
+"""
+import collections
+import os
+import re
+import string
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = []
+
+
+def _tar_path():
+    return os.path.join(DATA_HOME, "imdb", "aclImdb_v1.tar.gz")
+
+
+def tokenize(pattern):
+    """Lower-cased, punctuation-stripped token lists from tar members
+    whose names match `pattern`."""
+    with tarfile.open(_tar_path()) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                body = tarf.extractfile(tf).read().rstrip(b"\n\r")
+                body = body.translate(
+                    None, string.punctuation.encode("latin-1"))
+                yield body.lower().decode("latin-1").split()
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff):
+    """Word → id for words with frequency > cutoff, ordered by
+    (-freq, word); id len(dict) is reserved for <unk>."""
+    word_freq = collections.defaultdict(int)
+    for doc in tokenize(pattern):
+        for word in doc:
+            word_freq[word] += 1
+    word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+    dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary))
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx):
+    unk = word_idx["<unk>"]
+    all_samples = []
+
+    def load(pattern, label):
+        for doc in tokenize(pattern):
+            all_samples.append(
+                ([word_idx.get(w, unk) for w in doc], label))
+
+    def reader():
+        if not all_samples:
+            load(pos_pattern, 0)
+            load(neg_pattern, 1)
+        for sample in all_samples:
+            yield sample
+
+    return reader
+
+
+def train(word_idx):
+    """Training reader: ([word ids], 0 for positive / 1 for negative)."""
+    return reader_creator(
+        re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        re.compile(r"aclImdb/train/neg/.*\.txt$"), word_idx)
+
+
+def test(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/test/pos/.*\.txt$"),
+        re.compile(r"aclImdb/test/neg/.*\.txt$"), word_idx)
+
+
+def word_dict(cutoff=150):
+    """Dictionary over the whole corpus (train + test, pos + neg)."""
+    return build_dict(re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+                      cutoff)
+
+
+def fetch():
+    from .common import download
+    download("https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz",
+             "imdb", None)

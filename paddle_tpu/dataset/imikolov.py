@@ -1,0 +1,97 @@
+"""paddle.dataset.imikolov — PTB language-model corpus, legacy reader
+API.
+
+Parity: /root/reference/python/paddle/dataset/imikolov.py
+(simple-examples.tgz; NGRAM samples are n-tuples of word ids, SEQ
+samples are <s> ... <e> id lists).
+"""
+import collections
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = []
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+TRAIN_FILE = "./simple-examples/data/ptb.train.txt"
+TEST_FILE = "./simple-examples/data/ptb.valid.txt"
+
+
+def _tar_path():
+    return os.path.join(DATA_HOME, "imikolov", "simple-examples.tgz")
+
+
+def word_count(f, word_freq=None):
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for line in f:
+        for w in line.strip().split():
+            word_freq[w] += 1
+        word_freq["<s>"] += 1
+        word_freq["<e>"] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50):
+    """Word → id over the train corpus, frequency-ordered; <unk> gets
+    the last id."""
+    with tarfile.open(_tar_path()) as tf:
+        train_f = [l.decode() for l in tf.extractfile(TRAIN_FILE)]
+        test_f = [l.decode() for l in tf.extractfile(TEST_FILE)]
+        word_freq = word_count(test_f, word_count(train_f))
+        if "<unk>" in word_freq:
+            word_freq["<unk>"] = -1  # re-added below with the last id
+        word_freq = [x for x in word_freq.items()
+                     if x[1] > min_word_freq]
+        word_freq_sorted = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words, _ = list(zip(*word_freq_sorted))
+        word_idx = dict(list(zip(words, range(len(words)))))
+        word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(filename, word_idx, n, data_type):
+    def reader():
+        with tarfile.open(_tar_path()) as tf:
+            f = tf.extractfile(filename)
+            UNK = word_idx["<unk>"]
+            for line in f:
+                if DataType.NGRAM == data_type:
+                    assert n > -1, "Invalid gram length"
+                    line = ["<s>"] + line.decode().strip().split() + ["<e>"]
+                    if len(line) >= n:
+                        line = [word_idx.get(w, UNK) for w in line]
+                        for i in range(n, len(line) + 1):
+                            yield tuple(line[i - n:i])
+                elif DataType.SEQ == data_type:
+                    line = line.decode().strip().split()
+                    line = [word_idx.get(w, UNK) for w in line]
+                    src_seq = [word_idx["<s>"]] + line
+                    trg_seq = line + [word_idx["<e>"]]
+                    if n > 0 and len(src_seq) > n:
+                        continue
+                    yield src_seq, trg_seq
+                else:
+                    assert False, "Unknown data type"
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(TRAIN_FILE, word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(TEST_FILE, word_idx, n, data_type)
+
+
+def fetch():
+    from .common import download
+    download("https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz",
+             "imikolov", None)

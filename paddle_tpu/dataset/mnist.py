@@ -1,0 +1,38 @@
+"""paddle.dataset.mnist — legacy reader-creator API over the idx-gzip
+parser in paddle_tpu.vision.datasets.MNIST.
+
+Parity: /root/reference/python/paddle/dataset/mnist.py (samples are
+(float32[784] scaled to [-1, 1], int label)).
+"""
+import numpy as np
+
+from ..vision.datasets import MNIST
+
+__all__ = []
+
+
+def _reader_creator(mode):
+    def reader():
+        ds = MNIST(mode=mode)
+        images = ds.images.reshape(len(ds), -1).astype(np.float32)
+        images = images / 255.0 * 2.0 - 1.0
+        for img, label in zip(images, ds.labels):
+            yield img, int(label)
+
+    return reader
+
+
+def train():
+    """MNIST training set creator: 60k (image[784] in [-1,1], label)."""
+    return _reader_creator("train")
+
+
+def test():
+    """MNIST test set creator: 10k (image[784] in [-1,1], label)."""
+    return _reader_creator("test")
+
+
+def fetch():
+    from .common import download
+    download("https://dataset.bj.bcebos.com/mnist/train-images-idx3-ubyte.gz",
+             "mnist", None)

@@ -1,0 +1,162 @@
+"""paddle.dataset.movielens — MovieLens-1M ratings corpus, legacy
+reader API.
+
+Parity: /root/reference/python/paddle/dataset/movielens.py (ml-1m.zip
+with ::-separated movies/users/ratings .dat files; samples are
+user.value() + movie.value() + [[scaled rating]]).
+"""
+import functools
+import os
+import re
+import zipfile
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = []
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def _zip_path():
+    return os.path.join(DATA_HOME, "movielens", "ml-1m.zip")
+
+
+class MovieInfo:
+    """Movie id, title and categories."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        return [self.index,
+                [CATEGORIES_DICT[c] for c in self.categories],
+                [MOVIE_TITLE_DICT[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    """User id, gender, age bucket and job."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), "
+                f"gender({'M' if self.is_male else 'F'}), "
+                f"age({age_table[self.age]}), job({self.job_id})>")
+
+
+MOVIE_INFO = None
+MOVIE_TITLE_DICT = None
+CATEGORIES_DICT = None
+USER_INFO = None
+
+
+def __initialize_meta_info__():
+    global MOVIE_INFO, MOVIE_TITLE_DICT, CATEGORIES_DICT, USER_INFO
+    fn = _zip_path()
+    if not os.path.exists(fn):
+        raise FileNotFoundError(
+            f"movielens: no network access — place ml-1m.zip at {fn}")
+    if MOVIE_INFO is None:
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        MOVIE_INFO, title_words, categories = {}, set(), set()
+        with zipfile.ZipFile(fn) as package:
+            with package.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    movie_id, title, cats = line.decode(
+                        "latin").strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pattern.match(title).group(1)
+                    MOVIE_INFO[int(movie_id)] = MovieInfo(
+                        movie_id, cats, title)
+                    title_words.update(
+                        w.lower() for w in title.split())
+            MOVIE_TITLE_DICT = {w: i for i, w in enumerate(title_words)}
+            CATEGORIES_DICT = {c: i for i, c in enumerate(categories)}
+            USER_INFO = {}
+            with package.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode(
+                        "latin").strip().split("::")
+                    USER_INFO[int(uid)] = UserInfo(uid, gender, age, job)
+    return fn
+
+
+def __reader__(rand_seed=0, test_ratio=0.1, is_test=False):
+    fn = __initialize_meta_info__()
+    np.random.seed(rand_seed)
+    with zipfile.ZipFile(fn) as package:
+        with package.open("ml-1m/ratings.dat") as f:
+            for line in f:
+                if (np.random.random() < test_ratio) != is_test:
+                    continue
+                uid, mov_id, rating, _ = line.decode(
+                    "latin").strip().split("::")
+                rating = float(rating) * 2 - 5.0
+                yield (USER_INFO[int(uid)].value()
+                       + MOVIE_INFO[int(mov_id)].value() + [[rating]])
+
+
+def __reader_creator__(**kwargs):
+    return lambda: __reader__(**kwargs)
+
+
+train = functools.partial(__reader_creator__, is_test=False)
+test = functools.partial(__reader_creator__, is_test=True)
+
+
+def get_movie_title_dict():
+    __initialize_meta_info__()
+    return MOVIE_TITLE_DICT
+
+
+def max_movie_id():
+    __initialize_meta_info__()
+    return max(MOVIE_INFO.values(), key=lambda m: m.index).index
+
+
+def max_user_id():
+    __initialize_meta_info__()
+    return max(USER_INFO.values(), key=lambda u: u.index).index
+
+
+def max_job_id():
+    __initialize_meta_info__()
+    return max(USER_INFO.values(), key=lambda u: u.job_id).job_id
+
+
+def movie_categories():
+    __initialize_meta_info__()
+    return CATEGORIES_DICT
+
+
+def user_info():
+    __initialize_meta_info__()
+    return list(USER_INFO.values())
+
+
+def movie_info():
+    __initialize_meta_info__()
+    return list(MOVIE_INFO.values())
+
+
+def fetch():
+    from .common import download
+    download("https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip",
+             "movielens", None)

@@ -1,0 +1,48 @@
+"""paddle.dataset.uci_housing — legacy reader-creator API over
+paddle_tpu.text.UCIHousing.
+
+Parity: /root/reference/python/paddle/dataset/uci_housing.py.
+"""
+import numpy as np
+
+from ..text import UCIHousing
+
+__all__ = []
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+def _reader_creator(mode):
+    def reader():
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, np.float32), np.asarray(y, np.float32)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")
+
+
+def predict_reader():
+    """First 100 test samples, features only (inference feed)."""
+    def reader():
+        for i, (x, _) in enumerate(_reader_creator("test")()):
+            if i == 100:
+                break
+            yield (x,)
+
+    return reader
+
+
+def fetch():
+    from .common import download
+    download("http://paddlemodels.bj.bcebos.com/uci_housing/housing.data",
+             "uci_housing", None)

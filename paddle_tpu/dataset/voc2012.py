@@ -1,0 +1,58 @@
+"""paddle.dataset.voc2012 — Pascal VOC2012 segmentation corpus, legacy
+reader API.
+
+Parity: /root/reference/python/paddle/dataset/voc2012.py (VOCtrainval
+tar; samples are (jpeg image CHW uint8 array, segmentation label HW)).
+"""
+import io
+import os
+import tarfile
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = []
+
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def _tar_path():
+    return os.path.join(DATA_HOME, "voc2012",
+                        "VOCtrainval_11-May-2012.tar")
+
+
+def reader_creator(filename, sub_name):
+    def reader():
+        from PIL import Image
+        with tarfile.open(filename) as tf:
+            names = tf.extractfile(
+                SET_FILE.format(sub_name)).read().decode().split()
+            for name in names:
+                img = np.array(Image.open(io.BytesIO(
+                    tf.extractfile(DATA_FILE.format(name)).read())))
+                label = np.array(Image.open(io.BytesIO(
+                    tf.extractfile(LABEL_FILE.format(name)).read())))
+                yield img.transpose(2, 0, 1), label
+
+    return reader
+
+
+def train():
+    return reader_creator(_tar_path(), "trainval")
+
+
+def test():
+    return reader_creator(_tar_path(), "train")
+
+
+def val():
+    return reader_creator(_tar_path(), "val")
+
+
+def fetch():
+    from .common import download
+    download("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+             "VOCtrainval_11-May-2012.tar", "voc2012", None)

@@ -1,0 +1,88 @@
+"""paddle.dataset.wmt14 — WMT'14 en→fr MT corpus, legacy reader API.
+
+Parity: /root/reference/python/paddle/dataset/wmt14.py (tar with
+*src.dict / *trg.dict members and tab-separated parallel text; samples
+are (src_ids with <s>/<e>, trg_ids with <s>, trg_ids_next with <e>)).
+"""
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = []
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def _tar_path():
+    return os.path.join(DATA_HOME, "wmt14", "wmt14.tgz")
+
+
+def _read_dicts(tar_file, dict_size):
+    def to_dict(fd, size):
+        out = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            out[line.decode().strip()] = i
+        return out
+
+    with tarfile.open(tar_file) as f:
+        src_name = [m.name for m in f if m.name.endswith("src.dict")]
+        trg_name = [m.name for m in f if m.name.endswith("trg.dict")]
+        assert len(src_name) == 1 and len(trg_name) == 1
+        return (to_dict(f.extractfile(src_name[0]), dict_size),
+                to_dict(f.extractfile(trg_name[0]), dict_size))
+
+
+def reader_creator(tar_file, file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = _read_dicts(tar_file, dict_size)
+        with tarfile.open(tar_file) as f:
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [src_dict.get(w, UNK_IDX)
+                               for w in [START] + parts[0].split() + [END]]
+                    trg_ids = [trg_dict.get(w, UNK_IDX)
+                               for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    trg_ids_next = trg_ids + [trg_dict[END]]
+                    trg_ids = [trg_dict[START]] + trg_ids
+                    yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def train(dict_size):
+    return reader_creator(_tar_path(), "train/train", dict_size)
+
+
+def test(dict_size):
+    return reader_creator(_tar_path(), "test/test", dict_size)
+
+
+def gen(dict_size):
+    return reader_creator(_tar_path(), "gen/gen", dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); id→word when reverse (the default)."""
+    src_dict, trg_dict = _read_dicts(_tar_path(), dict_size)
+    if reverse:
+        src_dict = {v: k for k, v in src_dict.items()}
+        trg_dict = {v: k for k, v in trg_dict.items()}
+    return src_dict, trg_dict
+
+
+def fetch():
+    from .common import download
+    download("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz",
+             "wmt14", None)

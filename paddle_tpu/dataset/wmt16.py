@@ -1,0 +1,143 @@
+"""paddle.dataset.wmt16 — WMT'16 en↔de multimodal-task corpus, legacy
+reader API.
+
+Parity: /root/reference/python/paddle/dataset/wmt16.py (tar with
+wmt16/{train,test,val} tab-separated en\tde lines; dictionaries are
+built from corpus frequency on first use and cached under DATA_HOME).
+"""
+import collections
+import os
+import tarfile
+
+from .common import DATA_HOME, must_mkdirs
+
+__all__ = []
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+
+def _tar_path():
+    return os.path.join(DATA_HOME, "wmt16", "wmt16.tar.gz")
+
+
+def __build_dict(tar_file, dict_size, save_path, lang):
+    word_dict = collections.defaultdict(int)
+    col = 0 if lang == "en" else 1
+    with tarfile.open(tar_file) as f:
+        for line in f.extractfile("wmt16/train"):
+            parts = line.decode().strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for w in parts[col].split():
+                word_dict[w] += 1
+    with open(save_path, "w") as fout:
+        fout.write(f"{START_MARK}\n{END_MARK}\n{UNK_MARK}\n")
+        for idx, word in enumerate(
+                sorted(word_dict.items(), key=lambda x: x[1],
+                       reverse=True)):
+            if idx + 3 == dict_size:
+                break
+            fout.write(word[0] + "\n")
+
+
+def __load_dict(tar_file, dict_size, lang, reverse=False):
+    dict_path = os.path.join(DATA_HOME, "wmt16",
+                             f"{lang}_{dict_size}.dict")
+    dict_found = False
+    if os.path.exists(dict_path):
+        with open(dict_path) as d:
+            dict_found = len(d.readlines()) == dict_size
+    if not dict_found:
+        must_mkdirs(os.path.dirname(dict_path))
+        __build_dict(tar_file, dict_size, dict_path, lang)
+    word_dict = {}
+    with open(dict_path) as fdict:
+        for idx, line in enumerate(fdict):
+            if reverse:
+                word_dict[idx] = line.strip()
+            else:
+                word_dict[line.strip()] = idx
+    return word_dict
+
+
+def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
+    src_dict_size = min(src_dict_size, (TOTAL_EN_WORDS if src_lang == "en"
+                                        else TOTAL_DE_WORDS))
+    trg_dict_size = min(trg_dict_size, (TOTAL_DE_WORDS if src_lang == "en"
+                                        else TOTAL_EN_WORDS))
+    return src_dict_size, trg_dict_size
+
+
+def reader_creator(tar_file, file_name, src_dict_size, trg_dict_size,
+                   src_lang):
+    def reader():
+        src_dict = __load_dict(tar_file, src_dict_size, src_lang)
+        trg_dict = __load_dict(tar_file, trg_dict_size,
+                               "de" if src_lang == "en" else "en")
+        start_id = src_dict[START_MARK]
+        end_id = src_dict[END_MARK]
+        unk_id = src_dict[UNK_MARK]
+        src_col = 0 if src_lang == "en" else 1
+        trg_col = 1 - src_col
+        with tarfile.open(tar_file) as f:
+            for line in f.extractfile(file_name):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = ([start_id]
+                           + [src_dict.get(w, unk_id)
+                              for w in parts[src_col].split()]
+                           + [end_id])
+                trg_ids = [trg_dict.get(w, unk_id)
+                           for w in parts[trg_col].split()]
+                trg_ids_next = trg_ids + [end_id]
+                trg_ids = [start_id] + trg_ids
+                yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def _check_lang(src_lang):
+    if src_lang not in ("en", "de"):
+        raise ValueError('src_lang must be one of ["en", "de"]')
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    _check_lang(src_lang)
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+    return reader_creator(_tar_path(), "wmt16/train", src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    _check_lang(src_lang)
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+    return reader_creator(_tar_path(), "wmt16/test", src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    _check_lang(src_lang)
+    src_dict_size, trg_dict_size = __get_dict_size(
+        src_dict_size, trg_dict_size, src_lang)
+    return reader_creator(_tar_path(), "wmt16/val", src_dict_size,
+                          trg_dict_size, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    dict_size = min(dict_size, (TOTAL_EN_WORDS if lang == "en"
+                                else TOTAL_DE_WORDS))
+    return __load_dict(_tar_path(), dict_size, lang, reverse)
+
+
+def fetch():
+    from .common import download
+    download("http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz",
+             "wmt16", None)

@@ -1,0 +1,1 @@
+from .metric import sum, max, min, auc, mae, rmse, mse, acc  # noqa: F401,A004

@@ -1,0 +1,108 @@
+"""fleet.metrics — cross-worker metric aggregation.
+
+Parity: /root/reference/python/paddle/distributed/fleet/metrics/metric.py.
+Each helper all-reduces locally-accumulated statistics over the worker
+world via fleet.util (single-process worlds are the identity, matching
+the TPU single-controller SPMD model) and then finishes the metric
+math on the aggregate.
+"""
+import numpy as np
+
+__all__ = []
+
+
+def _resolve(value):
+    from ...fleet import util
+    from ....framework.core import Tensor
+    if isinstance(value, Tensor):
+        value = value.numpy()
+    return np.asarray(value), util
+
+
+def sum(input, scope=None, util=None):
+    """Distributed sum of a metric array."""
+    arr, u = _resolve(input)
+    u = util or u
+    return u.all_reduce(arr, "sum").reshape(arr.shape)
+
+
+def max(input, scope=None, util=None):
+    """Distributed elementwise max."""
+    arr, u = _resolve(input)
+    u = util or u
+    return u.all_reduce(arr, "max").reshape(arr.shape)
+
+
+def min(input, scope=None, util=None):
+    """Distributed elementwise min."""
+    arr, u = _resolve(input)
+    u = util or u
+    return u.all_reduce(arr, "min").reshape(arr.shape)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Distributed AUC from per-worker positive/negative score
+    histograms (the reference's streaming formulation)."""
+    pos, u = _resolve(stat_pos)
+    neg, _ = _resolve(stat_neg)
+    u = util or u
+    global_pos = u.all_reduce(pos.ravel(), "sum")
+    global_neg = u.all_reduce(neg.ravel(), "sum")
+    num_bucket = global_pos.shape[0]
+    area = 0.0
+    pos_cum = 0.0
+    neg_cum = 0.0
+    new_pos = 0.0
+    new_neg = 0.0
+    for i in range(num_bucket):
+        idx = num_bucket - 1 - i
+        new_pos = pos_cum + global_pos[idx]
+        new_neg = neg_cum + global_neg[idx]
+        area += (new_neg - neg_cum) * (pos_cum + new_pos) / 2
+        pos_cum = new_pos
+        neg_cum = new_neg
+    if pos_cum == 0 or neg_cum == 0:
+        return 0.5
+    return float(area / (pos_cum * neg_cum))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    """Distributed mean absolute error from (Σ|err|, N)."""
+    err, u = _resolve(abserr)
+    u = util or u
+    n = _as_count(total_ins_num, u)
+    global_err = float(u.all_reduce(err.ravel().sum(), "sum"))
+    return global_err / n
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    """Distributed root-mean-square error from (Σerr², N)."""
+    return float(np.sqrt(mse(sqrerr, total_ins_num, scope, util)))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    """Distributed mean squared error from (Σerr², N)."""
+    err, u = _resolve(sqrerr)
+    u = util or u
+    n = _as_count(total_ins_num, u)
+    global_err = float(u.all_reduce(err.ravel().sum(), "sum"))
+    return global_err / n
+
+
+def acc(correct, total, scope=None, util=None):
+    """Distributed accuracy from (correct, total) counts."""
+    c, u = _resolve(correct)
+    u = util or u
+    t = _as_count(total, u)
+    global_c = float(u.all_reduce(c.ravel().sum(), "sum"))
+    return global_c / t
+
+
+def _as_count(total, util):
+    arr = np.asarray(
+        total.numpy() if hasattr(total, "numpy") else total)
+    n = float(util.all_reduce(arr.ravel().sum(), "sum"))
+    if n == 0:
+        raise ZeroDivisionError(
+            "fleet.metrics: total instance count reduced to zero")
+    return n

@@ -1,1 +1,4 @@
 from .recompute import recompute, recompute_sequential, recompute_hybrid
+from .fs import (FS, LocalFS, HDFSClient, ExecuteError,
+                 FSFileExistsError, FSFileNotExistsError, FSTimeOut,
+                 FSShellCmdAborted)

@@ -1,0 +1,333 @@
+"""fleet.utils.fs — filesystem abstraction for checkpoint/data paths.
+
+Parity: /root/reference/python/paddle/distributed/fleet/utils/fs.py.
+LocalFS is fully functional (it backs sharded-checkpoint paths);
+HDFSClient shells out to the `hadoop` CLI exactly like the reference
+and degrades to a clear error when no hadoop binary is on PATH (TPU
+pods normally mount GCS via local paths instead).
+"""
+import os
+import shutil
+import subprocess
+
+__all__ = []
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem client (used by checkpoint save/load paths)."""
+
+    def ls_dir(self, fs_path):
+        """Returns (dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, f)):
+                dirs.append(f)
+            else:
+                files.append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), \
+            f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self._rm(fs_path)
+        return self._rmr(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        return self.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        """Directory names directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return []
+        return [f for f in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, f))]
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read().rstrip("\n")
+
+
+def _handle_errors(max_time_out=None):
+    """Retry decorator for flaky shell-backed operations."""
+    import functools
+    import time
+
+    def decorator(f):
+        @functools.wraps(f)
+        def handler(*args, **kwargs):
+            o = args[0]
+            time_out = max_time_out or o._time_out
+            inter = o._sleep_inter
+            start = time.time() * 1000
+            last_print_time = start
+            while True:
+                try:
+                    return f(*args, **kwargs)
+                except ExecuteError:
+                    now = time.time() * 1000
+                    if now - start > time_out:
+                        raise FSTimeOut(
+                            f"args:{args} timeout:{now - start}ms")
+                    time.sleep(inter / 1000.0)
+                    if now - last_print_time > 30000:
+                        print(f"hadoop operation retrying, args: "
+                              f"{args} elapsed: {now - start}ms")
+                        last_print_time = now
+
+        return handler
+
+    return decorator
+
+
+class HDFSClient(FS):
+    """HDFS client shelling to the hadoop CLI (reference behavior).
+
+    Raises a clear ExecuteError when no hadoop binary is available —
+    on TPU pods, mount the store (e.g. GCS fuse) and use LocalFS.
+    """
+
+    def __init__(self, hadoop_home, configs, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base_cmd = os.path.join(hadoop_home, "bin/hadoop")
+        if configs:
+            for k, v in configs.items():
+                self._base_cmd += f" -D{k}={v}"
+        self._time_out = time_out
+        self._sleep_inter = sleep_inter
+        self._bd_err_re = None
+
+    def _run_cmd(self, cmd, redirect_stderr=False):
+        full = f"{self._base_cmd} {cmd}"
+        proc = subprocess.run(
+            full, shell=True, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT if redirect_stderr else
+            subprocess.PIPE)
+        out = proc.stdout.decode(errors="replace").splitlines()
+        if proc.returncode != 0 and not os.path.exists(
+                self._base_cmd.split()[0]):
+            raise ExecuteError(
+                f"no hadoop binary at {self._base_cmd.split()[0]}; "
+                "HDFSClient needs a hadoop install (use LocalFS + a "
+                "mounted filesystem on TPU pods)")
+        return proc.returncode, out
+
+    @_handle_errors()
+    def is_exist(self, fs_path):
+        ret, _ = self._run_cmd(f"fs -test -e {fs_path}",
+                               redirect_stderr=True)
+        return ret == 0
+
+    @_handle_errors()
+    def is_dir(self, fs_path):
+        ret, _ = self._run_cmd(f"fs -test -d {fs_path}",
+                               redirect_stderr=True)
+        return ret == 0
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    @_handle_errors()
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        ret, lines = self._run_cmd(f"fs -ls {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"ls_dir {fs_path}")
+        dirs, files = [], []
+        for line in lines:
+            arr = line.split()
+            if len(arr) != 8:
+                continue
+            name = os.path.basename(arr[7])
+            if arr[0].startswith("d"):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        dirs, _ = self.ls_dir(fs_path)
+        return dirs
+
+    @_handle_errors()
+    def mkdirs(self, fs_path):
+        if self.is_exist(fs_path):
+            return
+        ret, _ = self._run_cmd(f"fs -mkdir -p {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"mkdirs {fs_path}")
+
+    @_handle_errors()
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        ret, _ = self._run_cmd(f"fs -rm -r {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"delete {fs_path}")
+
+    @_handle_errors()
+    def upload(self, local_path, fs_path):
+        if self.is_exist(fs_path):
+            raise FSFileExistsError(f"{fs_path} exists")
+        if not os.path.exists(local_path):
+            raise FSFileNotExistsError(f"{local_path} not exists")
+        ret, _ = self._run_cmd(f"fs -put {local_path} {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"upload {local_path} {fs_path}")
+
+    @_handle_errors()
+    def download(self, fs_path, local_path):
+        if not self.is_exist(fs_path):
+            raise FSFileNotExistsError(f"{fs_path} not exists")
+        ret, _ = self._run_cmd(f"fs -get {fs_path} {local_path}")
+        if ret != 0:
+            raise ExecuteError(f"download {fs_path} {local_path}")
+
+    @_handle_errors()
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        ret, _ = self._run_cmd(f"fs -touchz {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"touch {fs_path}")
+
+    @_handle_errors()
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if self.is_exist(fs_dst_path):
+                raise FSFileExistsError(fs_dst_path)
+        ret, _ = self._run_cmd(f"fs -mv {fs_src_path} {fs_dst_path}")
+        if ret != 0:
+            raise ExecuteError(f"mv {fs_src_path} {fs_dst_path}")
+
+    def need_upload_download(self):
+        return True
+
+    @_handle_errors()
+    def cat(self, fs_path=None):
+        if not self.is_file(fs_path):
+            return ""
+        ret, lines = self._run_cmd(f"fs -cat {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"cat {fs_path}")
+        return "\n".join(lines)

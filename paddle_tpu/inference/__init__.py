@@ -15,7 +15,10 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "DataType", "Tensor", "PredictorPool",
+           "get_version", "get_trt_compile_version",
+           "get_trt_runtime_version", "get_num_bytes_of_data_type",
+           "convert_to_mixed_precision"]
 
 
 class PrecisionType:
@@ -23,6 +26,43 @@ class PrecisionType:
     Half = 1
     Bfloat16 = 2
     Int8 = 3
+
+
+class DataType:
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+_DATA_TYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8,
+                    DataType.INT32: 4, DataType.UINT8: 1,
+                    DataType.INT8: 1, DataType.FLOAT16: 2,
+                    DataType.BFLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype):
+    """Bytes per element of an inference DataType enum value."""
+    try:
+        return _DATA_TYPE_BYTES[dtype]
+    except KeyError:
+        raise ValueError(f"unknown inference DataType: {dtype!r}")
+
+
+def get_version():
+    from ..version import full_version
+    return f"paddle_tpu inference {full_version} (XLA backend)"
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT on TPU; XLA is the engine
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
 
 
 class PlaceType:
@@ -162,3 +202,27 @@ class Predictor:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+class PredictorPool:
+    """`size` independently-cloned Predictors for thread-per-slot
+    serving (reference: paddle_inference_api.h services::PredictorPool).
+    Each slot has its own io state so threads never share handles."""
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        main = Predictor(config)
+        self._preds = [main] + [main.clone() for _ in range(size - 1)]
+
+    def retrive(self, idx):
+        return self._preds[idx]
+
+    retrieve = retrive  # the reference spells it "Retrive"; keep both
+
+
+def convert_to_mixed_precision(*args, **kwargs):
+    raise NotImplementedError(
+        "convert_to_mixed_precision rewrites a serialized fp32 program; "
+        "with paddle_tpu re-export the model under amp instead "
+        "(jit.save of a bf16 layer) — see docs/MIGRATION.md")

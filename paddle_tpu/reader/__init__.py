@@ -1,0 +1,9 @@
+"""paddle.reader — legacy reader-decorator utilities.
+
+Parity: /root/reference/python/paddle/reader/__init__.py.
+"""
+from .decorator import (cache, map_readers, shuffle, chain, compose,
+                        buffered, firstn, xmap_readers,
+                        multiprocess_reader, ComposeNotAligned)
+
+__all__ = []

@@ -3,8 +3,10 @@
 Parity: python/paddle/tensor/__init__.py + the monkey-patching done by
 python/paddle/fluid/dygraph/math_op_patch.py in the reference.
 """
-from . import attribute, creation, einsum, linalg, logic, manipulation, \
-    math, random, search, stat
+from . import array, attribute, creation, einsum, linalg, logic, \
+    manipulation, math, random, search, stat
+from .array import (array_length, array_read, array_write,  # noqa: F401
+                    create_array)
 from ..framework.core import Tensor
 
 _MODULES = [attribute, creation, einsum, linalg, logic, manipulation, math,

@@ -1,0 +1,68 @@
+"""paddle.tensor.array — TensorArray ops.
+
+Parity: /root/reference/python/paddle/tensor/array.py. In the
+reference, dynamic mode backs the array with a Python list and static
+mode with a LOD_TENSOR_ARRAY variable; here the list representation is
+used everywhere — under trace (jit.to_static / static.Program capture)
+a list of traced values stages cleanly into the jaxpr, so no separate
+variable kind is needed.
+"""
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = []
+
+
+def _index(i):
+    """Positional index as a host int (write positions are trace-time
+    constants in the list representation, as in reference dygraph)."""
+    if isinstance(i, Tensor):
+        return int(np.asarray(i.numpy()).reshape(-1)[0])
+    if hasattr(i, "shape") and getattr(i, "shape", None):
+        return int(np.asarray(i).reshape(-1)[0])
+    return int(i)
+
+
+def array_length(array):
+    """Length of the array as a 1-D int64 Tensor of shape [1]."""
+    return Tensor(np.asarray([len(array)], np.int64))
+
+
+def array_read(array, i):
+    """Read the element at position ``i``."""
+    return array[_index(i)]
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at position ``i``; appends when ``i`` equals the
+    current length. Returns the (possibly new) array."""
+    if array is None:
+        array = []
+    idx = _index(i)
+    if idx > len(array):
+        raise IndexError(
+            f"array_write position {idx} is beyond the array end "
+            f"({len(array)}); TensorArray writes must be contiguous")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def create_array(dtype, initialized_list=None):
+    """A new TensorArray (Python list), optionally pre-filled."""
+    array = []
+    if initialized_list is not None:
+        if not isinstance(initialized_list, (list, tuple)):
+            raise TypeError(
+                "initialized_list should be a list of Tensors, got "
+                f"{type(initialized_list)}")
+        array = list(initialized_list)
+    for val in array:
+        if not isinstance(val, Tensor):
+            raise TypeError(
+                "All values in `initialized_list` should be Tensors, "
+                f"got {type(val)}")
+    return array

@@ -14,16 +14,8 @@ def deprecated(update_to="", since="", reason="", level=0):
 
 
 def run_check():
-    import jax
-    import numpy as np
-    from ..framework.core import Tensor
-    from ..tensor.linalg import matmul
-    a = Tensor(np.ones((16, 16), np.float32))
-    out = matmul(a, a)
-    assert float(out.numpy()[0, 0]) == 16.0
-    n = jax.device_count()
-    print(f"PaddleTPU works! devices={n} backend={jax.default_backend()}")
-    return True
+    from .install_check import run_check as _full_check
+    return _full_check()
 
 
 def try_import(module_name, err_msg=None):
@@ -66,9 +58,6 @@ class unique_name:
         return g()
 
 
-class download:
-    @staticmethod
-    def get_weights_path_from_url(url, md5sum=None):
-        raise RuntimeError(
-            "zero-egress environment: download is unavailable; place "
-            "weights locally and load with paddle.load")
+from . import download  # noqa: E402 — real submodule (cache+md5+unpack)
+from . import dlpack  # noqa: E402
+from . import install_check  # noqa: E402

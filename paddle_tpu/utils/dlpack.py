@@ -1,0 +1,47 @@
+"""paddle.utils.dlpack — zero-copy tensor exchange via the DLPack
+protocol.
+
+Parity: /root/reference/python/paddle/utils/dlpack.py. jax arrays speak
+DLPack natively, so to_dlpack hands out the capsule of the backing
+array and from_dlpack imports straight onto the device.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor → DLPack capsule (no copy; the tensor keeps ownership)."""
+    if isinstance(x, Tensor):
+        x = x.value
+    if not hasattr(x, "__dlpack__"):
+        raise TypeError(
+            f"to_dlpack expects a paddle Tensor or array, got {type(x)}")
+    return x.__dlpack__()
+
+
+class _CapsuleHolder:
+    """Adapter giving a raw capsule the __dlpack__ protocol surface
+    jnp.from_dlpack expects."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        # kDLCPU = 1; jax re-queries the real device from the capsule
+        return (1, 0)
+
+
+def from_dlpack(dlpack):
+    """DLPack capsule (or any object exporting __dlpack__) → Tensor."""
+    if hasattr(dlpack, "__dlpack__"):
+        arr = jnp.from_dlpack(dlpack)
+    else:
+        arr = jnp.from_dlpack(_CapsuleHolder(dlpack))
+    return Tensor(arr)

@@ -1,0 +1,80 @@
+"""paddle.utils.download — weights-cache path resolution.
+
+Parity: /root/reference/python/paddle/utils/download.py. This
+environment is zero-egress, so the network half raises a clear
+placement instruction; the cache lookup, md5 verification and archive
+decompression halves are fully functional against local files.
+"""
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def is_url(path):
+    return path.startswith(("http://", "https://"))
+
+
+def _map_path(url, root_dir):
+    fname = osp.split(url)[-1]
+    return osp.join(root_dir, fname)
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Resolve a weights url to its local cache path (WEIGHTS_HOME)."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True):
+    if not is_url(url):
+        raise ValueError(f"Given url {url} is not valid")
+    fullpath = _map_path(url, root_dir)
+    if check_exist and osp.exists(fullpath) and _md5check(fullpath,
+                                                          md5sum):
+        if decompress and (tarfile.is_tarfile(fullpath)
+                           or zipfile.is_zipfile(fullpath)):
+            return _decompress(fullpath)
+        return fullpath
+    raise RuntimeError(
+        f"zero-egress environment: cannot download {url}; place the "
+        f"file at {fullpath} manually")
+
+
+def _decompress(fname):
+    """Unpack a tar/zip next to itself; returns the extracted root."""
+    fpath = osp.split(fname)[0]
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as f:
+            names = f.getnames()
+            root = names[0].split("/")[0]
+            dst = osp.join(fpath, root)
+            if not osp.exists(dst):
+                f.extractall(fpath)
+        return dst
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as f:
+            names = f.namelist()
+            root = names[0].split("/")[0]
+            dst = osp.join(fpath, root)
+            if not osp.exists(dst):
+                f.extractall(fpath)
+        return dst
+    return fname

@@ -1,0 +1,116 @@
+"""paddle.utils.install_check — post-install smoke test.
+
+Parity: /root/reference/python/paddle/utils/install_check.py. Runs a
+tiny linear-regression train step three ways — eager, static
+(Executor), and data-parallel across every visible device via a
+sharded batch — and prints the reference's familiar confirmation
+lines.
+"""
+import numpy as np
+
+__all__ = []
+
+
+def _simple_network():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    class SimpleNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    return SimpleNet()
+
+
+def _train_data():
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 16).astype(np.float32)
+    y = rng.rand(8, 4).astype(np.float32)
+    return x, y
+
+
+def _run_dygraph_single():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    paddle.disable_static()
+    model = _simple_network()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x, y = _train_data()
+    loss = nn.functional.mse_loss(model(paddle.to_tensor(x)),
+                                  paddle.to_tensor(y))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.item())
+
+
+def _run_static_single():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x_in = static.data("x", [None, 16], "float32")
+            y_in = static.data("y", [None, 4], "float32")
+            out = static.nn.fc(x_in, 4)
+            loss = paddle.mean((out - y_in) ** 2)
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        x, y = _train_data()
+        (lv,) = exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+        return float(np.asarray(lv).reshape(-1)[0])
+    finally:
+        paddle.disable_static()
+
+
+def _run_parallel():
+    """One jitted step with the batch sharded across every device —
+    the TPU equivalent of the reference's multi-GPU fleet check."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = jax.device_count()
+    if n < 2:
+        return None
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    w = jnp.zeros((16, 4), jnp.float32)
+    x, y = _train_data()
+    x = jnp.asarray(np.tile(x, (n, 1)))
+    y = jnp.asarray(np.tile(y, (n, 1)))
+    x = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    y = jax.device_put(y, NamedSharding(mesh, P("dp", None)))
+
+    @jax.jit
+    def step(w, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return loss, w - 0.1 * g
+
+    loss, _ = step(w, x, y)
+    return float(loss)
+
+
+def run_check():
+    """Smoke-check the installation; mirrors the reference's output."""
+    import jax
+    n = jax.device_count()
+    backend = jax.default_backend()
+    print(f"Running verify PaddlePaddle(TPU) program ... ")
+    _run_dygraph_single()
+    _run_static_single()
+    parallel = _run_parallel()
+    if parallel is not None:
+        print(f"PaddlePaddle(TPU) works well on {n} {backend} devices.")
+    print(f"PaddlePaddle(TPU) works well on 1 {backend} device.")
+    print("PaddlePaddle(TPU) is installed successfully! Let's start "
+          "deep learning with PaddlePaddle(TPU) now.")
+    return True

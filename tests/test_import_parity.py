@@ -22,6 +22,23 @@ import pytest
     ("paddle_tpu.optimizer.lr", ["LRScheduler", "NoamDecay"]),
     ("paddle_tpu.vision.transforms", ["Compose", "Resize"]),
     ("paddle_tpu.static.nn", ["fc", "cond", "while_loop"]),
+    ("paddle_tpu.compat", ["to_text", "to_bytes", "round",
+                           "floor_division", "get_exception_message"]),
+    ("paddle_tpu.callbacks", ["Callback", "EarlyStopping"]),
+    ("paddle_tpu.reader", ["cache", "map_readers", "shuffle", "chain",
+                           "compose", "buffered", "firstn",
+                           "xmap_readers", "multiprocess_reader"]),
+    ("paddle_tpu.dataset", ["mnist", "cifar", "imdb", "imikolov",
+                            "movielens", "conll05", "uci_housing",
+                            "wmt14", "wmt16", "flowers", "voc2012",
+                            "image", "common"]),
+    ("paddle_tpu.dataset.common", ["DATA_HOME", "md5file", "download",
+                                   "split", "cluster_files_reader"]),
+    ("paddle_tpu.cost_model", ["CostModel"]),
+    ("paddle_tpu.inference", ["DataType", "PredictorPool", "get_version",
+                              "get_trt_compile_version",
+                              "get_trt_runtime_version",
+                              "get_num_bytes_of_data_type"]),
 ])
 def test_module_path_and_names(path, names):
     mod = importlib.import_module(path)

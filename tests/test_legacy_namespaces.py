@@ -1,0 +1,403 @@
+"""paddle.{compat, callbacks, reader, dataset, cost_model} + inference
+utilities — the legacy top-level namespaces a 2.x reference user still
+imports (reference: python/paddle/{compat.py, callbacks.py, reader/,
+dataset/, cost_model/}).
+
+Dataset parsers are fed synthetic files in the OFFICIAL formats
+(idx-gzip, ::-separated dat, tab-separated parallel text) so the
+parsing is proven without network access.
+"""
+import gzip
+import io
+import os
+import struct
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------- compat
+def test_compat_text_bytes_round_trip():
+    from paddle_tpu import compat
+    assert compat.to_text(b"hello") == "hello"
+    assert compat.to_bytes("hello") == b"hello"
+    nested = {"k": [b"a", b"b"], "v": {b"x"}}
+    out = compat.to_text(nested)
+    assert out["k"] == ["a", "b"] and out["v"] == {"x"}
+    lst = [b"a", [b"b"]]
+    assert compat.to_text(lst, inplace=True) is lst
+    assert lst == ["a", ["b"]]
+
+
+def test_compat_round_half_away_from_zero():
+    from paddle_tpu import compat
+    assert compat.round(0.5) == 1.0
+    assert compat.round(-0.5) == -1.0
+    assert compat.round(2.675, 2) == 2.68
+    assert compat.floor_division(7, 2) == 3
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
+
+
+# ------------------------------------------------------------- callbacks
+def test_callbacks_namespace_matches_hapi():
+    import paddle_tpu.callbacks as cb
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+    assert cb.EarlyStopping is EarlyStopping
+    for name in ["Callback", "ProgBarLogger", "ModelCheckpoint",
+                 "VisualDL", "LRScheduler", "EarlyStopping",
+                 "ReduceLROnPlateau"]:
+        assert hasattr(cb, name)
+
+
+# ---------------------------------------------------------------- reader
+def test_reader_decorators_compose():
+    from paddle_tpu import reader
+
+    def r():
+        return iter(range(10))
+
+    assert list(reader.firstn(r, 3)()) == [0, 1, 2]
+    assert list(reader.cache(r)()) == list(range(10))
+    assert list(reader.chain(r, r)()) == list(range(10)) * 2
+    assert list(reader.map_readers(lambda a, b: a + b, r, r)()) == [
+        2 * i for i in range(10)]
+    assert sorted(reader.shuffle(r, 4)()) == list(range(10))
+    assert list(reader.buffered(r, 2)()) == list(range(10))
+    got = list(reader.compose(r, r)())
+    assert got[0] == (0, 0) and len(got) == 10
+
+
+def test_reader_compose_alignment_check():
+    from paddle_tpu import reader
+
+    def short():
+        return iter(range(3))
+
+    def long():
+        return iter(range(5))
+
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(short, long)())
+    # unchecked mode just zips to the shorter
+    assert len(list(reader.compose(short, long,
+                                   check_alignment=False)())) == 3
+
+
+def test_reader_xmap_ordered_and_unordered():
+    from paddle_tpu import reader
+
+    def r():
+        return iter(range(20))
+
+    ordered = list(reader.xmap_readers(lambda x: x * 2, r, 3, 4,
+                                       order=True)())
+    assert ordered == [x * 2 for x in range(20)]
+    unordered = list(reader.xmap_readers(lambda x: x * 2, r, 3, 4)())
+    assert sorted(unordered) == [x * 2 for x in range(20)]
+
+
+def test_multiprocess_reader():
+    from paddle_tpu import reader
+    got = sorted(reader.multiprocess_reader(
+        [_mp_reader_a, _mp_reader_b], queue_size=8)())
+    assert got == list(range(8))
+
+
+def _mp_reader_a():
+    return iter(range(4))
+
+
+def _mp_reader_b():
+    return iter(range(4, 8))
+
+
+# --------------------------------------------------------------- dataset
+def _write_mnist(home, mode, n=4):
+    d = os.path.join(home, "mnist")
+    os.makedirs(d, exist_ok=True)
+    from paddle_tpu.vision.datasets import MNIST
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, size=(n, 28, 28), dtype=np.uint8)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    with gzip.open(os.path.join(d, MNIST.IMG[mode]), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(os.path.join(d, MNIST.LAB[mode]), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return imgs, labels
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    home = str(tmp_path / "dataset")
+    os.makedirs(home, exist_ok=True)
+    import paddle_tpu.dataset.common as common
+    import paddle_tpu.vision.datasets as vd
+    monkeypatch.setattr(common, "DATA_HOME", home)
+    monkeypatch.setattr(vd, "DATA_HOME", home)
+    for mod in ("mnist", "imdb", "imikolov", "movielens", "wmt14",
+                "wmt16", "conll05", "uci_housing", "voc2012",
+                "flowers"):
+        m = __import__(f"paddle_tpu.dataset.{mod}", fromlist=[mod])
+        if hasattr(m, "DATA_HOME"):
+            monkeypatch.setattr(m, "DATA_HOME", home)
+    return home
+
+
+def test_dataset_mnist_reader(data_home, monkeypatch):
+    import paddle_tpu.dataset as dataset
+    imgs, labels = _write_mnist(data_home, "train")
+    samples = list(dataset.mnist.train()())
+    assert len(samples) == 4
+    img, label = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    np.testing.assert_allclose(
+        img, imgs[0].reshape(-1).astype(np.float32) / 255 * 2 - 1)
+    assert label == int(labels[0])
+
+
+def test_dataset_imdb_build_dict_and_reader(data_home):
+    import paddle_tpu.dataset.imdb as imdb
+    d = os.path.join(data_home, "imdb")
+    os.makedirs(d, exist_ok=True)
+    docs = {"aclImdb/train/pos/0_9.txt": b"a great great movie!",
+            "aclImdb/train/neg/0_1.txt": b"a terrible movie.",
+            "aclImdb/test/pos/0_8.txt": b"great fun",
+            "aclImdb/test/neg/0_2.txt": b"boring"}
+    with tarfile.open(os.path.join(d, "aclImdb_v1.tar.gz"), "w:gz") as tf:
+        for name, body in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tf.addfile(info, io.BytesIO(body))
+    w = imdb.word_dict(cutoff=0)
+    assert "great" in w and "<unk>" in w
+    samples = list(imdb.train(w)())
+    assert len(samples) == 2
+    ids, label = samples[0]
+    assert label in (0, 1) and all(isinstance(i, int) for i in ids)
+
+
+def test_dataset_imikolov_ngram_and_seq(data_home):
+    import paddle_tpu.dataset.imikolov as imikolov
+    d = os.path.join(data_home, "imikolov")
+    os.makedirs(d, exist_ok=True)
+    train_text = b"the cat sat\nthe dog sat\n"
+    valid_text = b"the cat ran\n"
+    with tarfile.open(os.path.join(d, "simple-examples.tgz"),
+                      "w:gz") as tf:
+        for name, body in [(imikolov.TRAIN_FILE, train_text),
+                           (imikolov.TEST_FILE, valid_text)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tf.addfile(info, io.BytesIO(body))
+    w = imikolov.build_dict(min_word_freq=0)
+    assert "<s>" in w and "<e>" in w and "<unk>" in w
+    grams = list(imikolov.train(w, 2)())
+    assert all(len(g) == 2 for g in grams) and grams
+    seqs = list(imikolov.train(w, 0,
+                               imikolov.DataType.SEQ)())
+    src, trg = seqs[0]
+    assert src[0] == w["<s>"] and trg[-1] == w["<e>"]
+
+
+def test_dataset_wmt14_reader(data_home):
+    import paddle_tpu.dataset.wmt14 as wmt14
+    d = os.path.join(data_home, "wmt14")
+    os.makedirs(d, exist_ok=True)
+    vocab = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    train = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(os.path.join(d, "wmt14.tgz"), "w:gz") as tf:
+        for name, body in [("wmt14/src.dict", vocab),
+                           ("wmt14/trg.dict", vocab),
+                           ("wmt14/train/train", train)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tf.addfile(info, io.BytesIO(body))
+    samples = list(wmt14.train(dict_size=5)())
+    assert len(samples) == 2
+    src, trg, trg_next = samples[0]
+    assert src[0] == 0 and src[-1] == 1  # <s> ... <e>
+    assert trg[0] == 0 and trg_next[-1] == 1
+    src_d, trg_d = wmt14.get_dict(5)
+    assert src_d[3] == "hello"
+
+
+def test_dataset_wmt16_builds_dict_from_corpus(data_home):
+    import paddle_tpu.dataset.wmt16 as wmt16
+    d = os.path.join(data_home, "wmt16")
+    os.makedirs(d, exist_ok=True)
+    train = b"hello world\thallo welt\ngood day\tguten tag\n"
+    with tarfile.open(os.path.join(d, "wmt16.tar.gz"), "w:gz") as tf:
+        for name, body in [("wmt16/train", train),
+                           ("wmt16/test", train),
+                           ("wmt16/val", train)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tf.addfile(info, io.BytesIO(body))
+    samples = list(wmt16.train(100, 100)())
+    assert len(samples) == 2
+    src, trg, trg_next = samples[0]
+    en_dict = wmt16.get_dict("en", 100)
+    assert en_dict["<s>"] == 0 and "hello" in en_dict
+
+
+def test_dataset_movielens_readers(data_home):
+    import paddle_tpu.dataset.movielens as ml
+    ml.MOVIE_INFO = None  # reset module cache across DATA_HOME changes
+    d = os.path.join(data_home, "movielens")
+    os.makedirs(d, exist_ok=True)
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action\n")
+    users = "1::M::25::6::12345\n2::F::35::3::54321\n"
+    ratings = "1::1::5::978300760\n2::2::3::978302109\n"
+    with zipfile.ZipFile(os.path.join(d, "ml-1m.zip"), "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    try:
+        samples = list(ml.train()())
+        assert samples, "train split unexpectedly empty"
+        row = samples[0]
+        # user(4) + movie(3) + rating(1)
+        assert len(row) == 8 and row[-1][0] in (5.0, 1.0)
+        assert ml.max_movie_id() == 2 and ml.max_user_id() == 2
+        assert ml.max_job_id() == 6
+        assert set(ml.movie_categories()) == {"Animation", "Comedy",
+                                              "Action"}
+        assert "toy" in ml.get_movie_title_dict()
+    finally:
+        ml.MOVIE_INFO = None
+
+
+def test_dataset_conll05_expand_props():
+    from paddle_tpu.dataset.conll05 import _expand_props
+    assert _expand_props(["(A0*", "*", "*)", "(V*)", "*"]) == [
+        "B-A0", "I-A0", "I-A0", "B-V", "O"]
+
+
+def test_dataset_conll05_corpus_reader(data_home):
+    import paddle_tpu.dataset.conll05 as conll05
+    d = os.path.join(data_home, "conll05st")
+    os.makedirs(d, exist_ok=True)
+    words = b"The\ncat\nsat\n\n"
+    props = b"-\t(A0*\nsat\t*)\n-\t(V*)\n\n"
+    # column layout: first col is the verb sense column, later cols one
+    # per predicate
+    words_gz = gzip.compress(words)
+    props_gz = gzip.compress(props)
+    tar_path = os.path.join(d, "conll05st-tests.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, body in [
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 words_gz),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 props_gz)]:
+            info = tarfile.TarInfo(name)
+            info.size = len(body)
+            tf.addfile(info, io.BytesIO(body))
+    reader = conll05.corpus_reader(
+        tar_path,
+        "conll05st-release/test.wsj/words/test.wsj.words.gz",
+        "conll05st-release/test.wsj/props/test.wsj.props.gz")
+    out = list(reader())
+    assert out == [(["The", "cat", "sat"], "sat",
+                    ["B-A0", "I-A0", "B-V"])]
+
+
+def test_dataset_uci_housing(data_home):
+    d = os.path.join(data_home, "uci_housing")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(0)
+    np.savetxt(os.path.join(d, "housing.data"),
+               rng.rand(20, 14).astype(np.float32))
+    import paddle_tpu.dataset.uci_housing as uci
+    import paddle_tpu.text as text
+    orig = text.DATA_HOME
+    text.DATA_HOME = data_home
+    try:
+        train = list(uci.train()())
+        test = list(uci.test()())
+        assert len(train) == 16 and len(test) == 4
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+    finally:
+        text.DATA_HOME = orig
+
+
+def test_dataset_zero_egress_error_is_clear(data_home):
+    import paddle_tpu.dataset.common as common
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        common.download("http://example.com/foo.tgz", "foo", None)
+
+
+def test_dataset_common_split_and_cluster(tmp_path, data_home,
+                                          monkeypatch):
+    import paddle_tpu.dataset.common as common
+
+    def r():
+        return iter(range(10))
+
+    monkeypatch.chdir(tmp_path)
+    written = common.split(r, 4)
+    assert len(written) >= 2
+    shard0 = list(common.cluster_files_reader("0000*.pickle", 2, 0)())
+    shard1 = list(common.cluster_files_reader("0000*.pickle", 2, 1)())
+    assert sorted(shard0 + shard1) == list(range(10))
+
+
+def test_dataset_image_utils():
+    from paddle_tpu.dataset import image
+    im = np.random.randint(0, 255, (64, 48, 3), dtype=np.uint8)
+    small = image.resize_short(im, 32)
+    assert min(small.shape[:2]) == 32
+    crop = image.center_crop(small, 24)
+    assert crop.shape[:2] == (24, 24)
+    chw = image.to_chw(crop)
+    assert chw.shape == (3, 24, 24)
+    flipped = image.left_right_flip(im)
+    np.testing.assert_array_equal(flipped, im[:, ::-1, :])
+    out = image.simple_transform(im, 40, 32, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+
+
+# ------------------------------------------------------------ cost_model
+def test_cost_model_static_table_and_program():
+    from paddle_tpu.cost_model import CostModel
+    cm = CostModel()
+    data = cm.static_cost_data()
+    ops = {d["op"] for d in data}
+    assert {"matmul", "relu"} <= ops
+    t = cm.get_static_op_time("matmul")
+    assert t["op_time"] > 0
+    t_b = cm.get_static_op_time("matmul", forward=False)
+    assert t_b["op_time"] > 0
+    startup, main = cm.build_program()
+    cost = cm.profile_measure(startup, main, device="cpu")
+    assert cost["time"] > 0
+    import paddle_tpu as paddle
+    paddle.disable_static()
+
+
+# ------------------------------------------------------------- inference
+def test_inference_utility_surface():
+    from paddle_tpu import inference
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.FLOAT32) == 4
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.INT64) == 8
+    assert "paddle_tpu" in inference.get_version()
+    assert inference.get_trt_compile_version() == (0, 0, 0)
+    assert inference.get_trt_runtime_version() == (0, 0, 0)
+
+
+def test_top_level_namespaces_importable():
+    for name in ("compat", "callbacks", "reader", "dataset",
+                 "cost_model", "batch"):
+        assert hasattr(paddle, name), name

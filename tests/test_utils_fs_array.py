@@ -1,0 +1,154 @@
+"""tensor.array ops, fleet.utils.fs.LocalFS, utils.{dlpack, download,
+install_check} — round-4 surface additions (reference:
+python/paddle/tensor/array.py, distributed/fleet/utils/fs.py,
+utils/{dlpack,download,install_check}.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ----------------------------------------------------------- tensor.array
+def test_tensor_array_write_read_length():
+    arr = paddle.tensor.create_array(dtype="float32")
+    x = paddle.full(shape=[1, 3], fill_value=5, dtype="float32")
+    i = paddle.zeros(shape=[1], dtype="int32")
+    arr = paddle.tensor.array_write(x, i, array=arr)
+    item = paddle.tensor.array_read(arr, i)
+    np.testing.assert_allclose(item.numpy(), np.full((1, 3), 5.0))
+    n = paddle.tensor.array_length(arr)
+    assert n.numpy().tolist() == [1]
+
+
+def test_tensor_array_append_and_overwrite():
+    a = paddle.to_tensor([1.0])
+    b = paddle.to_tensor([2.0])
+    arr = paddle.tensor.array_write(a, paddle.zeros([1], "int64"))
+    arr = paddle.tensor.array_write(b, paddle.to_tensor([1]))
+    assert len(arr) == 2
+    # overwrite position 0
+    arr = paddle.tensor.array_write(b, paddle.to_tensor([0]), array=arr)
+    np.testing.assert_allclose(
+        paddle.tensor.array_read(arr, paddle.to_tensor([0])).numpy(),
+        [2.0])
+    with pytest.raises(IndexError):
+        paddle.tensor.array_write(a, paddle.to_tensor([5]), array=arr)
+
+
+def test_tensor_array_initialized_list_validation():
+    t = paddle.to_tensor([1.0])
+    arr = paddle.tensor.create_array("float32", initialized_list=[t])
+    assert len(arr) == 1
+    with pytest.raises(TypeError):
+        paddle.tensor.create_array("float32", initialized_list=[1.0])
+    with pytest.raises(TypeError):
+        paddle.tensor.create_array("float32", initialized_list=5)
+
+
+# ---------------------------------------------------------- fleet fs
+def test_localfs_round_trip(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    fs = LocalFS()
+    d = str(tmp_path / "ckpt")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = os.path.join(d, "meta")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with open(f, "w") as fh:
+        fh.write("step=7\n")
+    assert fs.cat(f) == "step=7"
+    sub = os.path.join(d, "shard0")
+    fs.mkdirs(sub)
+    dirs, files = fs.ls_dir(d)
+    assert dirs == ["shard0"] and files == ["meta"]
+    assert fs.list_dirs(d) == ["shard0"]
+    dst = os.path.join(d, "meta2")
+    fs.mv(f, dst)
+    assert fs.is_file(dst) and not fs.is_exist(f)
+    assert not fs.need_upload_download()
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_localfs_mv_guards(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import (
+        LocalFS, FSFileExistsError, FSFileNotExistsError)
+    fs = LocalFS()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    fs.touch(a)
+    fs.touch(b)
+    with pytest.raises(FSFileExistsError):
+        fs.mv(a, b)
+    fs.mv(a, b, overwrite=True)
+    with pytest.raises(FSFileNotExistsError):
+        fs.mv(str(tmp_path / "nope"), b)
+    with pytest.raises(FSFileExistsError):
+        fs.touch(b, exist_ok=False)
+
+
+def test_hdfs_client_clear_error_without_hadoop(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import HDFSClient
+    from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                       FSTimeOut)
+    client = HDFSClient(str(tmp_path / "no-hadoop"), None,
+                        time_out=1, sleep_inter=1)
+    with pytest.raises((ExecuteError, FSTimeOut)):
+        client.is_exist("/tmp/x")
+    assert client.need_upload_download()
+
+
+# ------------------------------------------------------------ utils.*
+def test_dlpack_round_trip():
+    from paddle_tpu.utils.dlpack import to_dlpack, from_dlpack
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    capsule = to_dlpack(t)
+    t2 = from_dlpack(capsule)
+    np.testing.assert_allclose(t2.numpy(), t.numpy())
+    with pytest.raises(TypeError):
+        to_dlpack("not a tensor")
+
+
+def test_dlpack_interop_with_torch():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils.dlpack import from_dlpack
+    src = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    t = from_dlpack(src.__dlpack__())
+    np.testing.assert_allclose(t.numpy(), src.numpy())
+
+
+def test_download_cache_and_decompress(tmp_path, monkeypatch):
+    import tarfile
+    from paddle_tpu.utils import download as dl
+    url = "https://example.com/weights/model.pdparams"
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        dl.get_path_from_url(url, str(tmp_path))
+    # pre-placed file resolves
+    target = tmp_path / "model.pdparams"
+    target.write_bytes(b"abc")
+    got = dl.get_path_from_url(url, str(tmp_path))
+    assert got == str(target)
+    # md5 mismatch refuses the cache
+    with pytest.raises(RuntimeError):
+        dl.get_path_from_url(url, str(tmp_path), md5sum="0" * 32)
+    # archives are unpacked
+    arc_dir = tmp_path / "payload"
+    arc_dir.mkdir()
+    (arc_dir / "w.bin").write_bytes(b"xyz")
+    arc = tmp_path / "payload.tar"
+    with tarfile.open(arc, "w") as tf:
+        tf.add(arc_dir, arcname="payload")
+    got = dl.get_path_from_url("https://example.com/payload.tar",
+                               str(tmp_path))
+    assert got == str(tmp_path / "payload")
+    assert not dl.is_url("/local/path")
+
+
+def test_install_check_run_check(capsys):
+    assert paddle.utils.run_check() is True
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+    assert "8 cpu devices" in out  # the virtual test mesh
