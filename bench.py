@@ -1,16 +1,71 @@
 """Headline benchmark: tokens/sec/chip on a GPT train step (bf16).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE final JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline ratchets against BENCH_BASE.json (first run records the base;
 BASELINE.json carries no published numbers to compare against directly).
 On failure, prints a one-line diagnostic JSON instead of a bare traceback.
+
+Robustness contract (round-5, after BENCH_r04.json recorded rc=124 with
+zero output on a congested-compile day):
+  * a persistent XLA compilation cache (.xla_cache/, repo-local) means any
+    config that has EVER compiled on this machine loads in seconds —
+    remote-compile congestion can only hurt the first run ever;
+  * the child prints the headline JSON unbuffered the instant it is
+    measured and the parent tees it through immediately, so a kill at any
+    later point still leaves the headline line on stdout;
+  * the parent fits a total wall budget (BENCH_TOTAL_BUDGET, default
+    480 s): attempts are subprocesses with hard timeouts sized to the
+    remaining budget, the 1.3B side metric runs only after the headline
+    line is already safe and only with budget to spare;
+  * a compile that exceeds its attempt budget produces a diagnostic JSON
+    naming the config, the elapsed time, and the child's last stderr
+    lines (congestion evidence) instead of dying silent.
 """
 import json
 import os
+import sys
 import time
 import traceback
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_CACHE_DIR = os.environ.get("BENCH_XLA_CACHE",
+                            os.path.join(_REPO, ".xla_cache"))
+_STATE_PATH = os.path.join(_CACHE_DIR, "bench_state.json")
+
+
+def _enable_compile_cache(jax_mod):
+    """Persistent compilation cache: every compile (no minimum time or
+    size) is written to the repo-local cache dir, so repeat runs load
+    instead of recompiling."""
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        jax_mod.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax_mod.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never a blocker
+        print(f"bench: compile cache unavailable: {e}", file=sys.stderr)
+
+
+def _load_state():
+    try:
+        with open(_STATE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _mark_compiled(tag):
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        state = _load_state()
+        state[tag] = {"compiled_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                    time.gmtime())}
+        with open(_STATE_PATH, "w") as f:
+            json.dump(state, f)
+    except Exception:
+        pass
 
 
 def _peak_flops(jax_mod):
@@ -39,6 +94,7 @@ def _run():
     signal.alarm(init_budget)
     import jax
     import jax.numpy as jnp
+    _enable_compile_cache(jax)
     jax.devices()  # force backend init under the alarm
     signal.alarm(0)
 
@@ -55,8 +111,8 @@ def _run():
         # 262 ms for scan+full remat. The lax.scan path OOMed without
         # remat because it stacks residuals as [24, ...] buffers
         # (BENCH_r02.json); unrolled, XLA schedules/frees them per layer
-        # and everything fits. ~60 s compile. _run() retries on the
-        # scan+names config if this one fails.
+        # and everything fits. ~60 s compile cold; seconds from the
+        # persistent cache. The parent orders attempts by cache state.
         batch, seq = 8, 1024
         remat = os.environ.get("BENCH_REMAT", "false")
         if remat not in ("true", "false", "names", "dots"):
@@ -98,9 +154,14 @@ def _run():
     # warmup (compile); sync via a data fetch — through the axon tunnel
     # block_until_ready returns before execution finishes, so only a
     # fetch (.item()) is a true barrier
+    t_compile = time.perf_counter()
     for _ in range(3):
         loss = step(ids, ids)
     float(loss.item())
+    t_compile = time.perf_counter() - t_compile
+    _mark_compiled(f"headline scan={scan} remat={remat}")
+    print(f"bench: warmup+compile {t_compile:.1f}s "
+          f"(scan={scan} remat={remat})", file=sys.stderr, flush=True)
 
     iters = 30 if on_tpu else 3
     t0 = time.perf_counter()
@@ -110,10 +171,46 @@ def _run():
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
-    # calibrate sustained matmul rate (the realistic MXU ceiling for this
-    # chip/tunnel) with a 100-iter chained bf16 matmul, one scalar fetch
-    mm_tflops = 0.0
+    loss_val = round(float(loss.item()), 4)
+
+    # ---- the headline is now measured: print it IMMEDIATELY (the parent
+    # tees this line straight through, so any later kill cannot lose it)
+    peak = _peak_flops(jax) if on_tpu else 197e12
+    mfu = 6.0 * n_params * tokens_per_sec / peak if on_tpu else 0.0
+    base_path = os.path.join(_REPO, "BENCH_BASE.json")
+    vs = 1.0
     if on_tpu:
+        if os.path.exists(base_path):
+            with open(base_path) as f:
+                base = json.load(f).get("tokens_per_sec", tokens_per_sec)
+            vs = tokens_per_sec / base
+        else:
+            with open(base_path, "w") as f:
+                json.dump({"tokens_per_sec": tokens_per_sec,
+                           "mfu": mfu, "n_params": n_params}, f)
+    headline = {
+        "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+        "on_tpu": on_tpu,
+        "mfu": round(mfu, 4),
+        "remat": remat,
+        "scan_layers": scan,
+        "loss": loss_val,
+        "compile_s": round(t_compile, 1),
+    }
+    print(json.dumps(headline), flush=True)
+
+    if os.environ.get("BENCH_HOLD_AFTER_PRINT"):
+        # test hook: prove the headline survives a kill after measurement
+        time.sleep(float(os.environ["BENCH_HOLD_AFTER_PRINT"]))
+
+    # calibrate sustained matmul rate (the realistic MXU ceiling for this
+    # chip/tunnel) with a 100-iter chained bf16 matmul, one scalar fetch.
+    # Runs AFTER the headline line so it can never cost the record.
+    mm_tflops = 0.0
+    if on_tpu and os.environ.get("BENCH_MM_CAL", "1") == "1":
         from jax import lax
         a = jnp.asarray(rng.randn(4096, 4096) * 0.01, jnp.bfloat16)
         w = jnp.asarray(rng.randn(4096, 4096) * 0.01, jnp.bfloat16)
@@ -130,42 +227,13 @@ def _run():
         float(mm_chain(a))
         mm_dt = time.perf_counter() - t0
         mm_tflops = 100 * 2 * 4096**3 / mm_dt / 1e12
-    # MFU: train step ~ 6*N flops/token (fwd 2N + bwd 4N), against the
-    # chip generation's bf16 peak.  Context only; headline stays tokens/s.
-    peak = _peak_flops(jax) if on_tpu else 197e12
-    mfu = 6.0 * n_params * tokens_per_sec / peak if on_tpu else 0.0
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "BENCH_BASE.json")
-    vs = 1.0
-    if on_tpu:
-        if os.path.exists(base_path):
-            with open(base_path) as f:
-                base = json.load(f).get("tokens_per_sec", tokens_per_sec)
-            vs = tokens_per_sec / base
-        else:
-            with open(base_path, "w") as f:
-                json.dump({"tokens_per_sec": tokens_per_sec,
-                           "mfu": mfu, "n_params": n_params}, f)
-    print(json.dumps({
-        "metric": "gpt_medium_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(vs, 3),
-        "on_tpu": on_tpu,
-        "mfu": round(mfu, 4),
-        # mfu uses the v5e nominal 197 TFLOP/s; mfu_vs_measured_peak uses
-        # the sustained bf16 matmul rate calibrated above (~100 TFLOP/s on
-        # this chip/tunnel) — the honest utilization ceiling
-        "measured_matmul_tflops": round(mm_tflops, 1),
-        "mfu_vs_measured_peak": round(
+        # mfu uses the chip-generation nominal peak; mfu_vs_measured_peak
+        # uses the sustained bf16 matmul rate calibrated above (~100
+        # TFLOP/s on this chip/tunnel) — the honest utilization ceiling
+        headline["measured_matmul_tflops"] = round(mm_tflops, 1)
+        headline["mfu_vs_measured_peak"] = round(
             6.0 * n_params * tokens_per_sec / (mm_tflops * 1e12), 4)
-        if mm_tflops else 0.0,
-        "remat": remat,
-        "scan_layers": scan,
-        "loss": round(float(loss.item()), 4),
-    }))
-
-
+        print(json.dumps(headline), flush=True)
 
 
 def _run_1p3b():
@@ -181,6 +249,7 @@ def _run_1p3b():
     starve the headline metric (the parent already holds that line)."""
     import jax
     import jax.numpy as jnp
+    _enable_compile_cache(jax)
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.jit import TrainStep
@@ -219,6 +288,7 @@ def _run_1p3b():
     for _ in range(2):
         l13 = s13(ids13, ids13)
     float(l13.item())
+    _mark_compiled(f"1p3b remat={cfg13.scan_remat}")
     t0 = time.perf_counter()
     for _ in range(8):
         l13 = s13(ids13, ids13)
@@ -226,15 +296,73 @@ def _run_1p3b():
     tps = 4 * 1024 * 8 / (time.perf_counter() - t0)
     peak = _peak_flops(jax)
     print(json.dumps({"gpt_1p3b_tokens_per_sec": round(tps, 1),
-                      "gpt_1p3b_mfu": round(6.0 * n13 * tps / peak, 4)}))
+                      "gpt_1p3b_mfu": round(6.0 * n13 * tps / peak, 4)}),
+          flush=True)
+
+
+def _stream_child(extra_env, budget, tee_json_to_stdout):
+    """Run this script as a child (BENCH_CHILD=1 plus extra_env), stream
+    its output live. JSON lines are teed to stdout the instant they
+    arrive when tee_json_to_stdout (the kill-safety contract); all other
+    child output goes to stderr. Returns (rc, json_lines, stderr_tail);
+    rc is 'timeout' when the budget killed it."""
+    import subprocess
+    import threading
+
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        errors="replace")
+    json_lines = []
+    err_tail = []
+
+    def _pump_out():
+        for raw in proc.stdout:
+            line = raw.rstrip("\n")
+            if line.startswith("{"):
+                json_lines.append(line)
+                if tee_json_to_stdout:
+                    print(line, flush=True)
+                else:
+                    print(line, file=sys.stderr, flush=True)
+            else:
+                print(line, file=sys.stderr, flush=True)
+
+    def _pump_err():
+        for raw in proc.stderr:
+            err_tail.append(raw.rstrip("\n"))
+            del err_tail[:-8]
+            print(raw, end="", file=sys.stderr, flush=True)
+
+    t_out = threading.Thread(target=_pump_out, daemon=True)
+    t_err = threading.Thread(target=_pump_err, daemon=True)
+    t_out.start()
+    t_err.start()
+    try:
+        proc.wait(timeout=budget)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        rc = "timeout"
+    t_out.join(timeout=5)
+    t_err.join(timeout=5)
+    return rc, json_lines, err_tail
+
 
 def main():
     """Parent: run each attempt in a SUBPROCESS with a hard wall-clock
     timeout — SIGALRM cannot interrupt a GIL-holding C++ compile RPC
     (observed 2026-07-30: a congested remote compile helper stretched the
     normally-60s compile past 30 min and in-process alarms never fired).
-    The child (BENCH_CHILD=1) does the real work and prints the one JSON
-    line; the parent relays it verbatim, so the driver contract holds."""
+    The child (BENCH_CHILD=1) does the real work and prints the headline
+    JSON the instant it is measured; the parent tees it straight through
+    (kill-safe), then appends side metrics and re-prints the merged line
+    as the final word."""
     if os.environ.get("BENCH_CHILD") == "1":
         try:
             if os.environ.get("BENCH_TASK") == "1p3b":
@@ -247,82 +375,107 @@ def main():
                 "metric": "gpt_medium_train_tokens_per_sec_per_chip",
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
-                "traceback_tail": tb[-800:]}))
+                "traceback_tail": tb[-800:]}), flush=True)
             raise SystemExit(1)
         return
 
-    import subprocess
-    import sys
-    attempt_budget = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "900"))
+    t_start = time.perf_counter()
+    total_budget = int(os.environ.get("BENCH_TOTAL_BUDGET", "480"))
+
+    def remaining():
+        return total_budget - (time.perf_counter() - t_start)
+
+    # Attempt order is cache-aware: the unrolled config is the fastest at
+    # runtime (r3 record) but its cold compile is the longest; the scan
+    # config compiles one block. With a warm cache the unrolled config
+    # loads in seconds, so it goes first. On a cold cache, scan+names
+    # goes first to get A headline safely, then unrolled runs with the
+    # remaining budget and the parent reports the best.
+    state = _load_state()
+    unrolled = {}  # default env: scan=0 remat=false
+    scan_cfg = {"BENCH_REMAT": "names", "BENCH_SCAN": "1"}
     pinned = "BENCH_REMAT" in os.environ or "BENCH_SCAN" in os.environ
-    attempts = [{}] if pinned else [
-        {},  # fastest measured config (unrolled, no remat)
-        {"BENCH_REMAT": "names", "BENCH_SCAN": "1"},  # compile fallback
-    ]
+    if pinned:
+        attempts = [{}]
+    elif "headline scan=False remat=false" in state:
+        attempts = [unrolled, scan_cfg]
+    else:
+        attempts = [scan_cfg, unrolled]
+
+    def _last_json(lines, pred):
+        got = None
+        for line in lines:
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if pred(cand):
+                got = cand
+        return got
+
+    def _evidence(json_lines, err_tail):
+        # bounded per-string so the diagnostic JSON can never be cut
+        # mid-structure into unparseable output
+        return [s[:300] for s in (json_lines[-1:] or err_tail[-3:])]
+
+    best = None
     failures = []
     for extra in attempts:
-        env = dict(os.environ)
-        env["BENCH_CHILD"] = "1"
-        env.update(extra)
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                timeout=attempt_budget, capture_output=True)
-        except subprocess.TimeoutExpired:
-            failures.append(f"attempt {extra or 'default'}: killed after "
-                            f"{attempt_budget}s (compile hung)")
-            continue
-        out = proc.stdout.decode(errors="replace")
-        line = next((l for l in reversed(out.splitlines())
-                     if l.startswith("{")), None)
-        if proc.returncode == 0 and line:
-            result = json.loads(line)
-            # flagship side metric in its OWN bounded subprocess: the
-            # headline line above is already safe in hand
-            if result.get("value", 0) > 0 and result.get("on_tpu") and \
-                    os.environ.get("BENCH_1P3B", "1") == "1":
-                b13 = int(os.environ.get("BENCH_1P3B_TIMEOUT", "600"))
-                # "dots" (the sweep winner) first; full remat as the
-                # fallback — its compile is more robust when the remote
-                # compile helper is congested (observed 2026-07-31:
-                # the identical dots config compiled in 118 s at one
-                # hour and hung >12 min the next)
-                for remat13 in ("dots", "true"):
-                    env13 = dict(os.environ)
-                    env13["BENCH_CHILD"] = "1"
-                    env13["BENCH_TASK"] = "1p3b"
-                    env13.setdefault("BENCH_1P3B_REMAT", remat13)
-                    try:
-                        p13 = subprocess.run(
-                            [sys.executable, os.path.abspath(__file__)],
-                            env=env13, timeout=b13, capture_output=True)
-                        l13 = next((l for l in reversed(
-                            p13.stdout.decode(errors="replace")
-                            .splitlines()) if l.startswith("{")), None)
-                        if p13.returncode == 0 and l13:
-                            result.update(json.loads(l13))
-                            result.pop("gpt_1p3b_error", None)
-                            break
-                        result["gpt_1p3b_error"] = (
-                            l13 or p13.stderr.decode(
-                                errors="replace")[-200:])[:300]
-                    except subprocess.TimeoutExpired:
-                        result["gpt_1p3b_error"] = \
-                            f"timeout {b13}s (remat={remat13})"
-                    if "BENCH_1P3B_REMAT" in os.environ:
-                        break  # pinned by the operator: no fallback
-            result.setdefault("gpt_1p3b_tokens_per_sec", 0.0)
-            result.setdefault("gpt_1p3b_mfu", 0.0)
-            print(json.dumps(result))
-            return
-        failures.append(
-            f"attempt {extra or 'default'}: rc={proc.returncode} "
-            f"{(line or proc.stderr.decode(errors='replace')[-300:])[:400]}")
-    print(json.dumps({
-        "metric": "gpt_medium_train_tokens_per_sec_per_chip",
-        "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
-        "error": " | ".join(failures)[:900]}))
-    raise SystemExit(1)
+        if best is not None and remaining() < 90:
+            break  # keep what we have rather than risk the budget
+        if best is not None and not best.get("on_tpu"):
+            break  # off-TPU the configs are identical smoke runs
+        budget = max(60, min(int(os.environ.get(
+            "BENCH_ATTEMPT_TIMEOUT", "300")), remaining() - 30))
+        env_view = dict(os.environ)
+        env_view.update(extra)
+        tag = f"scan={env_view.get('BENCH_SCAN', '0')}" \
+              f",remat={env_view.get('BENCH_REMAT', 'false')}"
+        rc, json_lines, err_tail = _stream_child(
+            extra, budget, tee_json_to_stdout=(best is None))
+        result = _last_json(
+            json_lines,
+            lambda c: c.get("metric") and c.get("value", 0) > 0)
+        if result:
+            if best is None or result["value"] > best["value"]:
+                best = result
+        else:
+            failures.append({
+                "attempt": tag, "rc": rc, "budget_s": round(budget),
+                "evidence": _evidence(json_lines, err_tail)})
+    if best is None:
+        print(json.dumps({
+            "metric": "gpt_medium_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": "all attempts failed (compile congestion?)",
+            "attempts": failures}), flush=True)
+        raise SystemExit(1)
+
+    # flagship side metric, strictly after the headline is safe and only
+    # with budget to spare; its JSON goes to stderr so a kill mid-run
+    # can never leave a metric-less fragment as the last stdout line
+    best.setdefault("gpt_1p3b_tokens_per_sec", 0.0)
+    best.setdefault("gpt_1p3b_mfu", 0.0)
+    if best.get("on_tpu") and os.environ.get("BENCH_1P3B", "1") == "1" \
+            and remaining() > 120:
+        b13 = max(60, min(int(os.environ.get("BENCH_1P3B_TIMEOUT", "420")),
+                          remaining() - 30))
+        env13 = {"BENCH_TASK": "1p3b"}
+        if "BENCH_1P3B_REMAT" not in os.environ:
+            env13["BENCH_1P3B_REMAT"] = "dots"  # round-4 sweep winner
+        rc, json_lines, err_tail = _stream_child(
+            env13, b13, tee_json_to_stdout=False)
+        got = _last_json(json_lines,
+                         lambda c: "gpt_1p3b_tokens_per_sec" in c)
+        if got:
+            best.update(got)
+        else:
+            best["gpt_1p3b_error"] = (
+                f"rc={rc} budget={round(b13)}s " +
+                " | ".join(_evidence(json_lines, err_tail)))[:300]
+    if failures:
+        best["attempt_failures"] = str(failures)[:500]
+    print(json.dumps(best), flush=True)
 
 
 if __name__ == "__main__":

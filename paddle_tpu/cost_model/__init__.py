@@ -55,17 +55,16 @@ class CostModel:
         return cost
 
     _OP_BENCH = {
-        # op name -> (fwd thunk builder, flops) at a reference size
-        "matmul": lambda jnp: (lambda a=jnp.ones((256, 256)),
-                               b=jnp.ones((256, 256)): a @ b),
-        "relu": lambda jnp: (lambda a=jnp.ones((256, 256)):
-                             jnp.maximum(a, 0)),
-        "softmax": lambda jnp: (lambda a=jnp.ones((256, 256)):
-                                __import__("jax").nn.softmax(a)),
-        "elementwise_add": lambda jnp: (lambda a=jnp.ones((256, 256)):
-                                        a + a),
-        "mean": lambda jnp: (lambda a=jnp.ones((256, 256)):
-                             jnp.mean(a)),
+        # op name -> builder returning (fn(a), reference input)
+        "matmul": lambda jnp: ((lambda a: a @ a), jnp.ones((256, 256))),
+        "relu": lambda jnp: ((lambda a: jnp.maximum(a, 0)),
+                             jnp.ones((256, 256))),
+        "softmax": lambda jnp: ((lambda a: __import__("jax").nn.softmax(a)),
+                                jnp.ones((256, 256))),
+        "elementwise_add": lambda jnp: ((lambda a: a + a),
+                                        jnp.ones((256, 256))),
+        "mean": lambda jnp: ((lambda a: jnp.mean(a)),
+                             jnp.ones((256, 256))),
     }
 
     def static_cost_data(self):
@@ -77,28 +76,22 @@ class CostModel:
         import jax.numpy as jnp
         table = []
         for name, builder in self._OP_BENCH.items():
-            fn = builder(jnp)
+            fn, x = builder(jnp)
             jit_fn = jax.jit(fn)
-            jax.block_until_ready(jit_fn())  # compile
+            jax.block_until_ready(jit_fn(x))  # compile
             t0 = time.perf_counter()
             for _ in range(10):
-                out = jit_fn()
+                out = jit_fn(x)
             jax.block_until_ready(out)
             dt_ms = (time.perf_counter() - t0) / 10 * 1e3
 
-            def grad_scalar(*a):
-                return jnp.sum(fn(*a))
-
-            jit_bwd = jax.jit(jax.grad(grad_scalar))
-            try:
-                jax.block_until_ready(jit_bwd())
-                t0 = time.perf_counter()
-                for _ in range(10):
-                    g = jit_bwd()
-                jax.block_until_ready(g)
-                bwd_ms = (time.perf_counter() - t0) / 10 * 1e3
-            except Exception:
-                bwd_ms = dt_ms
+            jit_bwd = jax.jit(jax.grad(lambda a: jnp.sum(fn(a))))
+            jax.block_until_ready(jit_bwd(x))
+            t0 = time.perf_counter()
+            for _ in range(10):
+                g = jit_bwd(x)
+            jax.block_until_ready(g)
+            bwd_ms = (time.perf_counter() - t0) / 10 * 1e3
             table.append({
                 "op": name,
                 "config": "float32 [256, 256]",

