@@ -1,20 +1,80 @@
 """paddle.distributed.spawn parity (python/paddle/distributed/spawn.py).
 
-In the single-controller SPMD model one process drives every local chip,
-so spawn degenerates to calling the function once with the parallel env
-initialized — the semantics user code observes (func sees a world with
-all devices) are preserved.
+Default (nprocs<=1): the single-controller SPMD model — one process
+drives every local chip, so spawn degenerates to calling the function
+once with the parallel env initialized; user code observes the same
+semantics (func sees a world with all devices).
+
+nprocs>1: real multi-process spawn (the reference's per-GPU-process
+model, useful on the CPU backend and for multi-host-style testing) —
+each child joins a jax.distributed world over a loopback coordinator
+before running func, exactly the wiring `paddle_tpu.distributed.launch`
+sets up for script-level ranks.
 """
+import os
+import socket
+
 from .env import init_parallel_env
 
 __all__ = ["spawn"]
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    init_parallel_env()
-    result = func(*args)
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
-    class _Context:
-        def join(self):
-            return result
-    return _Context()
+
+def _spawn_worker(func, args, rank, nprocs, coordinator):
+    # bootstrap env BEFORE any jax import in the child touches a backend
+    os.environ["PADDLE_TPU_COORDINATOR"] = coordinator
+    os.environ["PADDLE_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["PADDLE_TPU_PROCESS_ID"] = str(rank)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    init_parallel_env()
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs is None or nprocs <= 1:
+        init_parallel_env()
+        result = func(*args)
+
+        class _Context:
+            processes = []
+
+            def join(self, timeout=None):
+                return result
+        return _Context()
+
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, args, rank, nprocs, coordinator),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class _MPContext:
+        processes = procs
+
+        def join(self, timeout=None):
+            for p in procs:
+                p.join(timeout)
+            bad = [(i, p.exitcode) for i, p in enumerate(procs)
+                   if p.exitcode not in (0, None)]
+            if bad:
+                raise RuntimeError(
+                    f"spawn: ranks failed (rank, exitcode): {bad}")
+            return all(p.exitcode == 0 for p in procs)
+
+    c = _MPContext()
+    if join:
+        c.join()
+    return c

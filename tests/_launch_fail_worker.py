@@ -1,0 +1,37 @@
+"""Rank-behavior worker for launcher supervision tests (no jax import —
+these exercise the supervisor itself, not the collective stack).
+
+argv: MODE OUTDIR
+  MODE=fail1    rank 1 exits 1 immediately; other ranks sleep 120 s
+                (the launcher must reap them)
+  MODE=elastic  every rank exits 1 on the first launch
+                (PADDLE_RESTART_COUNT=0) and succeeds on the restart
+"""
+import os
+import sys
+import time
+
+
+def main():
+    mode, outdir = sys.argv[1], sys.argv[2]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    with open(os.path.join(outdir, f"started.{rank}.{restart}"), "w"):
+        pass
+    if mode == "fail1":
+        if rank == 1:
+            print(f"rank {rank}: failing deliberately", flush=True)
+            sys.exit(1)
+        time.sleep(120)  # must be reaped by the launcher, not finish
+    elif mode == "elastic":
+        if restart == 0:
+            print(f"rank {rank}: first-launch failure", flush=True)
+            sys.exit(1)
+        with open(os.path.join(outdir, f"done.{rank}"), "w") as f:
+            f.write(f"restart={restart}\n")
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,93 @@ def test_two_process_launch(tmp_path):
                                   np.asarray(results[1]["w"]))
     # and both ranks observed the same loss trajectory
     assert results[0]["losses"] == results[1]["losses"]
+
+
+# ------------------------------------------------------------- round 5:
+# the real launcher CLI (reference fleet/launch.py arg surface,
+# supervision, per-rank logs, elastic gang restart)
+FAIL_WORKER = os.path.join(REPO, "tests", "_launch_fail_worker.py")
+
+
+def _cli_env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("XLA_", "JAX_"))}
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def test_single_launcher_two_ranks_with_logs(tmp_path):
+    """ONE `launch --nproc_per_node 2` invocation supervises both ranks:
+    same collective/DP assertions as the two-launcher test, plus
+    per-rank workerlog files."""
+    logdir = tmp_path / "logs"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(logdir),
+         WORKER, str(tmp_path)],
+        env=_cli_env(), cwd=REPO, timeout=180,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    assert proc.returncode == 0, proc.stdout.decode(errors="replace")[-3000:]
+    results = {}
+    for rank in (0, 1):
+        with open(tmp_path / f"rank{rank}.json") as f:
+            results[rank] = json.load(f)
+        assert (logdir / f"workerlog.{rank}").exists()
+    assert results[0]["world"] == 2
+    assert results[0]["psum"] == pytest.approx(1.0)
+    np.testing.assert_array_equal(np.asarray(results[0]["w"]),
+                                  np.asarray(results[1]["w"]))
+
+
+def test_launch_reaps_gang_on_rank_failure(tmp_path):
+    """rank 1 exits 1; the launcher must kill the (sleeping) rank 0,
+    report the failing rank + its log tail, and exit nonzero fast."""
+    import time as _time
+    logdir = tmp_path / "logs"
+    t0 = _time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(logdir),
+         FAIL_WORKER, "fail1", str(tmp_path)],
+        env=_cli_env(), cwd=REPO, timeout=90,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    took = _time.time() - t0
+    assert proc.returncode == 1
+    assert took < 60, f"reap took {took}s — rank 0 slept to completion?"
+    err = proc.stderr.decode(errors="replace")
+    assert "rank 1 exited with code 1" in err
+    assert "failing deliberately" in err  # log tail surfaced
+    assert (tmp_path / "started.0.0").exists()
+    assert (tmp_path / "started.1.0").exists()
+
+
+def test_launch_elastic_gang_restart(tmp_path):
+    """all ranks fail on first launch; --max_restarts 1 relaunches the
+    gang (PADDLE_RESTART_COUNT=1) and the job succeeds."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restarts", "1",
+         "--log_dir", str(tmp_path / "logs"),
+         FAIL_WORKER, "elastic", str(tmp_path)],
+        env=_cli_env(), cwd=REPO, timeout=90,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    err = proc.stderr.decode(errors="replace")
+    assert proc.returncode == 0, err[-2000:]
+    assert "elastic restart 1/1" in err
+    assert (tmp_path / "done.0").exists()
+    assert (tmp_path / "done.1").exists()
+    assert (tmp_path / "started.0.1").exists()  # second generation ran
+
+
+def test_spawn_multiprocess():
+    """paddle.distributed.spawn(nprocs=2): two real processes join a
+    jax.distributed world and each sees world_size 2."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "_spawn_worker.py")],
+        env=_cli_env(), cwd=REPO, timeout=180,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count("world=2") == 2, out
