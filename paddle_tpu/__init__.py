@@ -14,6 +14,14 @@ import jax as _jax
 # so this does not drag float64 onto the MXU.
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: every paddle_tpu.jit / static.Executor /
+# HybridTrainStep compile in any process is written to (and reloaded from)
+# disk, so warm processes skip the cold compile. PADDLE_TPU_COMPILE_CACHE
+# points it elsewhere or disables it ("0"); see framework/compile_cache.py.
+from .framework.compile_cache import enable_compile_cache as _enable_cc
+
+_enable_cc()
+
 from .framework import (Tensor, Parameter, to_tensor, no_grad, enable_grad,
                         set_grad_enabled, is_grad_enabled, seed,
                         get_rng_state, set_rng_state,

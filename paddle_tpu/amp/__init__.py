@@ -288,3 +288,57 @@ class GradScaler:
         self._scale = sd["scale"]
         self._good_steps = sd["good_steps"]
         self._bad_steps = sd["bad_steps"]
+
+    # -- functional state for the fused jit train step -------------------
+    # TrainStep/HybridTrainStep carry this state as device arrays inside
+    # the single compiled step (and DONATE it, like params and optimizer
+    # state), so dynamic loss scaling costs no host sync per step: the
+    # found_inf skip and the scale adaptation are branchless jnp.where
+    # selects inside the XLA program.
+
+    def init_jit_state(self):
+        """Device-array scaler state for the jitted step. The pytree
+        shape is stable across steps (donation-compatible)."""
+        return {"scale": jnp.asarray(self._scale, jnp.float32),
+                "good_steps": jnp.asarray(self._good_steps, jnp.int32),
+                "bad_steps": jnp.asarray(self._bad_steps, jnp.int32)}
+
+    def jit_unscale_and_update(self, state, grads):
+        """Pure (call under jit): unscale `grads` by state['scale'],
+        detect non-finite gradients, and advance the dynamic-scaling
+        state. Returns (unscaled_grads, found_inf, new_state); the
+        caller passes found_inf to Optimizer.apply_gradients_tree so an
+        overflow step updates nothing (reference: update_loss_scaling
+        op + check_finite_and_unscale, fluid/operators/amp/)."""
+        import jax
+        if not self._enable:
+            return grads, jnp.asarray(False), state
+        inv = 1.0 / state["scale"]
+        leaves = jax.tree.leaves(grads)
+        found = jnp.asarray(False)
+        for g in leaves:
+            found = jnp.logical_or(found, jnp.any(~jnp.isfinite(
+                g.astype(jnp.float32))))
+        grads = jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        if not self._dynamic:
+            return grads, found, state
+        good = jnp.where(found, 0, state["good_steps"] + 1)
+        bad = jnp.where(found, state["bad_steps"] + 1, 0)
+        incr = good >= self._incr_every
+        decr = bad >= self._decr_every
+        scale = jnp.where(
+            decr, jnp.maximum(state["scale"] * self._decr_ratio, 1.0),
+            jnp.where(incr, state["scale"] * self._incr_ratio,
+                      state["scale"]))
+        new_state = {"scale": scale,
+                     "good_steps": jnp.where(incr, 0, good),
+                     "bad_steps": jnp.where(decr, 0, bad)}
+        return grads, found, new_state
+
+    def sync_from_jit_state(self, state):
+        """Pull the carried device state back into the eager scaler
+        (checkpointing via state_dict after jitted training)."""
+        self._scale = float(state["scale"])
+        self._good_steps = int(state["good_steps"])
+        self._bad_steps = int(state["bad_steps"])

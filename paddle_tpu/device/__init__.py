@@ -12,7 +12,9 @@ __all__ = ["set_device", "get_device", "get_all_devices", "device_count",
            "is_compiled_with_cuda", "is_compiled_with_rocm",
            "is_compiled_with_xpu", "is_compiled_with_npu",
            "is_compiled_with_tpu", "synchronize", "get_device_properties",
-           "cuda", "Stream", "Event"]
+           "cuda", "Stream", "Event",
+           "max_memory_allocated", "memory_allocated",
+           "max_memory_reserved", "memory_reserved"]
 
 _current = None
 
@@ -90,14 +92,66 @@ def synchronize(device=None):
     (jax.device_put(0) + 0).block_until_ready()
 
 
-def get_device_properties(device=None):
-    d = _current or _default_device()
-    stats = {}
+def _memory_stats(device=None):
+    """jax.Device.memory_stats() for the selected device, {} when the
+    backend exposes no allocator stats (CPU). Resolves "kind:idx"
+    strings and plain int device ids (the common Paddle convention)
+    WITHOUT touching the set_device global."""
+    if isinstance(device, (str, int)):
+        devs = jax.devices()
+        if isinstance(device, int):
+            idx = device
+        else:
+            idx = int(device.split(":")[1]) if ":" in device else 0
+        d = devs[idx % len(devs)]
+    elif device is not None:
+        d = device
+    else:
+        d = _current or _default_device()
     if hasattr(d, "memory_stats"):
         try:
-            stats = d.memory_stats() or {}
+            return d.memory_stats() or {}
         except Exception:
-            stats = {}
+            return {}
+    return {}
+
+
+def max_memory_allocated(device=None):
+    """Peak bytes of device memory held by live buffers since process
+    start (parity: paddle.device.cuda.max_memory_allocated). Backed by
+    jax.Device.memory_stats()['peak_bytes_in_use'] — on TPU this is the
+    HBM high-water mark, the number that proves a donated train step is
+    NOT holding a second full copy of the model. The CPU backend exposes
+    no allocator stats, so the process peak RSS stands in (keeps the API
+    returning sane nonzero values everywhere)."""
+    peak = _memory_stats(device).get("peak_bytes_in_use", 0)
+    if not peak:
+        import resource
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    return int(peak)
+
+
+def memory_allocated(device=None):
+    """Bytes of device memory currently held by live buffers."""
+    return int(_memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None):
+    """Peak bytes the allocator reserved from the device (>= allocated)."""
+    stats = _memory_stats(device)
+    return int(stats.get("peak_bytes_reserved",
+                         stats.get("peak_bytes_in_use", 0)))
+
+
+def memory_reserved(device=None):
+    """Bytes the allocator currently reserves from the device."""
+    stats = _memory_stats(device)
+    return int(stats.get("bytes_reserved", stats.get("bytes_in_use", 0)))
+
+
+def get_device_properties(device=None):
+    d = _current or _default_device()
+    stats = _memory_stats(device)
 
     class _Props:
         name = str(d)

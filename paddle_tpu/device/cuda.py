@@ -17,17 +17,16 @@ def synchronize(device=None):
 
 
 def max_memory_allocated(device=None):
-    d = _default_device()
-    if hasattr(d, "memory_stats"):
-        return d.memory_stats().get("peak_bytes_in_use", 0)
-    return 0
+    # single source of truth with paddle.device.max_memory_allocated
+    # (memory_stats() returns None on backends without allocator stats —
+    # the parent module handles that and the RSS fallback)
+    from . import max_memory_allocated as _impl
+    return _impl(device)
 
 
 def memory_allocated(device=None):
-    d = _default_device()
-    if hasattr(d, "memory_stats"):
-        return d.memory_stats().get("bytes_in_use", 0)
-    return 0
+    from . import memory_allocated as _impl
+    return _impl(device)
 
 
 def empty_cache():

@@ -84,7 +84,7 @@ class HybridTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh, recompute=False,
                  accumulate_steps=1, donate=True, param_dtype=None,
-                 sharding_stage=1):
+                 sharding_stage=1, scaler=None):
         """sharding_stage selects the ZeRO behavior over the 'sharding'
         mesh axis (ref sharding/sharding_stage2.py:43, sharding_stage3.py:51):
           1 — optimizer state sharded (grads allreduced, params replicated)
@@ -106,6 +106,14 @@ class HybridTrainStep:
             raise ValueError(f"sharding_stage must be 1|2|3, got "
                              f"{sharding_stage}")
         self._step_i = 0
+        # GradScaler state rides inside the compiled step (donated, like
+        # params/opt state); replicated over the mesh
+        self.scaler = scaler
+        self.scaler_state = scaler.init_jit_state() if scaler is not None \
+            else {}
+        self.retraces = 0
+        self.compile_s = 0.0
+        self.last_compile_s = None
 
         params, buffers = state_arrays(model)
         if param_dtype is not None:
@@ -172,7 +180,17 @@ class HybridTrainStep:
                 run = jax.checkpoint(run)
             return run(micro)
 
-        def step_fn(params_, opt_state_, bufs, key, lr, step_i, *batch):
+        scaler_ref = scaler
+
+        def step_fn(params_, opt_state_, scaler_state_, bufs, key, lr,
+                    step_i, *batch):
+            scaling = scaler_ref is not None and scaler_ref.is_enable()
+            scale = scaler_state_["scale"] if scaling else None
+
+            def objective(ps, micro):
+                l = loss_of(ps, bufs, key, micro)
+                return l.astype(jnp.float32) * scale if scaling else l
+
             if accumulate_steps > 1:
                 micros = [jnp.stack(jnp.split(b, accumulate_steps, axis=0))
                           for b in batch]
@@ -180,7 +198,7 @@ class HybridTrainStep:
                 def acc_body(carry, micro):
                     loss_sum, grads_sum = carry
                     l, g = jax.value_and_grad(
-                        lambda ps: loss_of(ps, bufs, key, micro))(params_)
+                        lambda ps: objective(ps, micro))(params_)
                     return (loss_sum + l,
                             jax.tree.map(jnp.add, grads_sum, g)), None
 
@@ -192,7 +210,14 @@ class HybridTrainStep:
                 grads = jax.tree.map(lambda g: g / accumulate_steps, grads)
             else:
                 loss, grads = jax.value_and_grad(
-                    lambda ps: loss_of(ps, bufs, key, batch))(params_)
+                    lambda ps: objective(ps, batch))(params_)
+
+            if scaling:
+                loss = loss / scale
+                grads, found_inf, new_scaler_state = \
+                    scaler_ref.jit_unscale_and_update(scaler_state_, grads)
+            else:
+                found_inf, new_scaler_state = None, scaler_state_
 
             if stage >= 2:
                 # ZeRO-2: pin gradients to the zero specs — the SPMD
@@ -206,8 +231,9 @@ class HybridTrainStep:
             from ...nn.clip import clip_grads_tree
             grads = clip_grads_tree(grads, opt._grad_clip)
             new_params, new_state = opt.apply_gradients_tree(
-                params_, grads, opt_state_, lr, step_i)
-            return loss, new_params, new_state
+                params_, grads, opt_state_, lr, step_i,
+                found_inf=found_inf)
+            return loss, new_params, new_state, new_scaler_state
 
         # mirror each state leaf's structure (tuple, or the
         # {master, state} dict init_leaf_state builds for multi_precision)
@@ -218,13 +244,30 @@ class HybridTrainStep:
                                      self.params[k])): _sh,
                 self.opt_state[k])
             for k in self.opt_state}
+        scaler_shardings = jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), self.scaler_state)
         self._jitted = jax.jit(
             step_fn,
-            donate_argnums=(0, 1) if donate else (),
+            donate_argnums=(0, 1, 2) if donate else (),
             out_shardings=(loss_sharding, self.param_shardings,
-                           state_shardings))
+                           state_shardings, scaler_shardings))
+
+    def _count_compile(self, t0):
+        import time
+        try:
+            n = self._jitted._cache_size()
+        except AttributeError:
+            return
+        prev = getattr(self, "_traced_total", 0)
+        if n > prev:
+            dt = time.perf_counter() - t0
+            self.retraces += n - prev
+            self.compile_s += dt
+            self.last_compile_s = dt
+            self._traced_total = n
 
     def __call__(self, *batch):
+        import time
         dp_only = NamedSharding(self.mesh, P(("dp",)))
         arrays = [jax.device_put(
             a, self.batch_sharding if a.ndim >= 2 else dp_only)
@@ -232,9 +275,12 @@ class HybridTrainStep:
                       for b in batch)]
         self._step_i += 1
         lr = self.optimizer.get_lr()
-        loss, self.params, self.opt_state = self._jitted(
-            self.params, self.opt_state, self.buffers, split_key(),
-            jnp.asarray(lr, jnp.float32), self._step_i, *arrays)
+        t0 = time.perf_counter()
+        loss, self.params, self.opt_state, self.scaler_state = self._jitted(
+            self.params, self.opt_state, self.scaler_state, self.buffers,
+            split_key(), jnp.asarray(lr, jnp.float32), self._step_i,
+            *arrays)
+        self._count_compile(t0)
         return Tensor(loss)
 
     def sync_to_model(self):
@@ -242,11 +288,14 @@ class HybridTrainStep:
         with no_grad():
             for k, v in self.params.items():
                 named[k]._slot = _Slot(v)
+        if self.scaler is not None and self.scaler_state:
+            self.scaler.sync_from_jit_state(self.scaler_state)
 
     def compiled_text(self, *batch):
         """Return the optimized HLO for inspection/tests."""
         arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         return self._jitted.lower(
-            self.params, self.opt_state, self.buffers, split_key(),
-            jnp.asarray(0.1, jnp.float32), 1, *arrays).compile().as_text()
+            self.params, self.opt_state, self.scaler_state, self.buffers,
+            split_key(), jnp.asarray(0.1, jnp.float32), 1,
+            *arrays).compile().as_text()

@@ -227,33 +227,41 @@ class HDFSClient(FS):
         return proc.returncode, out
 
     @staticmethod
-    def _test_cmd_failed(out):
-        """A clean "no" from `hadoop fs -test` is a bare nonzero exit;
-        hadoop also emits benign stderr noise (SLF4J/native-loader
-        WARNs, log4j 'ERROR StatusLogger' config complaints), so only a
-        java exception in the merged output marks a real cluster/exec
-        error (the reference likewise scans the output text rather than
-        trusting the exit code alone)."""
-        return any("Exception" in line and "No such file" not in line
-                   for line in out)
+    def _test_says_no(ret, out):
+        """FAIL CLOSED: only `hadoop fs -test` exit code 1 with benign
+        output is a clean "no". Hadoop emits benign stderr noise
+        (SLF4J/native-loader WARNs, log4j 'ERROR StatusLogger' config
+        complaints), so lines are benign unless they carry a java
+        exception. Any OTHER nonzero exit (JVM OOM 137, classpath 127,
+        generic failure 255, kerberos/cluster exceptions) must NOT be
+        read as "checkpoint absent" — a caller that trusts a false "no"
+        restarts training from scratch over a transient cluster error."""
+        if ret != 1:
+            return False
+        return not any("Exception" in line and "No such file" not in line
+                       for line in out)
 
     @_handle_errors()
     def is_exist(self, fs_path):
         ret, out = self._run_cmd(f"fs -test -e {fs_path}",
                                  redirect_stderr=True)
-        if ret != 0 and self._test_cmd_failed(out):
-            raise ExecuteError(
-                f"is_exist {fs_path}: " + "\n".join(out[:5]))
-        return ret == 0
+        if ret == 0:
+            return True
+        if self._test_says_no(ret, out):
+            return False
+        raise ExecuteError(
+            f"is_exist {fs_path}: rc={ret} " + "\n".join(out[:5]))
 
     @_handle_errors()
     def is_dir(self, fs_path):
         ret, out = self._run_cmd(f"fs -test -d {fs_path}",
                                  redirect_stderr=True)
-        if ret != 0 and self._test_cmd_failed(out):
-            raise ExecuteError(
-                f"is_dir {fs_path}: " + "\n".join(out[:5]))
-        return ret == 0
+        if ret == 0:
+            return True
+        if self._test_says_no(ret, out):
+            return False
+        raise ExecuteError(
+            f"is_dir {fs_path}: rc={ret} " + "\n".join(out[:5]))
 
     def is_file(self, fs_path):
         return self.is_exist(fs_path) and not self.is_dir(fs_path)

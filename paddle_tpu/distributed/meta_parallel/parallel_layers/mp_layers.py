@@ -127,14 +127,28 @@ class VocabParallelEmbedding(Layer):
 
 
 class ParallelCrossEntropy(Layer):
-    """Cross entropy over logits whose class dim is mp-sharded. With GSPMD
-    the plain softmax-xent composition is partitioned automatically (the
-    reference implements a custom c_softmax_with_cross_entropy op)."""
+    """Cross entropy over logits whose class dim is mp-sharded.
+
+    Routed through ops.chunked_xent.softmax_xent_logits: an explicit
+    'mp' sharding constraint pins the vocab dim of the logits to the
+    mesh, and the gold logit is a one-hot product-sum instead of a
+    gather — so the lowered program reduces PARTIAL max/sum/gold per
+    shard (scalar-per-token collectives) and never all-gathers the full
+    [*, V] logits. Plain `F.cross_entropy` here leaves the partitioner
+    free to replicate the logits, which at GPT vocab sizes is the
+    largest single tensor of the step (the reference implements the same
+    idea by hand as c_softmax_with_cross_entropy: per-shard id masking +
+    allreduce)."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+        from ....ops.chunked_xent import softmax_xent_logits
+        ignore = self.ignore_index
+
+        def fn(logits, y):
+            return softmax_xent_logits(logits, y, ignore_index=ignore,
+                                       shard_axis="mp")
+        return apply_op(fn, input, label)

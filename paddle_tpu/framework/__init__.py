@@ -7,6 +7,8 @@ from .dtype import (dtype, float16, bfloat16, float32, float64, int8, int16,
                     iinfo, finfo)
 from .random import seed, get_rng_state, set_rng_state, rng_scope, split_key
 from . import io
+from . import compile_cache
+from .compile_cache import enable_compile_cache, disable_compile_cache
 
 
 def __getattr__(name):

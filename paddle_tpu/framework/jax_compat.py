@@ -1,0 +1,23 @@
+"""Version shims over the jax surface the framework relies on.
+
+The framework targets the current jax API; older jaxlibs (0.4.x) ship
+the same functionality under different names. Every cross-version access
+goes through here so a version bump is a one-file change.
+"""
+import functools
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map  # jax >= 0.6
+except ImportError:
+    # jax 0.4.x: experimental location, and the replication-check kwarg
+    # is `check_rep` (renamed to `check_vma` upstream)
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    @functools.wraps(_shard_map_04)
+    def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kwargs):
+        return _shard_map_04(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=check_vma,
+                             **kwargs)

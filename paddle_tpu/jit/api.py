@@ -267,36 +267,58 @@ class TrainStep:
     """One fully-jitted training step: forward + loss + grads + optimizer.
 
     The TPU-native analogue of the reference's whole-program executor path:
-    everything — including the optimizer update — is a single XLA
-    computation; parameter/optimizer-state buffers are donated so updates
-    are in-place in HBM.
+    everything — including the optimizer update and (with `scaler=`) the
+    GradScaler's dynamic loss scaling — is a single XLA computation;
+    parameter, optimizer-state, and scaler-state buffers are DONATED so
+    XLA aliases input/output buffers and updates in place in HBM instead
+    of holding a second full copy of the model per step.
 
         step = TrainStep(model, loss_fn, optimizer)
         loss = step(x, y)          # device arrays stay resident
         step.sync_to_model()       # copy back into Parameters when needed
+
+    Compile observability (the warm-start contract the persistent compile
+    cache in framework/compile_cache.py is measured by):
+        step.retraces        # how many distinct programs were compiled
+        step.compile_s       # total seconds spent tracing+compiling
+        step.last_compile_s  # the most recent compile, seconds
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
-                 in_shardings=None, donate=True, model_returns_loss=False):
+                 in_shardings=None, donate=True, model_returns_loss=False,
+                 scaler=None):
         """model_returns_loss=True: the model's forward(*batch) IS the
         scalar loss (e.g. GPTForCausalLM.fused_loss via a wrapper) —
         loss_fn is ignored. Lets memory-fused loss formulations (chunked
-        vocab xent) run under the same jitted step."""
+        vocab xent) run under the same jitted step.
+
+        scaler: an amp.GradScaler whose dynamic loss scaling runs INSIDE
+        the compiled step (scaled loss, unscale, found_inf update skip,
+        scale adaptation) with its state donated alongside params."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.scaler = scaler
         self._model_returns_loss = model_returns_loss
         params, self.buffers = state_arrays(model)
-        # buffers are donated every step; take a private copy so the
+        # params are donated every step; take a private copy so the
         # model's own Parameters stay valid for eager use
         self.params = jax.tree.map(jnp.array, params)
         self.opt_state = jax.tree.map(
             lambda v: self.optimizer.init_leaf_state(v), self.params,
             is_leaf=lambda x: hasattr(x, "dtype"))
+        # an empty dict is a valid (leafless) donated pytree when no
+        # scaler rides along, keeping one step_fn signature
+        self.scaler_state = scaler.init_jit_state() if scaler is not None \
+            else {}
         self._step_i = 0
         self._mesh = mesh
+        self.retraces = 0
+        self.compile_s = 0.0
+        self.last_compile_s = None
 
-        def step_fn(params, opt_state, buffers, key, lr, step_i, *batch):
+        def step_fn(params, opt_state, scaler_state, buffers, key, lr,
+                    step_i, *batch):
             def loss_of(ps):
                 reset_aux_losses(model)
                 if model_returns_loss:
@@ -315,18 +337,47 @@ class TrainStep:
                 aux = collect_aux_losses(model)
                 return l if aux is None else l + aux.astype(l.dtype)
 
-            loss, grads = jax.value_and_grad(loss_of)(params)
+            if scaler is not None and scaler.is_enable():
+                scale = scaler_state["scale"]
+                scaled_loss, grads = jax.value_and_grad(
+                    lambda ps: loss_of(ps).astype(jnp.float32) * scale)(
+                        params)
+                loss = scaled_loss / scale
+                grads, found_inf, new_scaler_state = \
+                    scaler.jit_unscale_and_update(scaler_state, grads)
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(params)
+                found_inf, new_scaler_state = None, scaler_state
             from ..nn.clip import clip_grads_tree
             grads = clip_grads_tree(grads, self.optimizer._grad_clip)
             new_params, new_state = self.optimizer.apply_gradients_tree(
-                params, grads, opt_state, lr, step_i)
-            return loss, new_params, new_state
+                params, grads, opt_state, lr, step_i, found_inf=found_inf)
+            return loss, new_params, new_state, new_scaler_state
 
-        donate_argnums = (0, 1) if donate else ()
+        donate_argnums = (0, 1, 2) if donate else ()
         self._donate = donate
         self._step_fn = step_fn
         self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
         self._scan_jit = {}
+
+    def _count_compile(self, jitted, t0):
+        """Fold a just-returned dispatch into the retrace/compile
+        counters when it traced a new program (dispatch returns right
+        after compile under async execution, so the elapsed time is
+        trace+compile, not step runtime)."""
+        import time
+        try:
+            n = jitted._cache_size()
+        except AttributeError:
+            return
+        counts = self.__dict__.setdefault("_traced_counts", {})
+        prev = counts.get(id(jitted), 0)
+        if n > prev:
+            dt = time.perf_counter() - t0
+            self.retraces += n - prev
+            self.compile_s += dt
+            self.last_compile_s = dt
+            counts[id(jitted)] = n
 
     def run_steps(self, n, *batch, data_per_step=False):
         """Run `n` optimizer steps in ONE XLA dispatch (lax.scan over the
@@ -369,42 +420,55 @@ class TrainStep:
         if sig not in self._scan_jit:
             step_fn = self._step_fn
 
-            def multi(params, opt_state, buffers, key, lr, base, *arrs):
+            def multi(params, opt_state, scaler_state, buffers, key, lr,
+                      base, *arrs):
                 def body(carry, i):
-                    p, s = carry
+                    p, s, sc = carry
                     b = [a[i] for a in arrs] if data_per_step else list(arrs)
                     # step index as f32: `beta ** step` with a traced int
                     # promotes to f64 under x64, breaking the scan carry
-                    loss, p, s = step_fn(p, s, buffers,
-                                         jax.random.fold_in(key, i), lr,
-                                         (base + i).astype(jnp.float32), *b)
-                    return (p, s), loss
+                    loss, p, s, sc = step_fn(
+                        p, s, sc, buffers, jax.random.fold_in(key, i), lr,
+                        (base + i).astype(jnp.float32), *b)
+                    return (p, s, sc), loss
 
-                (p, s), losses = jax.lax.scan(body, (params, opt_state),
-                                              jnp.arange(n, dtype=jnp.int32))
-                return losses, p, s
+                (p, s, sc), losses = jax.lax.scan(
+                    body, (params, opt_state, scaler_state),
+                    jnp.arange(n, dtype=jnp.int32))
+                return losses, p, s, sc
 
             if len(self._scan_jit) >= 8:  # bound compile-cache growth
-                self._scan_jit.pop(next(iter(self._scan_jit)))
+                evicted = self._scan_jit.pop(next(iter(self._scan_jit)))
+                # drop its retrace-counter entry too: a later jit object
+                # could reuse the freed id and inherit a stale count
+                self.__dict__.setdefault("_traced_counts", {}).pop(
+                    id(evicted), None)
             self._scan_jit[sig] = jax.jit(
-                multi, donate_argnums=(0, 1) if self._donate else ())
+                multi, donate_argnums=(0, 1, 2) if self._donate else ())
         else:  # LRU: re-insert so cycling signatures don't thrash
             self._scan_jit[sig] = self._scan_jit.pop(sig)
-        losses, self.params, self.opt_state = self._scan_jit[sig](
-            self.params, self.opt_state, self.buffers, key, lr, base,
-            *arrays)
+        import time
+        t0 = time.perf_counter()
+        losses, self.params, self.opt_state, self.scaler_state = \
+            self._scan_jit[sig](
+                self.params, self.opt_state, self.scaler_state,
+                self.buffers, key, lr, base, *arrays)
+        self._count_compile(self._scan_jit[sig], t0)
         self._step_i += n
         return Tensor(losses)
 
     def __call__(self, *batch):
+        import time
         arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         self._step_i += 1
         key = split_key()
         lr = self.optimizer.get_lr()
-        loss, self.params, self.opt_state = self._jitted(
-            self.params, self.opt_state, self.buffers, key,
-            jnp.asarray(lr, jnp.float32), self._step_i, *arrays)
+        t0 = time.perf_counter()
+        loss, self.params, self.opt_state, self.scaler_state = self._jitted(
+            self.params, self.opt_state, self.scaler_state, self.buffers,
+            key, jnp.asarray(lr, jnp.float32), self._step_i, *arrays)
+        self._count_compile(self._jitted, t0)
         return Tensor(loss)
 
     def sync_to_model(self):
@@ -412,3 +476,15 @@ class TrainStep:
         with no_grad():
             for k, v in self.params.items():
                 named[k]._slot = _Slot(v)
+        if self.scaler is not None and self.scaler_state:
+            self.scaler.sync_from_jit_state(self.scaler_state)
+
+    def compiled_text(self, *batch):
+        """Optimized HLO of the per-step executable (inspection/tests:
+        the donation proof greps input_output_alias entries here)."""
+        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        return self._jitted.lower(
+            self.params, self.opt_state, self.scaler_state, self.buffers,
+            split_key(), jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+            self._step_i + 1, *arrays).compile().as_text()

@@ -25,7 +25,54 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["chunked_softmax_xent"]
+__all__ = ["chunked_softmax_xent", "softmax_xent_logits"]
+
+
+def softmax_xent_logits(logits, labels, ignore_index=-100,
+                        shard_axis=None):
+    """Per-token softmax cross-entropy from materialized logits,
+    formulated GATHER-FREE: the gold logit is `sum(one_hot(y) * logits)`
+    instead of a take_along_axis. Under GSPMD with the vocab dim sharded
+    (`shard_axis='mp'`), that is the difference between a partial
+    product-sum per shard (+ a tiny cross-shard add, like the logsumexp
+    reductions) and a dynamic gather the partitioner can only lower by
+    ALL-GATHERING the full [N, V] logits to every device. A sharding
+    constraint is applied on the vocab dim so the partitioner keeps the
+    logits distributed through the whole loss (the mechanism behind
+    ParallelCrossEntropy; reference counterpart:
+    c_softmax_with_cross_entropy, which masks per-shard ids and
+    allreduces by hand).
+
+    logits: [..., V] float; labels: int [...] (ignore_index masks).
+    Returns per-token loss [...] in f32, 0.0 at masked positions.
+    """
+    def constrain(arr):
+        if shard_axis is None:
+            return arr
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..distributed.env import get_mesh
+            if isinstance(arr, jax.core.Tracer):
+                spec = P(*([None] * (arr.ndim - 1) + [shard_axis]))
+                return lax.with_sharding_constraint(
+                    arr, NamedSharding(get_mesh(), spec))
+        except Exception:
+            pass
+        return arr
+
+    v = logits.shape[-1]
+    lg = constrain(logits).astype(jnp.float32)
+    lg = constrain(lg)
+    m = lax.stop_gradient(jnp.max(lg, axis=-1, keepdims=True))
+    lse = jnp.log(jnp.sum(jnp.exp(lg - m), axis=-1)) + jnp.squeeze(m, -1)
+    y = labels.astype(jnp.int32)
+    if y.ndim == lg.ndim:  # [..., 1]-style labels
+        y = jnp.squeeze(y, -1)
+    valid = y != ignore_index
+    safe = jnp.where(valid, y, 0)
+    onehot = constrain(jax.nn.one_hot(safe, v, dtype=jnp.float32))
+    gold = jnp.sum(onehot * lg, axis=-1)
+    return jnp.where(valid, lse - gold, 0.0)
 
 
 def _pick_chunk(n, target=2048):

@@ -17,7 +17,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..framework.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["ring_attention", "ring_attention_arrays"]

@@ -234,8 +234,14 @@ class Optimizer:
                             is_leaf=lambda x: hasattr(x, "dtype"))
 
     def apply_gradients_tree(self, params_tree, grads_tree, state_tree, lr,
-                             step):
+                             step, found_inf=None):
         """Pure: returns (new_params, new_state). Call under jit.
+
+        `found_inf` (a traced bool from GradScaler.jit_unscale_and_update)
+        turns the whole update into a branchless skip: every param and
+        state leaf keeps its old value when the step overflowed, so the
+        fp16 loss-scaling semantics survive inside one donated XLA step
+        with no host sync.
 
         Dtype-stable by construction: the update math runs in float32
         (bf16 moments/gradients would lose the (1-beta) tail), then the
@@ -296,6 +302,11 @@ class Optimizer:
         new_p, new_s = [], []
         for i, (p, g, s) in enumerate(zip(flat_p, flat_g, flat_s)):
             np_, ns_ = upd(p, g, s, i)
+            if found_inf is not None:
+                np_ = jnp.where(found_inf, p, np_)
+                ns_ = jax.tree.map(
+                    lambda new, old: jnp.where(found_inf, old, new)
+                    if hasattr(old, "dtype") else new, ns_, s)
             new_p.append(np_)
             new_s.append(ns_)
         return treedef.unflatten(new_p), treedef.unflatten(new_s)

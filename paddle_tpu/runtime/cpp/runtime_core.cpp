@@ -9,8 +9,13 @@
 //
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
 #include <atomic>
+#include <cctype>
+#include <cerrno>
 #include <charconv>
+#include <cmath>
+#include <locale.h>
 #include <chrono>
+#include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -172,6 +177,13 @@ struct MSDataset {
 
 namespace {
 
+// number parsing must be locale-independent (an embedding host may have
+// set a comma-decimal LC_NUMERIC); one cached "C" locale for strtof_l
+locale_t c_numeric_locale() {
+    static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+    return loc;
+}
+
 // Parse one chunk of complete lines into a thread-local shard.
 // Returns false on malformed input. One record per line: a line with
 // missing/extra slots is an error (like the reference's CheckFile),
@@ -211,10 +223,30 @@ bool ms_parse_chunk(const char* p, const char* end, int n_slots,
                     p = r.ptr;
                     sl.ivals.push_back(v);
                 } else {
-                    float v = 0.f;
-                    auto r = std::from_chars(p, end, v);
-                    if (r.ec != std::errc()) return false;
-                    p = r.ptr;
+                    // strtof_l in the "C" locale, not std::from_chars:
+                    // libstdc++ < 11 ships no floating-point from_chars
+                    // overload, and plain strtof would misparse
+                    // '.'-decimal data under a comma-decimal LC_NUMERIC
+                    // set by an embedding host. The buffer is not
+                    // NUL-terminated mid-chunk, but every chunk ends at
+                    // a record boundary ('\n' <= end), so the parse
+                    // always stops before running past `end`.
+                    // strtof skips ANY leading whitespace (\n/\v/\f
+                    // included) — guard so a short line can never
+                    // silently consume a number from the next record
+                    if (std::isspace(static_cast<unsigned char>(*p)))
+                        return false;
+                    char* stop = nullptr;
+                    errno = 0;
+                    float v = strtof_l(p, &stop, c_numeric_locale());
+                    // ERANGE alone is not an error: glibc sets it on
+                    // underflow to a (valid) subnormal too — only
+                    // overflow to +/-HUGE_VALF is malformed input
+                    if (stop == p || stop > end ||
+                        (errno == ERANGE &&
+                         (v == HUGE_VALF || v == -HUGE_VALF)))
+                        return false;
+                    p = stop;
                     sl.fvals.push_back(v);
                 }
             }

@@ -40,15 +40,21 @@ def array_write(x, i, array=None):
     ``write_to_array`` op whose own docstring writes at subscript 10 of
     a fresh array (reference fluid/layers/control_flow.py:1479 — the
     result is "a LoDTensorArray with length 11"). Gap slots are filled
-    with empty tensors of ``x``'s dtype (the reference leaves them
-    uninitialized). Returns the (possibly new) array."""
+    with ZEROS of the written tensor's shape and dtype — the reference
+    leaves them uninitialized, but a 0-length filler makes stack/concat
+    over the array blow up far from the write site with a shape error
+    that names no culprit. Returns the (possibly new) array."""
     if array is None:
         array = []
     idx = _index(i)
     if idx < 0:
         raise IndexError(f"array_write position {idx} is negative")
-    while idx > len(array):
-        array.append(Tensor(np.zeros((0,), _np_dtype_of(x))))
+    if idx > len(array):
+        # one zero buffer shared (immutably) by every gap slot — a
+        # per-slot allocation would cost gap_count * sizeof(x)
+        fill = _zeros_like_written(x)
+        while idx > len(array):
+            array.append(Tensor(fill.value))
     if idx == len(array):
         array.append(x)
     else:
@@ -56,11 +62,17 @@ def array_write(x, i, array=None):
     return array
 
 
-def _np_dtype_of(x):
-    try:
-        return np.dtype(str(x.dtype).replace("paddle.", ""))
-    except Exception:
-        return np.float32
+def _zeros_like_written(x):
+    """A zero filler matching ``x``'s shape and dtype. Goes through the
+    value's own jax dtype — np.dtype(str(...)) mangles bfloat16 (numpy
+    has no such dtype; str round-trips produced float32 fillers that
+    poisoned later concat/stack dtype promotion)."""
+    import jax.numpy as jnp
+
+    if isinstance(x, Tensor):
+        return Tensor(jnp.zeros(tuple(x.shape), x.value.dtype))
+    arr = np.asarray(x)
+    return Tensor(np.zeros(arr.shape, arr.dtype))
 
 
 def create_array(dtype, initialized_list=None):

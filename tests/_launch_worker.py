@@ -38,9 +38,11 @@ def main():
     mesh = Mesh(np.array(jax.devices()), ("dp",))
 
     # 1. cross-process collective: psum of the rank id
+    from paddle_tpu.framework.jax_compat import shard_map
+
     @jax.jit
     def allsum(x):
-        return jax.shard_map(
+        return shard_map(
             lambda v: jax.lax.psum(v, "dp"), mesh=mesh,
             in_specs=P("dp"), out_specs=P())(x)
 

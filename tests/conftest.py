@@ -8,6 +8,12 @@ import os
 
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The framework-level persistent compile cache (framework/compile_cache.py)
+# stays OFF for the in-process suite — see the NOTE below on CPU AOT
+# reloads, and an inherited user cache dir must not be polluted by test
+# processes. Unconditional: subprocess tests that exercise the cache set
+# the env var explicitly in their child environments.
+os.environ["PADDLE_TPU_COMPILE_CACHE"] = "0"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -15,6 +15,8 @@ import pytest
 import paddle_tpu as paddle
 import jax.numpy as jnp
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def _make_batch(i, n=8, d=16):
     rs = np.random.RandomState(i)
@@ -54,7 +56,11 @@ def _train_steps(level, dtype, steps=3, use_scaler=None):
 @pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
 def test_eager_amp_trains(level, dtype):
     model, losses, grad_dtypes = _train_steps(level, dtype, steps=4)
-    assert losses[-1] < losses[0], losses
+    # steps alternate two batches: compare like-for-like (step i vs i+2
+    # revisits the same batch) — a cross-batch compare only held by
+    # initialization luck
+    assert losses[2] < losses[0], losses
+    assert losses[3] < losses[1], losses
     # grads land in the parameter dtype (master-weight semantics live in
     # the optimizer): O1 params stay f32, O2 params are the low dtype
     expect = np.dtype("float32") if level == "O1" else np.dtype(dtype)

@@ -9,6 +9,8 @@ import pytest
 
 import paddle_tpu as paddle
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 # ------------------------------------------------------------------ fft
 

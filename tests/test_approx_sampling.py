@@ -8,6 +8,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 
 def _gen(approx, top_k=None, top_p=None, vocab=16384, temperature=1.0):
     os.environ["PADDLE_TPU_APPROX_SAMPLING"] = "1" if approx else "0"

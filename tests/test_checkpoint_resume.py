@@ -12,6 +12,8 @@ from paddle_tpu.distributed.checkpoint import (save_train_state,
                                                load_train_state)
 from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 
 def _loss_fn():
     def f(out, y):

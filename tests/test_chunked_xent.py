@@ -12,6 +12,9 @@ import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu.ops.chunked_xent import chunked_softmax_xent
+import pytest
+
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
 
 
 def _ref(h, w, y):

@@ -1,0 +1,115 @@
+"""Framework-level persistent compile cache (framework/compile_cache.py).
+
+The acceptance proof for the warm-start contract: the same jitted train
+step in two SEPARATE processes, sharing only the on-disk cache dir — the
+second process must skip the cold compile (compile_s well under the 15 s
+bound; on TPU the same mechanism turns a 60 s+ GPT compile into a
+seconds-long cache load).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import json
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.jit import TrainStep
+
+paddle.seed(0)
+m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+step = TrainStep(
+    m, lambda out, y: nn.functional.cross_entropy(out, y), o)
+x = paddle.to_tensor(
+    np.random.RandomState(0).randn(4, 16).astype(np.float32))
+y = paddle.to_tensor(np.arange(4, dtype=np.int64) % 8)
+float(step(x, y).item())
+print(json.dumps({
+    "compile_s": step.compile_s,
+    "retraces": step.retraces,
+    "cache_dir": __import__(
+        "paddle_tpu.framework.compile_cache",
+        fromlist=["cache_dir"]).cache_dir(),
+}))
+"""
+
+
+def _run_child(cache_dir):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PADDLE_TPU_COMPILE_CACHE": str(cache_dir),
+        "PYTHONUNBUFFERED": "1",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_second_process_skips_cold_compile(tmp_path):
+    cache = tmp_path / "xla_cache"
+    first = _run_child(cache)
+    assert first["cache_dir"] == str(cache)
+    assert first["retraces"] == 1
+    entries = [n for n in os.listdir(cache) if not n.startswith(".")]
+    assert entries, "first process wrote no cache entries"
+    second = _run_child(cache)
+    # the acceptance bound: a warm process must never pay a cold compile
+    assert second["compile_s"] < 15, second
+    assert os.listdir(cache), "cache dir vanished"
+
+
+def test_enable_disable_and_env_knobs(tmp_path):
+    prev = compile_cache.cache_dir()
+    try:
+        d = compile_cache.enable_compile_cache(str(tmp_path / "cc"))
+        assert d == str(tmp_path / "cc") and os.path.isdir(d)
+        assert compile_cache.cache_dir() == d
+        assert jax.config.jax_compilation_cache_dir == d
+        # "0" and friends disable
+        assert compile_cache.enable_compile_cache("0") is None
+        assert compile_cache.cache_dir() is None
+        compile_cache.disable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir is None
+    finally:
+        if prev:
+            compile_cache.enable_compile_cache(prev)
+        else:
+            compile_cache.disable_compile_cache()
+
+
+def test_respects_preconfigured_jax_dir(tmp_path):
+    """bench.py configures jax's cache before importing the framework;
+    framework init must keep that dir, not clobber it with the default
+    (no env var, no explicit path)."""
+    prev = compile_cache.cache_dir()
+    prev_env = os.environ.pop("PADDLE_TPU_COMPILE_CACHE", None)
+    try:
+        pre = str(tmp_path / "pre")
+        os.makedirs(pre)
+        jax.config.update("jax_compilation_cache_dir", pre)
+        assert compile_cache.enable_compile_cache() == pre
+    finally:
+        if prev_env is not None:
+            os.environ["PADDLE_TPU_COMPILE_CACHE"] = prev_env
+        if prev:
+            compile_cache.enable_compile_cache(prev)
+        else:
+            compile_cache.disable_compile_cache()

@@ -10,6 +10,8 @@ import pytest
 import paddle_tpu as paddle
 import paddle_tpu.vision.ops as ops
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 class TestPSRoIPool:
     def test_matches_naive_loop(self):

@@ -14,6 +14,8 @@ from paddle_tpu.distributed.meta_parallel import (PipelineLayer,
                                                   LayerDesc)
 from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def make_loss_fn():
     def loss_fn(out, y):
@@ -217,8 +219,9 @@ class TestCollectivesAPI:
 
         def f(x):
             return psum(x, "dp")
-        out = jax.shard_map(f, mesh=mesh, in_specs=P("dp"),
-                            out_specs=P())(jnp.arange(8.0))
+        from paddle_tpu.framework.jax_compat import shard_map
+        out = shard_map(f, mesh=mesh, in_specs=P("dp"),
+                        out_specs=P())(jnp.arange(8.0))
         assert float(out[0]) == 28.0
 
     def test_eager_api_parity(self):
@@ -265,9 +268,14 @@ class TestZeROStages:
             np.random.RandomState(0).randint(0, 1024, size=(8, 16)))
         arrays = [ids.value, ids.value]
         lowered = step._jitted.lower(
-            step.params, step.opt_state, step.buffers, split_key(),
-            jnp.asarray(0.1, jnp.float32), 1, *arrays)
-        assert lowered.as_text().count("sharding_constraint") >= 20
+            step.params, step.opt_state, step.scaler_state, step.buffers,
+            split_key(), jnp.asarray(0.1, jnp.float32), 1, *arrays)
+        txt = lowered.as_text()
+        # jax >= 0.6 prints sharding_constraint ops; 0.4.x lowers the
+        # same constraint as a custom_call @Sharding
+        n_constraints = txt.count("sharding_constraint") + \
+            txt.count("@Sharding")
+        assert n_constraints >= 20, n_constraints
         hlo = lowered.compile().as_text()
         # qkv grad [64,192] over sharding=2 -> update math sees [32,192]
         assert "f32[32,192]" in hlo, "update does not run on grad shards"

@@ -5,6 +5,8 @@ import sys
 
 import pytest
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -12,6 +12,8 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu import optimizer as opt
 import paddle_tpu.nn as nn
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def _loss_fn():
     def f(out, y):

@@ -11,6 +11,8 @@ import paddle_tpu as paddle
 from paddle_tpu.jit.api import functional_call, state_arrays
 from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def _setup():
     paddle.seed(0)

@@ -8,6 +8,8 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.incubate as incubate
+
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
 FusedMultiHeadAttention = incubate.nn.FusedMultiHeadAttention
 FusedFeedForward = incubate.nn.FusedFeedForward
 

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 
 class TestRNN:
     @pytest.mark.heavy

@@ -10,6 +10,8 @@ import paddle_tpu.incubate as incubate
 from paddle_tpu import optimizer as opt
 from paddle_tpu.distributed import fleet
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 
 def _dense_oracle_top1(x2d, moe):
     """Route each token to its argmax expert, no capacity drops."""

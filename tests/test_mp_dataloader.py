@@ -9,6 +9,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.io import DataLoader, Dataset
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 
 class RangeSquares(Dataset):
     """Top-level (picklable) dataset."""

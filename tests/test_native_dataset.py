@@ -10,6 +10,8 @@ import pytest
 import paddle_tpu.distributed as dist
 from paddle_tpu.runtime import get_lib
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def _write(tmp_path, lines, name="part-0"):
     p = tmp_path / name

@@ -6,6 +6,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def t(a, sg=True):
     return paddle.to_tensor(np.asarray(a), stop_gradient=sg)

@@ -8,6 +8,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu import optimizer as opt
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def quad_problem():
     """One-parameter quadratic: loss = (w*x - y)^2 summed."""

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.ops.paged_attention import PagedKVCache, paged_attention
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 def _dense_ref(q, hist_k, hist_v):
     D = q.shape[-1]

@@ -10,6 +10,8 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu import optimizer as opt
 
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick commit gate no
+
 
 class TestIO:
     def test_dataloader_order_and_coverage(self):

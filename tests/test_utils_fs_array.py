@@ -99,6 +99,62 @@ def test_localfs_mv_guards(tmp_path):
         fs.touch(b, exist_ok=False)
 
 
+def test_tensor_array_gap_slots_are_zeros_of_written_shape():
+    """Sparse write at idx 3: slots 0..2 fill with zeros of the WRITTEN
+    tensor's shape/dtype (bfloat16 included — np.dtype(str(...)) used to
+    mangle it), so stack/concat over the array works far from the
+    write site."""
+    x = paddle.full([2, 4], 7.0, dtype="bfloat16")
+    arr = paddle.tensor.array_write(x, paddle.to_tensor([3]))
+    assert len(arr) == 4
+    for filler in arr[:3]:
+        assert filler.shape == [2, 4]
+        assert str(filler.value.dtype) == "bfloat16"
+        np.testing.assert_allclose(
+            filler.astype("float32").numpy(), np.zeros((2, 4)))
+    stacked = paddle.stack(arr)
+    assert stacked.shape == [4, 2, 4]
+    assert str(stacked.value.dtype) == "bfloat16"
+
+
+def _fake_hadoop(tmp_path, rc, message):
+    """A hadoop_home whose bin/hadoop prints `message` and exits rc."""
+    home = tmp_path / f"hadoop_rc{rc}"
+    (home / "bin").mkdir(parents=True)
+    binpath = home / "bin" / "hadoop"
+    binpath.write_text(f"#!/bin/sh\necho '{message}'\nexit {rc}\n")
+    binpath.chmod(0o755)
+    return str(home)
+
+
+def test_hdfs_test_rc1_benign_means_no(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import HDFSClient
+    home = _fake_hadoop(
+        tmp_path, 1, "SLF4J: Class path contains multiple bindings")
+    client = HDFSClient(home, None, time_out=1, sleep_inter=1)
+    assert client.is_exist("/ckpt") is False
+    assert client.is_dir("/ckpt") is False
+
+
+def test_hdfs_test_fails_closed_on_unexplained_exit(tmp_path):
+    """rc=255 (generic failure), rc=1+java exception: must raise, never
+    report "checkpoint absent" — a silent False restarts training from
+    scratch over a transient cluster error."""
+    from paddle_tpu.distributed.fleet.utils import HDFSClient
+    from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                       FSTimeOut)
+    for rc, msg in ((255, "connection reset"),
+                    (1, "java.net.ConnectException: Exception from "
+                        "RPC channel"),
+                    (137, "JVM killed")):
+        client = HDFSClient(_fake_hadoop(tmp_path, rc, msg), None,
+                            time_out=1, sleep_inter=1)
+        with pytest.raises((ExecuteError, FSTimeOut)):
+            client.is_exist("/ckpt")
+        with pytest.raises((ExecuteError, FSTimeOut)):
+            client.is_dir("/ckpt")
+
+
 def test_hdfs_client_clear_error_without_hadoop(tmp_path):
     from paddle_tpu.distributed.fleet.utils import HDFSClient
     from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,

@@ -7,6 +7,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
+pytestmark = [pytest.mark.slow, pytest.mark.heavy]  # multi-minute: out of tier-1 and the quick gate
+
 
 def _img(n=1, c=3, hw=64):
     rng = np.random.RandomState(0)
