@@ -27,7 +27,13 @@ Robustness contract (round-6; round-5 history in git):
     lines (congestion evidence) instead of dying silent;
   * BENCH_BASE.json RATCHETS: when a run beats the recorded base, the
     base is rewritten (prior records kept in its `history` list), so
-    vs_baseline always measures against the best this machine has done.
+    vs_baseline always measures against the best this machine has done;
+  * every attempt carries a PHASE BREAKDOWN (backend_init/import/build/
+    compile/steady timings, persistent-cache hit, per-step FLOPs from
+    XLA cost analysis, peak memory) in its JSON — success, crash, and
+    timeout alike (phases stream over stderr as "bench-phase:" lines,
+    so the parent keeps the last one even when it must SIGKILL the
+    child). A failed run diagnoses itself; see docs/OBSERVABILITY.md.
 """
 import json
 import os
@@ -55,6 +61,24 @@ def _default_cache_dir():
 
 _CACHE_DIR = _default_cache_dir()
 _STATE_PATH = os.path.join(_CACHE_DIR, "bench_state.json")
+
+# Phase breakdown (child-side): updated as each phase completes, so the
+# diagnostic JSON of a FAILED attempt still says how far it got and what
+# each phase cost — "all attempts failed" with no evidence (BENCH_r05)
+# can't happen again. "stage" is the cursor: the phase in flight when
+# the record was emitted.
+_PHASES = {"stage": "start"}
+
+
+def _phase(stage, **done):
+    _PHASES["stage"] = stage
+    for k, v in done.items():
+        _PHASES[k] = round(v, 3) if isinstance(v, float) else v
+    # stream every transition to stderr: a parent (or the driver log)
+    # sees how far a child got even when a hard timeout kills it before
+    # it can print any JSON
+    print(f"bench-phase: {json.dumps(_PHASES)}", file=sys.stderr,
+          flush=True)
 
 
 def _cache_entries():
@@ -102,12 +126,14 @@ def _mark_compiled(tag):
 
 
 def _peak_flops(jax_mod):
-    """bf16 peak for the attached chip generation (MFU denominator)."""
-    peaks = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
-             "v5p": 459e12, "v5": 459e12, "v6 lite": 918e12,
-             "v6e": 918e12}
-    kind = jax_mod.devices()[0].device_kind.lower()
-    return next((v for k, v in peaks.items() if k in kind), 197e12)
+    """bf16 peak for the attached chip generation (MFU denominator) —
+    the framework's single table (paddle_tpu/profiler/cost.py), with
+    bench's traditional 197e12 fallback for unknown chips."""
+    try:
+        from paddle_tpu.profiler.cost import device_peak_flops
+        return device_peak_flops(jax_mod.devices()[0], default=197e12)
+    except Exception:
+        return 197e12
 
 
 def _run():
@@ -125,17 +151,23 @@ def _run():
     # instead (observed 2026-07-29: tunnel outage mid-round)
     signal.signal(signal.SIGALRM, _init_timeout)
     signal.alarm(init_budget)
+    _phase("backend_init")
+    t_phase = time.perf_counter()
     import jax
     import jax.numpy as jnp
     _enable_compile_cache(jax)
     jax.devices()  # force backend init under the alarm
     signal.alarm(0)
+    _phase("import", backend_init_s=time.perf_counter() - t_phase)
 
+    t_phase = time.perf_counter()
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu import optimizer as opt
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    _phase("build", import_s=time.perf_counter() - t_phase)
+    t_phase = time.perf_counter()
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -184,6 +216,8 @@ def _run():
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
     cache_entries_before = _cache_entries()
+    _phase("compile", build_s=time.perf_counter() - t_phase,
+           cache_warm=cache_entries_before > 0)
 
     # warmup (compile); sync via a data fetch — through the axon tunnel
     # block_until_ready returns before execution finishes, so only a
@@ -194,6 +228,15 @@ def _run():
     float(loss.item())
     t_compile = time.perf_counter() - t_compile
     _mark_compiled(f"headline scan={scan} remat={remat}")
+    # the AOT executable cache knows whether the compile loaded from the
+    # persistent cache and what the per-step FLOPs are (free — no
+    # re-lower); see paddle_tpu/jit/api.py aot_compile
+    exec_info = next(iter(step._exec.values()))[1] if step._exec else {}
+    flops_per_step = float(exec_info.get("flops", 0.0))
+    _phase("steady", compile_warmup_s=t_compile,
+           compile_cache_hit=bool(exec_info.get("cache_hit", False)),
+           compile_lower_s=float(exec_info.get("lower_s", 0.0)),
+           compile_xla_s=float(exec_info.get("compile_s", 0.0)))
     print(f"bench: warmup+compile {t_compile:.1f}s "
           f"(scan={scan} remat={remat})", file=sys.stderr, flush=True)
 
@@ -203,6 +246,10 @@ def _run():
         loss = step(ids, ids)
     float(loss.item())
     dt = time.perf_counter() - t0
+    _phase("done", steady_s=dt, steady_iters=iters,
+           peak_bytes=int(paddle.device.max_memory_allocated()),
+           flops_per_step=flops_per_step,
+           cache_entries=_cache_entries())
 
     tokens_per_sec = batch * seq * iters / dt
     loss_val = round(float(loss.item()), 4)
@@ -251,6 +298,12 @@ def _run():
         "retraces": step.retraces,
         "donated": step._donate,
         "peak_mem_bytes": int(paddle.device.max_memory_allocated()),
+        # XLA cost analysis (per-executable FLOPs) — the measured-work
+        # MFU companion to the 6ND estimate above
+        "flops_per_step": flops_per_step,
+        "mfu_cost_analysis": round(
+            flops_per_step * iters / dt / peak, 4) if on_tpu else 0.0,
+        "phases": dict(_PHASES),
     }
     print(json.dumps(headline), flush=True)
 
@@ -299,14 +352,17 @@ def _run_1p3b():
     on the 16 GB chip (full remat: 11.0k tok/s; this config: 11.9k,
     +7.5%). Runs in its OWN subprocess so a congested compile can never
     starve the headline metric (the parent already holds that line)."""
+    _phase("backend_init")
     import jax
     import jax.numpy as jnp
     _enable_compile_cache(jax)
+    _phase("import")
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt_1p3b
     from paddle_tpu.optimizer import Momentum
+    _phase("build")
 
     cfg13 = gpt_1p3b()
     cfg13.max_position_embeddings = 1024
@@ -337,10 +393,13 @@ def _run_1p3b():
     rng = np.random.RandomState(0)
     ids13 = paddle.to_tensor(rng.randint(
         0, cfg13.vocab_size, size=(4, 1024)).astype(np.int32))
+    _phase("compile")
+    t_c = time.perf_counter()
     for _ in range(2):
         l13 = s13(ids13, ids13)
     float(l13.item())
     _mark_compiled(f"1p3b remat={cfg13.scan_remat}")
+    _phase("steady", compile_warmup_s=time.perf_counter() - t_c)
     t0 = time.perf_counter()
     for _ in range(8):
         l13 = s13(ids13, ids13)
@@ -357,8 +416,10 @@ def _stream_child(extra_env, budget):
     its output live. ALL child output — JSON lines included — goes to the
     parent's stderr: the driver contract is exactly one stdout JSON line,
     printed once by the parent as its final word. Returns
-    (rc, json_lines, stderr_tail); rc is 'timeout' when the budget
-    killed it."""
+    (rc, json_lines, stderr_tail, last_phase); rc is 'timeout' when the
+    budget killed it; last_phase is the child's most recent
+    "bench-phase:" breakdown (dict or None) — present even when a
+    timeout killed the child before any JSON."""
     import subprocess
     import threading
 
@@ -372,6 +433,7 @@ def _stream_child(extra_env, budget):
         errors="replace")
     json_lines = []
     err_tail = []
+    phase_holder = []
 
     def _pump_out():
         for raw in proc.stdout:
@@ -382,7 +444,14 @@ def _stream_child(extra_env, budget):
 
     def _pump_err():
         for raw in proc.stderr:
-            err_tail.append(raw.rstrip("\n"))
+            line = raw.rstrip("\n")
+            if line.startswith("bench-phase: "):
+                try:
+                    phase_holder[:] = [
+                        json.loads(line[len("bench-phase: "):])]
+                except ValueError:
+                    pass
+            err_tail.append(line)
             del err_tail[:-8]
             print(raw, end="", file=sys.stderr, flush=True)
 
@@ -399,7 +468,8 @@ def _stream_child(extra_env, budget):
         rc = "timeout"
     t_out.join(timeout=5)
     t_err.join(timeout=5)
-    return rc, json_lines, err_tail
+    return rc, json_lines, err_tail, \
+        (phase_holder[0] if phase_holder else None)
 
 
 def main():
@@ -424,6 +494,9 @@ def main():
                 "metric": "gpt_medium_train_tokens_per_sec_per_chip",
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {str(e)[:400]}",
+                # how far the attempt got and what each phase cost — the
+                # diagnosis BENCH_r05's bare 0.0 lacked
+                "phases": dict(_PHASES),
                 "traceback_tail": tb[-800:]}), flush=True)
             raise SystemExit(1)
         return
@@ -490,7 +563,7 @@ def main():
                 "evidence": [f"total budget exhausted "
                              f"({round(remaining())}s remaining)"]})
             break
-        rc, json_lines, err_tail = _stream_child(extra, budget)
+        rc, json_lines, err_tail, last_phase = _stream_child(extra, budget)
         result = _last_json(
             json_lines,
             lambda c: c.get("metric") and c.get("value", 0) > 0)
@@ -498,9 +571,15 @@ def main():
             if best is None or result["value"] > best["value"]:
                 best = result
         else:
-            failures.append({
-                "attempt": tag, "rc": rc, "budget_s": round(budget),
-                "evidence": _evidence(json_lines, err_tail)})
+            fail = {"attempt": tag, "rc": rc, "budget_s": round(budget),
+                    "evidence": _evidence(json_lines, err_tail)}
+            # phase breakdown even for a timed-out child (streamed over
+            # stderr) or a crashed one (embedded in its diagnostic JSON)
+            diag = _last_json(json_lines, lambda c: "phases" in c)
+            phases = (diag or {}).get("phases") or last_phase
+            if phases:
+                fail["phases"] = phases
+            failures.append(fail)
     if best is None:
         print(json.dumps({
             "metric": "gpt_medium_train_tokens_per_sec_per_chip",
@@ -521,7 +600,7 @@ def main():
         env13 = {"BENCH_TASK": "1p3b"}
         if "BENCH_1P3B_REMAT" not in os.environ:
             env13["BENCH_1P3B_REMAT"] = "dots"  # round-4 sweep winner
-        rc, json_lines, err_tail = _stream_child(env13, b13)
+        rc, json_lines, err_tail, _ = _stream_child(env13, b13)
         got = _last_json(json_lines,
                          lambda c: "gpt_1p3b_tokens_per_sec" in c)
         if got:

@@ -123,17 +123,28 @@ def max_memory_allocated(device=None):
     HBM high-water mark, the number that proves a donated train step is
     NOT holding a second full copy of the model. The CPU backend exposes
     no allocator stats, so the process peak RSS stands in (keeps the API
-    returning sane nonzero values everywhere)."""
+    returning sane nonzero values everywhere). Each query lands in the
+    telemetry store (a "device.memory" span + the device.peak_bytes
+    gauge), so Profiler.summary() carries the memory high-water mark."""
+    import time
+    from ..profiler import statistic as _stat
+    from ..profiler import monitor as _monitor
+    t0 = time.perf_counter()
     peak = _memory_stats(device).get("peak_bytes_in_use", 0)
     if not peak:
         import resource
         peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    _stat.record_span("device.memory", time.perf_counter() - t0)
+    _monitor.gauge("device.peak_bytes").set(int(peak))
     return int(peak)
 
 
 def memory_allocated(device=None):
     """Bytes of device memory currently held by live buffers."""
-    return int(_memory_stats(device).get("bytes_in_use", 0))
+    cur = int(_memory_stats(device).get("bytes_in_use", 0))
+    from ..profiler import monitor as _monitor
+    _monitor.gauge("device.bytes_in_use").set(cur)
+    return cur
 
 
 def max_memory_reserved(device=None):

@@ -15,7 +15,63 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.core import Tensor, apply_op
+from ..profiler import statistic as _stat
+from ..profiler import monitor as _monitor
 from .env import get_mesh
+
+
+def _payload_bytes(args):
+    """Sum the byte size of every Tensor/array (or list of them) in
+    `args`. Works on tracers too — shape/dtype are known under trace."""
+    nbytes = 0
+    stack = list(args)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (list, tuple)):
+            stack.extend(t)
+            continue
+        a = t.value if isinstance(t, Tensor) else t
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            nbytes += int(np.prod(shape)) * np.dtype(dtype).itemsize
+        except (TypeError, ValueError):
+            continue
+    return nbytes
+
+
+def _instrumented(fn=None, *, payload=None):
+    """Telemetry wrapper for a collective: per-kind call + payload-bytes
+    counters and a host span. Called under trace (inside jit/shard_map)
+    this tallies collectives INSERTED per traced program — once per
+    compile, not per execution; eager calls count one-for-one.
+
+    `payload` selects which positional args carry the transferred data
+    (args -> sequence) for APIs that also take an output placeholder
+    (reduce_scatter's dst tensor, alltoall's out list) — counting those
+    would overstate the traffic by the output size."""
+    if fn is None:
+        return lambda f: _instrumented(f, payload=payload)
+    import functools
+    import time
+    kind = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        # bytes BEFORE the call: all_gather/alltoall mutate their list
+        # arguments, so counting afterwards would tally outputs too
+        nbytes = _payload_bytes(payload(args) if payload else args)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _stat.record_span(f"collective.{kind}",
+                              time.perf_counter() - t0)
+            _monitor.counter(f"collective.{kind}.calls").inc()
+            _monitor.counter(f"collective.{kind}.bytes").inc(nbytes)
+    return wrapper
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce",
            "scatter", "alltoall", "send", "recv", "reduce_scatter",
@@ -62,26 +118,32 @@ def _in_trace(x):
 
 
 # ---- SPMD functional collectives (use inside shard_map) ----------------
+@_instrumented
 def psum(x, axis):
     return lax.psum(x, axis)
 
 
+@_instrumented
 def pmean(x, axis):
     return lax.pmean(x, axis)
 
 
+@_instrumented
 def pmax(x, axis):
     return lax.pmax(x, axis)
 
 
+@_instrumented
 def all_gather_axis(x, axis, tiled=True, gather_dim=0):
     return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
 
 
+@_instrumented
 def ppermute(x, axis, perm):
     return lax.ppermute(x, axis, perm)
 
 
+@_instrumented
 def all_to_all_axis(x, axis, split_axis, concat_axis):
     return lax.all_to_all(x, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
@@ -98,6 +160,7 @@ def _axis_of(group):
     return "dp"
 
 
+@_instrumented
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Inside shard_map: psum over the group axis. Eager: identity on the
     single controller (the mesh owns all shards already)."""
@@ -114,6 +177,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented(payload=lambda a: a[1:2])  # the gathered tensor;
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if _in_trace(tensor.value if isinstance(tensor, Tensor) else tensor):
         ax = _axis_of(group)
@@ -127,25 +191,32 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+@_instrumented
 def broadcast(tensor, src=0, group=None, sync_op=True):
     return tensor  # single-controller: every device sees the same program
 
 
+@_instrumented
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    # the UNinstrumented all_reduce body: one user call must count as
+    # one collective, not as a reduce plus an all_reduce
+    return all_reduce.__wrapped__(tensor, op, group, sync_op)
 
 
+@_instrumented(payload=lambda a: a[1:2])  # the scattered shards
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list:
         tensor._bind(tensor_list[0]._slot)
     return tensor
 
 
+@_instrumented(payload=lambda a: a[0:1])  # the input shards
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     out_tensor_list.extend(in_tensor_list)
     return out_tensor_list
 
 
+@_instrumented(payload=lambda a: a[1:2])  # the reduced shards
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     if _in_trace(tensor_list[0].value):
@@ -170,6 +241,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
         "shard_map on TPU (see meta_parallel.pipeline_parallel)")
 
 
+@_instrumented
 def wait(tensor, group=None, use_calc_stream=True):
     if isinstance(tensor, Tensor) and not _in_trace(tensor.value):
         jax.block_until_ready(tensor.value)

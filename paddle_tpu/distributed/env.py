@@ -51,11 +51,26 @@ def get_mesh():
     return _state["mesh"]
 
 
+def _apply_visible_devices():
+    """Consume PADDLE_VISIBLE_DEVICES (set per rank by
+    distributed.launch --devices) by mapping it onto the backend's own
+    masking env BEFORE the backend initializes — libtpu reads
+    TPU_VISIBLE_CHIPS, CUDA reads CUDA_VISIBLE_DEVICES. setdefault:
+    an explicitly set backend var wins. No effect once a backend is
+    already up (first device use wins), same as the native vars."""
+    vis = os.environ.get("PADDLE_VISIBLE_DEVICES")
+    if not vis:
+        return
+    os.environ.setdefault("TPU_VISIBLE_CHIPS", vis)
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", vis)
+
+
 def init_parallel_env():
     """Parity: paddle.distributed.init_parallel_env. Initializes multi-host
     jax.distributed if launch env vars are present, then the global mesh."""
     if _state["initialized"]:
         return ParallelEnv()
+    _apply_visible_devices()
     coord = os.environ.get("PADDLE_TPU_COORDINATOR")
     nproc = os.environ.get("PADDLE_TPU_NUM_PROCESSES")
     pid = os.environ.get("PADDLE_TPU_PROCESS_ID")

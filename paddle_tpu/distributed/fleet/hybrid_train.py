@@ -23,7 +23,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...framework.core import Tensor, no_grad, _Slot
 from ...framework.random import split_key
-from ...jit.api import functional_call, state_arrays
+from ...jit.api import (functional_call, state_arrays, aot_compile,
+                        count_train_use, export_step_metrics)
+from ...profiler import statistic as _stat
+from ...profiler import monitor as _monitor
+from ...profiler import cost as _cost
 
 __all__ = ["HybridTrainStep", "default_param_rules"]
 
@@ -251,37 +255,62 @@ class HybridTrainStep:
             donate_argnums=(0, 1, 2) if donate else (),
             out_shardings=(loss_sharding, self.param_shardings,
                            state_shardings, scaler_shardings))
+        # AOT executables keyed by batch signature (jit.api.aot_compile):
+        # trace/compile phases timed, persistent-cache hit observed,
+        # cost_analysis free
+        self._exec = {}
 
-    def _count_compile(self, t0):
-        import time
-        try:
-            n = self._jitted._cache_size()
-        except AttributeError:
-            return
-        prev = getattr(self, "_traced_total", 0)
-        if n > prev:
-            dt = time.perf_counter() - t0
-            self.retraces += n - prev
-            self.compile_s += dt
-            self.last_compile_s = dt
-            self._traced_total = n
-
-    def __call__(self, *batch):
-        import time
+    def _prep(self, batch, step_i):
+        """(sig, full arg tuple) for one dispatch — the ONE place the
+        batch is sharded and the signature built: __call__ and the
+        inspection paths must agree exactly, because the cached
+        executable bakes the input shardings."""
         dp_only = NamedSharding(self.mesh, P(("dp",)))
         arrays = [jax.device_put(
             a, self.batch_sharding if a.ndim >= 2 else dp_only)
             for a in (b.value if isinstance(b, Tensor) else jnp.asarray(b)
                       for b in batch)]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        args = (self.params, self.opt_state, self.scaler_state,
+                self.buffers, split_key(),
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                step_i, *arrays)
+        return sig, args
+
+    def __call__(self, *batch):
         self._step_i += 1
-        lr = self.optimizer.get_lr()
-        t0 = time.perf_counter()
-        loss, self.params, self.opt_state, self.scaler_state = self._jitted(
-            self.params, self.opt_state, self.scaler_state, self.buffers,
-            split_key(), jnp.asarray(lr, jnp.float32), self._step_i,
-            *arrays)
-        self._count_compile(t0)
+        sig, args = self._prep(batch, self._step_i)
+        _stat.begin_span("fleet.hybrid_step")
+        try:
+            entry = self._exec.get(sig)
+            compiled_now = entry is None
+            if compiled_now:
+                entry = self._exec[sig] = aot_compile(self._jitted, args)
+            compiled, info = entry
+            count_train_use(self, info)
+            loss, self.params, self.opt_state, self.scaler_state = \
+                compiled(*args)
+        finally:
+            dispatch_s = _stat.end_span()
+        export_step_metrics(self, dispatch_s, info, compiled_now)
         return Tensor(loss)
+
+    def cost_analysis(self, *batch):
+        """XLA cost report for this batch signature's SPMD executable
+        (per-device flops/bytes; free once the step has run, and never
+        touching the retrace counters)."""
+        return _cost.cost_analysis(self._executable(*batch))
+
+    def flops(self, *batch):
+        """Per-step per-device FLOPs of the compiled SPMD program."""
+        return _cost.executable_flops(self._executable(*batch))
+
+    def _executable(self, *batch):
+        sig, args = self._prep(batch, self._step_i + 1)
+        entry = self._exec.get(sig)
+        if entry is None:
+            entry = self._exec[sig] = aot_compile(self._jitted, args)
+        return entry[0]
 
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
@@ -292,10 +321,6 @@ class HybridTrainStep:
             self.scaler.sync_from_jit_state(self.scaler_state)
 
     def compiled_text(self, *batch):
-        """Return the optimized HLO for inspection/tests."""
-        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                  for b in batch]
-        return self._jitted.lower(
-            self.params, self.opt_state, self.scaler_state, self.buffers,
-            split_key(), jnp.asarray(0.1, jnp.float32), 1,
-            *arrays).compile().as_text()
+        """Optimized HLO for inspection/tests; reuses the AOT executable
+        cache — no extra compile once the step has run."""
+        return self._executable(*batch).as_text()

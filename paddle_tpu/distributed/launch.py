@@ -45,14 +45,20 @@ def _parse(argv=None):
                       default=int(os.environ.get("PADDLE_NPROC_PER_NODE",
                                                  "1")),
                       help="ranks to launch on this host (TPU: usually 1 "
-                           "process drives all local chips; >1 splits "
-                           "them, mostly for CPU-backend testing)")
+                           "process drives all local chips; >1 needs "
+                           "--devices to partition chips across ranks, "
+                           "or the CPU backend for testing)")
     base.add_argument("--log_dir", default=None,
                       help="per-rank logs as <log_dir>/workerlog.<rank>; "
                            "default: ranks inherit the launcher's stdout")
     base.add_argument("--devices", "--gpus", "--xpus", dest="devices",
                       default=None,
-                      help="visible device ids for this host's ranks")
+                      help="comma-separated device ids for this host, "
+                           "partitioned contiguously across the local "
+                           "ranks (count must divide by nproc_per_node); "
+                           "each rank sees its slice as "
+                           "PADDLE_VISIBLE_DEVICES, consumed by "
+                           "init_parallel_env before backend init")
     coll = p.add_argument_group("Collective Parameters")
     coll.add_argument("--nnodes", type=int,
                       default=int(os.environ.get("PADDLE_NNODES", "1")))
@@ -85,6 +91,18 @@ def _free_port():
     return port
 
 
+def _rank_devices(devices, nproc, local_rank):
+    """Contiguous per-rank slice of the --devices id list (rank i of n
+    gets ids [i*k, (i+1)*k) for k = len/n)."""
+    ids = [d.strip() for d in str(devices).split(",") if d.strip()]
+    if not ids or len(ids) % nproc != 0:
+        raise SystemExit(
+            f"launch: --devices lists {len(ids)} ids, not divisible "
+            f"across --nproc_per_node {nproc}")
+    k = len(ids) // nproc
+    return ",".join(ids[local_rank * k:(local_rank + 1) * k])
+
+
 def _rank_env(args, coordinator, local_rank, restart_count):
     world = args.nnodes * args.nproc_per_node
     rank = args.node_rank * args.nproc_per_node + local_rank
@@ -92,11 +110,18 @@ def _rank_env(args, coordinator, local_rank, restart_count):
     endpoints = ",".join(
         f"{host}:{_ep_port(coordinator, r)}" for r in range(world))
     env = dict(os.environ)
+    if world > 1:
+        # multi-process bootstrap (consumed by init_parallel_env). NOT
+        # set for a single-rank gang: forcing the coordinator env there
+        # made init_parallel_env run jax.distributed.initialize for a
+        # 1-process "world", losing the single-controller init path
+        # (one process owning every local chip)
+        env.update({
+            "PADDLE_TPU_COORDINATOR": coordinator,
+            "PADDLE_TPU_NUM_PROCESSES": str(world),
+            "PADDLE_TPU_PROCESS_ID": str(rank),
+        })
     env.update({
-        # paddle_tpu bootstrap (consumed by init_parallel_env)
-        "PADDLE_TPU_COORDINATOR": coordinator,
-        "PADDLE_TPU_NUM_PROCESSES": str(world),
-        "PADDLE_TPU_PROCESS_ID": str(rank),
         # reference-compatible trainer env (fleet launch_utils contract)
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
@@ -106,7 +131,8 @@ def _rank_env(args, coordinator, local_rank, restart_count):
         "PADDLE_RESTART_COUNT": str(restart_count),
     })
     if args.devices is not None:
-        env["PADDLE_VISIBLE_DEVICES"] = args.devices
+        env["PADDLE_VISIBLE_DEVICES"] = _rank_devices(
+            args.devices, args.nproc_per_node, local_rank)
     return env
 
 

@@ -103,13 +103,26 @@ class ProgBarLogger(Callback):
         self.epoch = epoch
         self.steps = 0
         self._t0 = time.time()
+        self._tb = time.time()
 
     def on_train_batch_end(self, step, logs=None):
+        from ..profiler import monitor as _monitor
+        now = time.time()
+        dt = now - self._tb
+        self._tb = now
+        _monitor.histogram("hapi.step_s").observe(dt)
         self.steps += 1
         if self.verbose and step % self.log_freq == 0:
             loss = logs.get("loss")
             lstr = ", ".join(f"{v:.4f}" for v in loss) if loss else "-"
-            print(f"Epoch {self.epoch} step {step}: loss={lstr}")
+            extra = f", {dt * 1000:.0f} ms/step"
+            # cost-analysis MFU published by the jitted train steps
+            # (jit/api.py export_step_metrics); eager fit() has no
+            # compiled executable to account against
+            mfu = _monitor.gauge("train.mfu").value
+            if mfu:
+                extra += f", mfu={mfu:.3f}"
+            print(f"Epoch {self.epoch} step {step}: loss={lstr}{extra}")
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:

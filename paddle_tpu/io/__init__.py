@@ -13,11 +13,14 @@ import math
 import os
 import queue
 import threading
+import time
 
 import numpy as np
 
 from ..framework.core import Tensor
 from ..framework import random as fw_random
+from ..profiler import statistic as _stat
+from ..profiler import monitor as _monitor
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ChainDataset",
            "ComposeDataset", "ConcatDataset", "Subset", "random_split",
@@ -382,6 +385,25 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def __iter__(self):
+        """Iteration wraps the concrete source with telemetry: every
+        batch's host-side wait (assembly + queue time — the gap the
+        prefetch ring exists to hide) lands as a "dataloader.next" span
+        and in the dataloader.wait_s histogram, so a starved train step
+        is visible in Profiler.summary() rather than inferred."""
+        inner = self._iter_source()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(inner)
+            except StopIteration:
+                return
+            dt = time.perf_counter() - t0
+            _stat.record_span("dataloader.next", dt)
+            _monitor.histogram("dataloader.wait_s").observe(dt)
+            _monitor.counter("dataloader.batches").inc()
+            yield batch
+
+    def _iter_source(self):
         if self._iterable_mode:
             yield from self._iter_iterable()
             return

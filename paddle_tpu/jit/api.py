@@ -13,6 +13,7 @@ forward runs under trace, with the tape disabled (jax.grad provides
 differentiation on this path).
 """
 import functools
+import time
 
 import numpy as np
 import jax
@@ -20,8 +21,114 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor, Parameter, no_grad, _Slot
 from ..framework.random import rng_scope, split_key
+from ..profiler import statistic as _stat
+from ..profiler import monitor as _monitor
+from ..profiler import cost as _cost
 
-__all__ = ["functional_call", "to_static", "TrainStep", "not_to_static"]
+__all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
+           "aot_compile", "count_train_use", "export_step_metrics"]
+
+
+def aot_compile(jitted, args):
+    """Explicitly lower + compile a jax.jit function for `args` — the
+    AOT dispatch path TrainStep/HybridTrainStep use instead of jax.jit's
+    implicit first-call compile. This is the telemetry keystone: the
+    trace/lower and XLA-compile phases get separate host spans
+    ("jit.trace_lower", "jit.compile"), the persistent compile cache
+    (framework/compile_cache.py) hit/miss is observed (hit = compile
+    added no new on-disk entry), and the returned executable exposes
+    cost_analysis() for free — no re-lower, no re-compile.
+
+    Returns (compiled, info) where info carries lower_s / compile_s /
+    cache_hit / flops / bytes. The global jit.* metrics count every
+    compile; a train-step object's retraces/compile_s counters advance
+    via `count_train_use` only when the executable first runs a
+    training step, so inspection compiles (compiled_text / flops on an
+    untrained signature) can't fake shape instability.
+    """
+    from ..framework import compile_cache as _cc
+    t0 = time.perf_counter()
+    _stat.begin_span("jit.trace_lower")
+    try:
+        lowered = jitted.lower(*args)
+    finally:
+        lower_s = _stat.end_span()
+    cache_on = _cc.cache_dir() is not None
+    entries_before = _cc.cache_entry_count() if cache_on else 0
+    _stat.begin_span("jit.compile")
+    try:
+        compiled = lowered.compile()
+    finally:
+        compile_s = _stat.end_span()
+    cache_hit = cache_on and _cc.cache_entry_count() == entries_before
+    total = time.perf_counter() - t0
+    _monitor.counter("jit.retraces").inc()
+    _monitor.counter("jit.cache_hit" if cache_hit
+                     else "jit.cache_miss").inc()
+    _monitor.histogram("jit.compile_s").observe(total)
+    ca = _cost.cost_analysis(compiled)
+    info = {"lower_s": lower_s, "compile_s": compile_s,
+            "cache_hit": cache_hit,
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0))}
+    return compiled, info
+
+
+def count_train_use(owner, info):
+    """Fold a compiled executable's cost into the owner's
+    retraces/compile_s/last_compile_s the FIRST time it runs a training
+    step (idempotent per executable)."""
+    if info.get("counted"):
+        return
+    info["counted"] = True
+    total = info["lower_s"] + info["compile_s"]
+    owner.retraces += 1
+    owner.compile_s += total
+    owner.last_compile_s = total
+
+
+def export_step_metrics(step, dispatch_s, info, compiled_now):
+    """Per-step telemetry for a train-step object: step-time histogram,
+    cost-analysis FLOPs/MFU gauges, and — when PADDLE_TPU_METRICS_FILE
+    is set — one documented JSONL step record
+    (tools/check_metrics_schema.py validates the shape).
+
+    step_time_s is the wall time since the previous step's dispatch
+    returned: under async dispatch the call itself returns early, but in
+    a steady train loop the inter-dispatch interval converges on the
+    true device step time. The first (or a recompiling) step falls back
+    to its own dispatch time minus the compile."""
+    now = time.perf_counter()
+    prev = getattr(step, "_last_step_end", None)
+    step._last_step_end = now
+    compile_s = info["lower_s"] + info["compile_s"] if compiled_now \
+        else 0.0
+    steady = prev is not None and not compiled_now
+    if steady:
+        step_time = now - prev
+    else:
+        step_time = max(dispatch_s - compile_s, 0.0)
+    flops = float(info.get("flops", 0.0))
+    # MFU only from the steady inter-dispatch interval: the fallback
+    # dispatch time is near zero under async dispatch and would publish
+    # an absurd >1 utilization for the first/recompiling step
+    m = _cost.mfu(flops, step_time) if steady else 0.0
+    _monitor.histogram("train.step_s").observe(step_time)
+    _monitor.gauge("train.flops_per_step").set(flops)
+    _monitor.gauge("train.bytes_per_step").set(
+        float(info.get("bytes", 0.0)))
+    _monitor.gauge("train.mfu").set(m)
+    if not _monitor.metrics_file():
+        return
+    from .. import device as _device
+    _monitor.export_step({
+        "step": int(step._step_i),
+        "step_time_s": float(step_time),
+        "compile_s": float(compile_s),
+        "cache_hit": bool((not compiled_now) or info["cache_hit"]),
+        "peak_bytes": int(_device.max_memory_allocated()),
+        "flops": flops,
+        "mfu": float(m)})
 
 
 def state_arrays(layer):
@@ -188,8 +295,10 @@ class StaticFunction:
                   for a in args]
         sig = self._sig(arrays)
         jitted = self._cache.get(sig)
-        if jitted is None:
+        new_program = jitted is None
+        if new_program:
             jitted = self._compile(sig, arrays)
+            _monitor.counter("jit.retraces").inc()
         key = split_key()
         if self._is_layer:
             named = list(self._obj.named_parameters())
@@ -212,9 +321,16 @@ class StaticFunction:
                                for a in args]
                 return apply_op(fn, *[p for _, p in named], *tensor_args)
             params = {k: p.value for k, p in named}
+            t0 = time.perf_counter()
             out = jitted(params, buffers, key, *arrays)
         else:
+            t0 = time.perf_counter()
             out = jitted(key, *arrays)
+        if new_program:
+            # jax.jit compiles lazily on this first dispatch; the elapsed
+            # time is trace+compile (dispatch returns right after compile
+            # under async execution)
+            _stat.record_span("jit.compile", time.perf_counter() - t0)
         return jax.tree.map(Tensor, out)
 
     def __get__(self, instance, owner=None):
@@ -358,26 +474,10 @@ class TrainStep:
         self._donate = donate
         self._step_fn = step_fn
         self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+        # AOT executables keyed by batch signature (aot_compile): phases
+        # timed, persistent-cache hit observed, cost_analysis free
+        self._exec = {}
         self._scan_jit = {}
-
-    def _count_compile(self, jitted, t0):
-        """Fold a just-returned dispatch into the retrace/compile
-        counters when it traced a new program (dispatch returns right
-        after compile under async execution, so the elapsed time is
-        trace+compile, not step runtime)."""
-        import time
-        try:
-            n = jitted._cache_size()
-        except AttributeError:
-            return
-        counts = self.__dict__.setdefault("_traced_counts", {})
-        prev = counts.get(id(jitted), 0)
-        if n > prev:
-            dt = time.perf_counter() - t0
-            self.retraces += n - prev
-            self.compile_s += dt
-            self.last_compile_s = dt
-            counts[id(jitted)] = n
 
     def run_steps(self, n, *batch, data_per_step=False):
         """Run `n` optimizer steps in ONE XLA dispatch (lax.scan over the
@@ -438,38 +538,84 @@ class TrainStep:
                 return losses, p, s, sc
 
             if len(self._scan_jit) >= 8:  # bound compile-cache growth
-                evicted = self._scan_jit.pop(next(iter(self._scan_jit)))
-                # drop its retrace-counter entry too: a later jit object
-                # could reuse the freed id and inherit a stale count
-                self.__dict__.setdefault("_traced_counts", {}).pop(
-                    id(evicted), None)
-            self._scan_jit[sig] = jax.jit(
+                self._scan_jit.pop(next(iter(self._scan_jit)))
+            jitted = jax.jit(
                 multi, donate_argnums=(0, 1, 2) if self._donate else ())
+            _stat.begin_span("train.run_steps")
+            try:
+                self._scan_jit[sig] = aot_compile(
+                    jitted, (self.params, self.opt_state, self.scaler_state,
+                             self.buffers, key, lr, base, *arrays))
+            finally:
+                _stat.end_span()
         else:  # LRU: re-insert so cycling signatures don't thrash
             self._scan_jit[sig] = self._scan_jit.pop(sig)
-        import time
-        t0 = time.perf_counter()
-        losses, self.params, self.opt_state, self.scaler_state = \
-            self._scan_jit[sig](
-                self.params, self.opt_state, self.scaler_state,
-                self.buffers, key, lr, base, *arrays)
-        self._count_compile(self._scan_jit[sig], t0)
+        compiled, _info = self._scan_jit[sig]
+        count_train_use(self, _info)
+        _stat.begin_span("train.run_steps")
+        try:
+            losses, self.params, self.opt_state, self.scaler_state = \
+                compiled(self.params, self.opt_state, self.scaler_state,
+                         self.buffers, key, lr, base, *arrays)
+        finally:
+            dt = _stat.end_span()
+        _monitor.histogram("train.run_steps_s").observe(dt)
+        _monitor.export_step({"steps": n, "dispatch_s": float(dt),
+                              "flops": float(_info.get("flops", 0.0))},
+                             kind="scan")
         self._step_i += n
         return Tensor(losses)
 
-    def __call__(self, *batch):
-        import time
+    def _prep(self, batch, step_i):
+        """(sig, full arg tuple) for one dispatch — the ONE place the
+        call signature is built: __call__ and the inspection paths must
+        agree exactly, because the cached executable bakes the input
+        avals."""
         arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        args = (self.params, self.opt_state, self.scaler_state,
+                self.buffers, split_key(),
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                step_i, *arrays)
+        return sig, args
+
+    def __call__(self, *batch):
         self._step_i += 1
-        key = split_key()
-        lr = self.optimizer.get_lr()
-        t0 = time.perf_counter()
-        loss, self.params, self.opt_state, self.scaler_state = self._jitted(
-            self.params, self.opt_state, self.scaler_state, self.buffers,
-            key, jnp.asarray(lr, jnp.float32), self._step_i, *arrays)
-        self._count_compile(self._jitted, t0)
+        sig, args = self._prep(batch, self._step_i)
+        _stat.begin_span("train.step")
+        try:
+            entry = self._exec.get(sig)
+            compiled_now = entry is None
+            if compiled_now:
+                entry = self._exec[sig] = aot_compile(self._jitted, args)
+            compiled, info = entry
+            count_train_use(self, info)
+            loss, self.params, self.opt_state, self.scaler_state = \
+                compiled(*args)
+        finally:
+            dispatch_s = _stat.end_span()
+        export_step_metrics(self, dispatch_s, info, compiled_now)
         return Tensor(loss)
+
+    def cost_analysis(self, *batch):
+        """XLA's analytical cost report for THIS batch signature's
+        per-step executable ({'flops', 'bytes accessed', ...}) — free
+        when the step has already run (the AOT executable is cached);
+        otherwise compiles it first (warm via the persistent cache)
+        without touching the retrace counters."""
+        return _cost.cost_analysis(self._executable(*batch))
+
+    def flops(self, *batch):
+        """Per-step FLOPs of the compiled executable (0.0 unknown)."""
+        return _cost.executable_flops(self._executable(*batch))
+
+    def _executable(self, *batch):
+        sig, args = self._prep(batch, self._step_i + 1)
+        entry = self._exec.get(sig)
+        if entry is None:
+            entry = self._exec[sig] = aot_compile(self._jitted, args)
+        return entry[0]
 
     def sync_to_model(self):
         named = dict(self.model.named_parameters())
@@ -481,10 +627,7 @@ class TrainStep:
 
     def compiled_text(self, *batch):
         """Optimized HLO of the per-step executable (inspection/tests:
-        the donation proof greps input_output_alias entries here)."""
-        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                  for b in batch]
-        return self._jitted.lower(
-            self.params, self.opt_state, self.scaler_state, self.buffers,
-            split_key(), jnp.asarray(self.optimizer.get_lr(), jnp.float32),
-            self._step_i + 1, *arrays).compile().as_text()
+        the donation proof greps input_output_alias entries here).
+        Reuses the AOT executable cache — no extra compile after a
+        step has run with this signature."""
+        return self._executable(*batch).as_text()

@@ -1,18 +1,34 @@
 """paddle.profiler. Parity: python/paddle/profiler/ (profiler.py,
-RecordEvent, export_chrome_tracing).
+profiler_statistic.py, RecordEvent, export_chrome_tracing).
 
-TPU-native: wraps jax.profiler — traces are XLA/TPU-aware (HLO op
-timelines, HBM usage) and open in TensorBoard/Perfetto, strictly more
-detail than the reference's host-side chrome trace.
+Two layers, like the reference:
+
+- **Device traces** wrap jax.profiler — XLA/TPU-aware timelines (HLO op
+  schedules, HBM usage) that open in TensorBoard/Perfetto, strictly more
+  detail than the reference's chrome trace.
+- **Host statistics** (`statistic.py`): `RecordEvent` records nested
+  spans in-process in addition to the trace annotation, every framework
+  hot path (jit compile, train step, DataLoader, collectives, memory
+  queries) reports into the same store, and `Profiler.summary()` renders
+  the aggregated table the reference's profiler_statistic.py prints.
+  The metrics registry (`monitor.py`) and the cost-analysis helpers
+  (`cost.py`) ride along. See docs/OBSERVABILITY.md.
 """
-import contextlib
+import json
 import os
 import time
 
 import jax
 
+from . import statistic
+from . import monitor
+from . import cost
+from .statistic import SortedKeys
+
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "ProfilerResult", "SortedKeys",
+           "statistic", "monitor", "cost"]
 
 
 class ProfilerTarget:
@@ -75,8 +91,37 @@ class Profiler:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+        self.export_host_stats()
         if self._on_ready:
             self._on_ready(self)
+
+    def export_host_stats(self, path=None):
+        """Write the aggregated host spans + metrics registry to
+        `<PADDLE_PROFILER_DIR>/host_stats.json` (or `path`) — the
+        artifact `load_profiler_result` reads back. Non-zero ranks get a
+        `host_stats.rank<r>.json` suffix so a shared profiler dir keeps
+        every rank's payload instead of last-writer-wins. Returns the
+        path, or None when the filesystem refuses (telemetry never
+        raises)."""
+        if path is None:
+            r = monitor.rank()
+            name = "host_stats.json" if r == 0 else \
+                f"host_stats.rank{r}.json"
+            path = os.path.join(self._dir, name)
+        payload = {"schema": "paddle_tpu.host_stats.v1",
+                   "recorded_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime()),
+                   "rank": monitor.rank(),
+                   "step_times_s": list(self._step_times),
+                   "spans": statistic.snapshot(),
+                   "metrics": monitor.metrics_snapshot()}
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        except (OSError, TypeError, ValueError):
+            return None
+        return path
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -96,10 +141,46 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        print(self.step_info())
-        if not self._timer_only:
-            print(f"trace written to {self._dir} (open in TensorBoard/"
-                  "Perfetto)")
+        """Aggregated host-span table + metrics registry + derived
+        performance accounting (cost-analysis FLOPs / MFU gauges the
+        instrumented train steps publish). Prints AND returns the text
+        (the reference prints; returning makes it testable/loggable)."""
+        parts = [self.step_info(),
+                 "",
+                 "----- host spans (RecordEvent + framework hot paths) "
+                 "-----",
+                 statistic.summary_table(sorted_by=sorted_by,
+                                         time_unit=time_unit,
+                                         thread_sep=thread_sep)]
+        metrics = monitor.metrics_snapshot()
+        if metrics:
+            parts += ["", "----- metrics registry -----"]
+            for name, val in metrics.items():
+                if isinstance(val, dict):  # histogram stats
+                    parts.append(
+                        f"{name:<44}  count={val['count']} "
+                        f"avg={val['avg']*1e3:.3f}ms "
+                        f"max={val['max']*1e3:.3f}ms")
+                else:
+                    parts.append(f"{name:<44}  {val}")
+        flops = metrics.get("train.flops_per_step", 0)
+        if flops:
+            peak = cost.device_peak_flops()
+            parts += ["", "----- cost analysis (XLA) -----",
+                      f"train step FLOPs:        {flops:.3e}",
+                      f"train step bytes:        "
+                      f"{metrics.get('train.bytes_per_step', 0):.3e}",
+                      f"device nominal peak:     "
+                      f"{peak:.3e} FLOP/s" if peak else
+                      "device nominal peak:     unknown (CPU backend)",
+                      f"last-step MFU:           "
+                      f"{metrics.get('train.mfu', 0):.4f}"]
+        if not self._timer_only and self._t0 is not None:
+            parts += ["", f"device trace written to {self._dir} (open in "
+                          "TensorBoard/Perfetto)"]
+        text = "\n".join(parts)
+        print(text)
+        return text
 
     def __enter__(self):
         self.start()
@@ -111,14 +192,17 @@ class Profiler:
 
 
 class RecordEvent:
-    """Annotates a named region onto the device trace
-    (jax.profiler.TraceAnnotation)."""
+    """Named region: annotates the device trace
+    (jax.profiler.TraceAnnotation) AND records a nested host span into
+    the in-process statistics store, so `Profiler.summary()` can render
+    real aggregated tables without a trace viewer."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
 
     def begin(self):
+        statistic.begin_span(self.name)
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
 
@@ -126,6 +210,7 @@ class RecordEvent:
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+            statistic.end_span()
 
     def __enter__(self):
         self.begin()
@@ -136,7 +221,73 @@ class RecordEvent:
         return False
 
 
+class ProfilerResult:
+    """Queryable view over exported telemetry: host-span aggregates
+    (`spans`, `get`, `total_s`), per-step metric records (`steps`), and
+    the metrics registry snapshot (`metrics`)."""
+
+    def __init__(self, spans=None, metrics=None, steps=None,
+                 step_times_s=None, source=None):
+        self.span_tree = spans or []
+        self.spans = statistic.flatten(self.span_tree)
+        self.metrics = metrics or {}
+        self.steps = steps or []
+        self.step_times_s = step_times_s or []
+        self.source = source
+
+    def get(self, name):
+        """All aggregated span records with this name (any nesting)."""
+        return [s for s in self.spans if s["name"] == name]
+
+    def total_s(self, name):
+        return sum(s["total_s"] for s in self.get(name))
+
+    def summary(self):
+        names = sorted({s["name"] for s in self.spans})
+        return (f"ProfilerResult({self.source}): {len(self.spans)} span "
+                f"rows ({', '.join(names[:8])}"
+                f"{'...' if len(names) > 8 else ''}), "
+                f"{len(self.steps)} step records, "
+                f"{len(self.metrics)} metrics")
+
+    def __repr__(self):
+        return self.summary()
+
+
 def load_profiler_result(filename):
-    raise NotImplementedError(
-        "open the perfetto trace produced by Profiler in the TensorBoard "
-        "profile plugin")
+    """Load exported telemetry back into a queryable ProfilerResult.
+
+    Accepts: a profiler directory (reads its host_stats.json), the
+    host_stats.json itself, or a metrics JSONL file written via
+    PADDLE_TPU_METRICS_FILE (one JSON object per line; `kind == "step"`
+    records land in `.steps`)."""
+    path = filename
+    if os.path.isdir(path):
+        path = os.path.join(path, "host_stats.json")
+    with open(path) as f:
+        text = f.read()
+    try:
+        payload = json.loads(text)
+    except ValueError:
+        payload = None
+    if isinstance(payload, dict) and "spans" in payload:
+        return ProfilerResult(spans=payload.get("spans"),
+                              metrics=payload.get("metrics"),
+                              step_times_s=payload.get("step_times_s"),
+                              source=path)
+    # JSONL metrics export: one object per line
+    steps, other = [], []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            raise ValueError(
+                f"{path}:{lineno}: not a host_stats.json export and not "
+                f"valid JSONL ({e})") from None
+        (steps if rec.get("kind") == "step" else other).append(rec)
+    result = ProfilerResult(steps=steps, source=path)
+    result.records = steps + other
+    return result

@@ -1,0 +1,80 @@
+"""Derived performance accounting: XLA cost analysis → FLOPs/bytes/MFU.
+
+"Operator Fusion in XLA: Analysis and Evaluation" (PAPERS.md) identifies
+XLA's own cost analysis as the per-executable source of truth for FLOPs
+and bytes moved — exactly the denominator-side evidence a bench attempt
+or a Profiler.summary() needs. jax exposes it as
+`compiled.cost_analysis()`; this module normalizes the return shape
+(list-of-dicts on some jaxlibs, dict on others), maps device kinds to
+nominal bf16 peak FLOP/s, and derives MFU.
+
+TrainStep/HybridTrainStep dispatch through explicitly compiled
+executables (jit/api.py), so the analysis here is free — no re-lower, no
+re-compile.
+"""
+
+__all__ = ["cost_analysis", "executable_flops", "executable_bytes",
+           "device_peak_flops", "mfu", "PEAK_BF16_FLOPS"]
+
+# nominal bf16 peak per chip generation (matmul TFLOP/s), keyed by
+# substrings of jax.Device.device_kind
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def cost_analysis(compiled):
+    """XLA's analytical cost report for a compiled executable as a plain
+    dict ({} when the backend exposes none). Keys of interest: 'flops',
+    'bytes accessed', plus per-operand 'bytes accessed{N}' entries."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return dict(ca)
+    except Exception:
+        return {}
+
+
+def executable_flops(compiled):
+    """Per-execution FLOPs of a compiled executable (0.0 if unknown)."""
+    return float(cost_analysis(compiled).get("flops", 0.0))
+
+
+def executable_bytes(compiled):
+    """Bytes accessed per execution (0.0 if unknown)."""
+    return float(cost_analysis(compiled).get("bytes accessed", 0.0))
+
+
+def device_peak_flops(device=None, default=0.0):
+    """Nominal bf16 peak FLOP/s for the attached chip generation;
+    `default` (0.0 = unknown) for backends without a table entry (CPU).
+    Touches jax.devices() — callers on the no-backend-init path must
+    guard."""
+    try:
+        import jax
+        d = device if device is not None else jax.devices()[0]
+        kind = d.device_kind.lower()
+    except Exception:
+        return default
+    for key, peak in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return peak
+    return default
+
+
+def mfu(flops_per_step, step_time_s, peak_flops=None):
+    """Model FLOPs utilization: achieved FLOP/s over the chip's nominal
+    peak. 0.0 when any input is unknown (missing cost analysis, CPU
+    backend, zero step time)."""
+    if peak_flops is None:
+        peak_flops = device_peak_flops()
+    if not flops_per_step or not step_time_s or not peak_flops:
+        return 0.0
+    return float(flops_per_step) / float(step_time_s) / float(peak_flops)

@@ -1,0 +1,179 @@
+"""Global metrics registry: counters / gauges / histograms + a JSONL
+per-step exporter.
+
+Every framework hot path reports here (jit compiles and retraces, train
+steps, DataLoader batch waits, collectives, device memory peaks), so a
+training process carries its own always-on flight recorder:
+
+    from paddle_tpu.profiler import monitor
+    monitor.counter("jit.retraces").inc()
+    monitor.gauge("train.mfu").set(0.41)
+    monitor.histogram("dataloader.wait_s").observe(dt)
+    monitor.metrics_snapshot()   # {name: value-or-stats}
+
+Exporter: with `PADDLE_TPU_METRICS_FILE` set, `export_step(record)`
+appends ONE JSON object per line, tagged with a wall-clock `ts`, the
+process `rank` (from the launch env), and a `kind`. TrainStep /
+HybridTrainStep call it once per optimizer step with the documented step
+schema (step, step_time_s, compile_s, cache_hit, peak_bytes, flops, mfu
+— validated by tools/check_metrics_schema.py); see docs/OBSERVABILITY.md.
+"""
+import json
+import os
+import threading
+import time
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
+           "histogram", "get_metric", "metrics_snapshot", "reset_metrics",
+           "rank", "metrics_file", "export_step"]
+
+_lock = threading.RLock()
+_export_lock = threading.Lock()  # file appends only: registry ops must
+_registry = {}                   # never stall behind metrics-file I/O
+
+
+class Counter:
+    """Monotonically increasing count (calls, bytes, cache hits)."""
+    kind = "counter"
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, v=1):
+        with _lock:
+            self.value += v
+        return self.value
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge:
+    """Last-observed value (peak bytes, current MFU)."""
+    kind = "gauge"
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def set(self, v):
+        with _lock:
+            self.value = v
+        return v
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram:
+    """Streaming count/sum/min/max/last of observations (durations)."""
+    kind = "histogram"
+
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self.last = 0.0
+
+    def observe(self, v):
+        v = float(v)
+        with _lock:
+            self.count += 1
+            self.sum += v
+            self.last = v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    @property
+    def avg(self):
+        return self.sum / self.count if self.count else 0.0
+
+    def snapshot(self):
+        return {"count": self.count, "sum": self.sum, "avg": self.avg,
+                "min": self.min if self.count else 0.0, "max": self.max,
+                "last": self.last}
+
+
+def _get_or_create(name, cls):
+    with _lock:
+        m = _registry.get(name)
+        if m is None:
+            m = _registry[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, requested {cls.__name__}")
+        return m
+
+
+def counter(name):
+    return _get_or_create(name, Counter)
+
+
+def gauge(name):
+    return _get_or_create(name, Gauge)
+
+
+def histogram(name):
+    return _get_or_create(name, Histogram)
+
+
+def get_metric(name):
+    return _registry.get(name)
+
+
+def metrics_snapshot():
+    """{name: scalar (counter/gauge) or stats dict (histogram)} — JSON
+    serializable, sorted by name."""
+    with _lock:
+        return {name: _registry[name].snapshot()
+                for name in sorted(_registry)}
+
+
+def reset_metrics():
+    with _lock:
+        _registry.clear()
+
+
+def rank():
+    """This process's rank from the launch env (0 single-controller).
+    Read from env, NOT jax.process_index(): telemetry must never force
+    backend init."""
+    for var in ("PADDLE_TPU_PROCESS_ID", "PADDLE_TRAINER_ID"):
+        v = os.environ.get(var)
+        if v is not None and v != "":
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+def metrics_file():
+    """The JSONL export path, or None when export is off."""
+    return os.environ.get("PADDLE_TPU_METRICS_FILE") or None
+
+
+def export_step(record, kind="step"):
+    """Append one rank-tagged JSON line to PADDLE_TPU_METRICS_FILE.
+    No-op (returns False) when the env var is unset; never raises —
+    telemetry must not take down a train loop."""
+    path = metrics_file()
+    if not path:
+        return False
+    rec = {"ts": time.time(), "rank": rank(), "kind": kind}
+    rec.update(record)
+    try:
+        line = json.dumps(rec)
+    except (TypeError, ValueError):
+        return False
+    try:
+        with _export_lock, open(path, "a") as f:
+            f.write(line + "\n")
+    except OSError:
+        return False
+    return True

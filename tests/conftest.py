@@ -14,6 +14,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # processes. Unconditional: subprocess tests that exercise the cache set
 # the env var explicitly in their child environments.
 os.environ["PADDLE_TPU_COMPILE_CACHE"] = "0"
+# an inherited metrics export path must not collect the whole suite's
+# step records; telemetry tests set it explicitly (tmp_path)
+os.environ.pop("PADDLE_TPU_METRICS_FILE", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Schema lint for paddle_tpu metrics JSONL exports.
+
+The per-step metrics file (PADDLE_TPU_METRICS_FILE, written by
+paddle_tpu/profiler/monitor.py export_step) is a contract between the
+framework, bench.py, and whatever driver/dashboard tails it. This tool
+is the contract's enforcement point: tests/test_telemetry.py runs it on
+a freshly emitted file, so the schema can't silently drift.
+
+Schema (documented in docs/OBSERVABILITY.md):
+
+  every line    one JSON object, no blank interior lines required keys:
+                  ts    number   unix seconds
+                  rank  int      process rank (0 single-controller)
+                  kind  str      record type ("step", "scan", ...)
+  kind == "step" additionally requires:
+                  step         int     optimizer step index (>= 1)
+                  step_time_s  number  wall seconds attributed to the step
+                  compile_s    number  trace+compile seconds (0 warm)
+                  cache_hit    bool    executable came from a cache
+                  peak_bytes   int     device memory high-water mark
+                  flops        number  per-step FLOPs (XLA cost analysis;
+                                       0.0 when unavailable)
+                  mfu          number  in [0, ~1]; 0.0 when unknown
+
+Extra keys are allowed (the schema is open for forward compat); missing
+or mistyped required keys are violations.
+
+Usage: python tools/check_metrics_schema.py FILE [FILE...]
+Exit 0 when every line of every file validates, 1 otherwise.
+"""
+import json
+import sys
+
+BASE_REQUIRED = {"ts": (int, float), "rank": int, "kind": str}
+STEP_REQUIRED = {"step": int, "step_time_s": (int, float),
+                 "compile_s": (int, float), "cache_hit": bool,
+                 "peak_bytes": int, "flops": (int, float),
+                 "mfu": (int, float)}
+
+
+def _check_types(rec, required, where, errors):
+    for key, types in required.items():
+        if key not in rec:
+            errors.append(f"{where}: missing required key {key!r}")
+            continue
+        val = rec[key]
+        # bool is an int subclass: only cache_hit may be bool
+        if isinstance(val, bool) and types is not bool:
+            errors.append(f"{where}: key {key!r} is bool, expected "
+                          f"{types}")
+        elif not isinstance(val, types):
+            errors.append(f"{where}: key {key!r} has type "
+                          f"{type(val).__name__}, expected {types}")
+
+
+def validate_line(line, where="<line>"):
+    """Errors (list of strings, empty = valid) for one JSONL line."""
+    errors = []
+    try:
+        rec = json.loads(line)
+    except ValueError as e:
+        return [f"{where}: not valid JSON ({e})"]
+    if not isinstance(rec, dict):
+        return [f"{where}: not a JSON object"]
+    _check_types(rec, BASE_REQUIRED, where, errors)
+    if rec.get("kind") == "step":
+        _check_types(rec, STEP_REQUIRED, where, errors)
+        if isinstance(rec.get("step"), int) and \
+                not isinstance(rec.get("step"), bool) and rec["step"] < 1:
+            errors.append(f"{where}: step must be >= 1, got {rec['step']}")
+    return errors
+
+
+def validate_file(path):
+    """All violations in one file; ["<path>: empty file"] when empty."""
+    errors = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    if not any(line.strip() for line in lines):
+        return [f"{path}: empty file (no records emitted)"]
+    for lineno, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        errors.extend(validate_line(line, f"{path}:{lineno}"))
+    return errors
+
+
+def main(argv):
+    if not argv:
+        print(__doc__.strip().splitlines()[-2].strip())
+        return 2
+    all_errors = []
+    for path in argv:
+        all_errors.extend(validate_file(path))
+    for err in all_errors:
+        print(err)
+    if all_errors:
+        print(f"FAIL: {len(all_errors)} schema violation(s)")
+        return 1
+    print(f"OK: {len(argv)} file(s) validate")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
