@@ -25,6 +25,7 @@ from ...framework.core import Tensor, no_grad, _Slot
 from ...framework.random import split_key
 from ...jit.api import (functional_call, state_arrays, aot_compile,
                         count_train_use, export_step_metrics)
+from ...jit.deferred import DeferredLoss
 from ...profiler import statistic as _stat
 from ...profiler import monitor as _monitor
 from ...profiler import cost as _cost
@@ -159,6 +160,7 @@ class HybridTrainStep:
         sp_deg = mesh.shape.get("sp", 1)
         self.batch_sharding = NamedSharding(
             mesh, P(("dp",), "sp") if sp_deg > 1 else P(("dp",)))
+        self._dp_only = NamedSharding(mesh, P(("dp",)))
         loss_sharding = NamedSharding(mesh, P())
 
         model_ref = model
@@ -260,16 +262,28 @@ class HybridTrainStep:
         # cost_analysis free
         self._exec = {}
 
+    def input_sharding(self, arr):
+        """Sharding the compiled step expects for a batch leaf (batch dim
+        over 'dp', sequence over 'sp' when sequence-parallel). The device
+        prefetch ring (io/device_prefetch.py) places H2D copies with this
+        while the previous step computes, so `_prep` below finds the
+        arrays already resident and sharded."""
+        return self.batch_sharding if arr.ndim >= 2 else self._dp_only
+
     def _prep(self, batch, step_i):
         """(sig, full arg tuple) for one dispatch — the ONE place the
         batch is sharded and the signature built: __call__ and the
         inspection paths must agree exactly, because the cached
-        executable bakes the input shardings."""
-        dp_only = NamedSharding(self.mesh, P(("dp",)))
-        arrays = [jax.device_put(
-            a, self.batch_sharding if a.ndim >= 2 else dp_only)
-            for a in (b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                      for b in batch)]
+        executable bakes the input shardings. An array that already
+        carries its target sharding (prefetch ring) passes through
+        without a fresh device_put."""
+        arrays = []
+        for b in batch:
+            a = b.value if isinstance(b, Tensor) else jnp.asarray(b)
+            sh = self.input_sharding(a)
+            if getattr(a, "sharding", None) != sh:
+                a = jax.device_put(a, sh)
+            arrays.append(a)
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
         args = (self.params, self.opt_state, self.scaler_state,
                 self.buffers, split_key(),
@@ -293,7 +307,9 @@ class HybridTrainStep:
         finally:
             dispatch_s = _stat.end_span()
         export_step_metrics(self, dispatch_s, info, compiled_now)
-        return Tensor(loss)
+        # non-blocking handle (see jit/deferred.py): the fit loop keeps
+        # dispatching while the loss streams back
+        return DeferredLoss(loss)
 
     def cost_analysis(self, *batch):
         """XLA cost report for this batch signature's SPMD executable

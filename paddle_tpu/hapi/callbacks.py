@@ -114,7 +114,10 @@ class ProgBarLogger(Callback):
         self.steps += 1
         if self.verbose and step % self.log_freq == 0:
             loss = logs.get("loss")
-            lstr = ", ".join(f"{v:.4f}" for v in loss) if loss else "-"
+            # float() resolves a deferred loss handle — log_freq
+            # boundaries are the fit loop's only mid-epoch host sync
+            lstr = ", ".join(f"{float(v):.4f}" for v in loss) \
+                if loss else "-"
             extra = f", {dt * 1000:.0f} ms/step"
             # cost-analysis MFU published by the jitted train steps
             # (jit/api.py export_step_metrics); eager fit() has no
@@ -238,21 +241,35 @@ class VisualDL(Callback):
         self.log_dir = log_dir
         self._f = None
         self._step = 0
+        self._pending = []
 
     def on_train_begin(self, logs=None):
         os.makedirs(self.log_dir, exist_ok=True)
         self._f = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
 
     def on_train_batch_end(self, step, logs=None):
-        import json
-        logs = logs or {}
-        loss = logs.get("loss")
-        if loss and self._f:
-            self._f.write(json.dumps(
-                {"step": self._step, "loss": float(loss[0])}) + "\n")
+        # hold the (deferred) loss handle — resolving here would block
+        # the host on the step dispatched microseconds ago, undoing the
+        # async loop; scalars flush at epoch/train end
+        loss = (logs or {}).get("loss")
+        if loss:
+            self._pending.append((self._step, loss[0]))
         self._step += 1
 
+    def _drain(self):
+        import json
+        if self._f:
+            for s, v in self._pending:
+                self._f.write(json.dumps(
+                    {"step": s, "loss": float(v)}) + "\n")
+            self._f.flush()
+        self._pending = []
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._drain()
+
     def on_train_end(self, logs=None):
+        self._drain()
         if self._f:
             self._f.close()
 

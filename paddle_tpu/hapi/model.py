@@ -20,6 +20,40 @@ class _InputSpecList(list):
     pass
 
 
+def _resolve_scalars(values):
+    """Deferred loss handles -> floats. The ONE deliberate host sync
+    point of the fit loop: called at log_freq boundaries (ProgBarLogger)
+    and epoch end, never per step."""
+    return [float(v) for v in values or []]
+
+
+def _stack_batches(batches):
+    """k loader batches (lists of Tensors) -> one list of Tensors with a
+    leading microbatch dim of k, the layout TrainStep.accumulate scans."""
+    import jax.numpy as jnp
+    out = []
+    for j in range(len(batches[0])):
+        vals = [b[j].value if isinstance(b[j], Tensor)
+                else jnp.asarray(b[j]) for b in batches]
+        out.append(Tensor(jnp.stack(vals)))
+    return out
+
+
+def _batch_shapes(batch):
+    """Shape signature of one loader batch — microbatches can only stack
+    into one scanned update when every field's shape matches."""
+    return [tuple(t.shape) if hasattr(t, "shape") else None for t in batch]
+
+
+def _unbind_fit_sharding(loader):
+    """Release a fit-bound prefetch sharding fn (a bound method of a
+    TrainStep — holding it pins the step's device state). User-set fns
+    are not fit's to release."""
+    if getattr(loader, "_sharding_from_fit", False):
+        loader._batch_sharding_fn = None
+        loader._sharding_from_fit = False
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -54,14 +88,26 @@ class Model:
 
     # -- steps ---------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        """Public single-step API: a deliberate sync point (returns
+        resolved floats). The fit loop does NOT go through here — it
+        keeps the deferred handles unresolved between log boundaries."""
         self._ensure_train_step()
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
         loss = self._train_step(*ins, labs[0])
-        return [float(loss.item())]
+        return _resolve_scalars([loss])
 
     @no_grad()
     def eval_batch(self, inputs, labels=None):
+        losses, metrics = self._eval_batch_async(inputs, labels)
+        return _resolve_scalars(losses), metrics
+
+    @no_grad()
+    def _eval_batch_async(self, inputs, labels=None):
+        """eval_batch that returns deferred loss handles instead of
+        floats — evaluate() drains them all at the end of the pass, so
+        evaluation doesn't serialize dispatch on a per-batch fetch."""
+        from ..jit.deferred import DeferredLoss
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
         if self._train_step is not None:
@@ -76,7 +122,7 @@ class Model:
             m.update(res)
             metrics.append(m.accumulate())
         self.network.train()
-        return ([float(loss.item())] if loss is not None else []), metrics
+        return ([DeferredLoss(loss)] if loss is not None else []), metrics
 
     @no_grad()
     def predict_batch(self, inputs):
@@ -90,37 +136,144 @@ class Model:
         outs = out if isinstance(out, (list, tuple)) else [out]
         return [o.numpy() for o in outs]
 
+    def _dispatch_micro(self, micro):
+        """One optimizer update from >= 1 queued loader batches, in one
+        jitted dispatch, returning a deferred (non-blocking) loss handle:
+        a single batch goes through the per-step program, several go
+        through the scanned accumulation program (one update for all)."""
+        self._ensure_train_step()  # eval drops it (sync_to_model)
+        if len(micro) == 1:
+            batch = micro[0]
+            return self._train_step(*batch[:-1], batch[-1])
+        return self._train_step.accumulate(len(micro),
+                                           *_stack_batches(micro))
+
     # -- loops ---------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        """The async step loop: every iteration dispatches work and keeps
+        going — the loss lands in `logs` as a deferred handle that
+        ProgBarLogger resolves only at `log_freq` boundaries and this
+        loop resolves at epoch end, so the host never blocks on the
+        device mid-stride. With `accumulate_grad_batches=k`, k loader
+        batches fold into ONE scanned optimizer update (one `step` /
+        callback round per update; `num_iters` counts updates). A loader
+        built with `prefetch_to_device=` stages upcoming batches onto the
+        device (with this model's step input shardings) while the
+        current step computes."""
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        k = max(1, int(accumulate_grad_batches or 1))
+
+        def _bind_prefetch_sharding():
+            # (re)bind the CURRENT step for the device prefetch ring — a
+            # fn bound to an older step (previous fit, or the step
+            # evaluate() dropped) must not pin that step's device state
+            # nor shadow this one's shardings; an explicitly user-set fn
+            # is left alone. Without prefetch this does nothing, so the
+            # TrainStep keeps its lazy first-batch creation (callbacks
+            # that mutate weights in on_train_begin/on_epoch_begin run
+            # first either way).
+            if not getattr(loader, "prefetch_to_device", 0):
+                return
+            self._ensure_train_step()
+            if hasattr(self._train_step, "input_sharding") and \
+                    (getattr(loader, "_batch_sharding_fn", None) is None
+                     or getattr(loader, "_sharding_from_fit", False)):
+                loader._batch_sharding_fn = \
+                    self._train_step.input_sharding
+                loader._sharding_from_fit = True
+
         cbks = cb_mod.config_callbacks(callbacks, self, epochs, None,
                                        verbose, log_freq, save_dir,
                                        save_freq, self._metrics)
         cbks.on_begin("train")
+        try:
+            self._fit_epochs(loader, eval_data, batch_size, epochs,
+                             eval_freq, save_dir, save_freq, num_workers,
+                             cbks, k, num_iters, _bind_prefetch_sharding)
+        finally:
+            # a loader that outlives this fit must not pin the step
+            _unbind_fit_sharding(loader)
+            # on_end in the finally: callbacks that buffer until train
+            # end (VisualDL's deferred scalars) still drain when an
+            # epoch dies mid-flight
+            cbks.on_end("train")
+
+    def _fit_epochs(self, loader, eval_data, batch_size, epochs,
+                    eval_freq, save_dir, save_freq, num_workers, cbks, k,
+                    num_iters, bind_sharding):
         steps_done = 0
+        ragged_warned = False
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
+            bind_sharding()  # after callbacks; evaluate() drops the step
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(loader):
-                ins, labs = batch[:-1], batch[-1]
+            micro = []
+            step = 0
+            hit_iters = False
+            ragged_flushes = 0  # ONE tail flush per epoch is expected
+
+            def _one_update(group):
+                nonlocal logs, step, steps_done, hit_iters
                 cbks.on_batch_begin("train", step, logs)
-                losses = self.train_batch(list(ins), labs)
-                logs = {"loss": losses, "step": step}
+                loss = self._dispatch_micro(group)
+                logs = {"loss": [loss], "step": step}
                 cbks.on_batch_end("train", step, logs)
+                step += 1
                 steps_done += 1
                 if num_iters is not None and steps_done >= num_iters:
-                    break
+                    hit_iters = True
+
+            for batch in loader:
+                if micro and _batch_shapes(batch) != _batch_shapes(
+                        micro[0]):
+                    # ragged batch (drop_last=False tail) can't stack
+                    # with the queued group: flush the group as its own
+                    # (smaller) update first
+                    ragged_flushes += 1
+                    if ragged_flushes == 2 and not ragged_warned:
+                        # a second early flush in ONE epoch means
+                        # variable batch shapes are silently degrading
+                        # accumulation toward per-batch updates
+                        ragged_warned = True
+                        import warnings
+                        warnings.warn(
+                            "accumulate_grad_batches: consecutive batch "
+                            "shapes keep differing, so microbatch groups "
+                            "flush early (effective accumulation < "
+                            f"{k}); pad or bucket batches to uniform "
+                            "shapes for real accumulation")
+                    _one_update(micro)
+                    micro = []
+                    if hit_iters:
+                        break
+                micro.append(batch)
+                if len(micro) >= k:
+                    _one_update(micro)
+                    micro = []
+                    if hit_iters:
+                        break
+            if micro and not hit_iters:
+                # leftover microbatches (dataset size not divisible by
+                # k): still one (smaller) optimizer update
+                _one_update(micro)
+                micro = []
+            if "loss" in logs:  # epoch boundary: the deliberate sync
+                logs["loss"] = _resolve_scalars(logs["loss"])
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                # evaluate() drops the train step to free its device
+                # state — release the loader's reference too, or the
+                # dead step stays resident through the whole eval pass
+                _unbind_fit_sharding(loader)
                 eres = self.evaluate(eval_data, batch_size=batch_size,
                                      verbose=0, num_workers=num_workers)
-                logs.update({"eval_" + k: v for k, v in eres.items()})
+                logs.update({"eval_" + k2: v for k2, v in eres.items()})
             cbks.on_epoch_end(epoch, logs)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(os.path.join(save_dir, str(epoch)))
@@ -128,7 +281,6 @@ class Model:
                 break
             if num_iters is not None and steps_done >= num_iters:
                 break
-        cbks.on_end("train")
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):
@@ -137,11 +289,14 @@ class Model:
                        num_workers=num_workers)
         for m in self._metrics:
             m.reset()
-        losses = []
+        handles = []
         for batch in loader:
             ins, labs = batch[:-1], batch[-1]
-            l, _ = self.eval_batch(list(ins), labs)
-            losses.extend(l)
+            l, _ = self._eval_batch_async(list(ins), labs)
+            handles.extend(l)
+        # one host drain at the end of the pass: per-batch dispatch never
+        # waited on the previous batch's loss fetch
+        losses = _resolve_scalars(handles)
         out = {"loss": [float(np.mean(losses))] if losses else []}
         for m in self._metrics:
             out[m.name()] = m.accumulate()
@@ -154,13 +309,13 @@ class Model:
                        num_workers=num_workers)
         outputs = []
         for batch in loader:
-            ins = batch if not isinstance(batch, (list, tuple)) else batch
-            if isinstance(ins, (list, tuple)) and len(ins) > 1:
+            # a bare (non-list) batch wraps to a one-input forward; a
+            # multi-field batch drops its trailing label field
+            ins = list(batch) if isinstance(batch, (list, tuple)) \
+                else [batch]
+            if len(ins) > 1:
                 ins = ins[:-1]
-            outputs.append(self.predict_batch(list(ins)
-                                              if isinstance(ins, (list,
-                                                                  tuple))
-                                              else [ins]))
+            outputs.append(self.predict_batch(ins))
         if stack_outputs and outputs:
             n_out = len(outputs[0])
             return [np.concatenate([o[i] for o in outputs])

@@ -311,7 +311,13 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, prefetch_to_device=0):
+        """prefetch_to_device: ring depth for the device prefetch layer
+        (io/device_prefetch.py) — a background thread jax.device_puts up
+        to this many upcoming batches (with the train step's input
+        shardings, see `set_batch_sharding`) while the current step
+        computes, so the consumer-side `dataloader.next` wait is ~0 in
+        steady state. 0/False disables (default); True means depth 2."""
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -320,6 +326,10 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = use_shared_memory
         self.persistent_workers = persistent_workers
+        self.prefetch_to_device = 2 if prefetch_to_device is True \
+            else int(prefetch_to_device or 0)
+        self._batch_sharding_fn = None
+        self._sharding_from_fit = False  # fit-bound fns rebind per fit
         self._mp_pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
@@ -384,13 +394,32 @@ class DataLoader:
         if batch and not getattr(self, "drop_last", False):
             yield self.collate_fn(batch)
 
+    def set_batch_sharding(self, fn):
+        """Per-leaf sharding callable (`TrainStep.input_sharding` /
+        `HybridTrainStep.input_sharding`) the device prefetch ring places
+        staged batches with. hapi `Model.fit` wires this automatically;
+        set it yourself when driving a step object directly with
+        `prefetch_to_device` enabled. A fn set here is yours: fit won't
+        replace it (fit-bound fns, by contrast, rebind on every fit so a
+        stale step's device state is never pinned)."""
+        self._batch_sharding_fn = fn
+        self._sharding_from_fit = False
+        return self
+
     def __iter__(self):
         """Iteration wraps the concrete source with telemetry: every
         batch's host-side wait (assembly + queue time — the gap the
-        prefetch ring exists to hide) lands as a "dataloader.next" span
+        prefetch layers exist to hide) lands as a "dataloader.next" span
         and in the dataloader.wait_s histogram, so a starved train step
-        is visible in Profiler.summary() rather than inferred."""
+        is visible in Profiler.summary() rather than inferred. With
+        `prefetch_to_device` set, the device prefetch ring sits between
+        the source and this wait, so the span measures what the *step
+        loop* actually waited — ~0 when the ring keeps up."""
         inner = self._iter_source()
+        if self.prefetch_to_device:
+            from .device_prefetch import device_prefetch_iterator
+            inner = device_prefetch_iterator(inner, self.prefetch_to_device,
+                                             self._batch_sharding_fn)
         while True:
             t0 = time.perf_counter()
             try:

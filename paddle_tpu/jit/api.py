@@ -24,9 +24,11 @@ from ..framework.random import rng_scope, split_key
 from ..profiler import statistic as _stat
 from ..profiler import monitor as _monitor
 from ..profiler import cost as _cost
+from .deferred import DeferredLoss
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
-           "aot_compile", "count_train_use", "export_step_metrics"]
+           "aot_compile", "count_train_use", "export_step_metrics",
+           "DeferredLoss"]
 
 
 def aot_compile(jitted, args):
@@ -390,8 +392,15 @@ class TrainStep:
     of holding a second full copy of the model per step.
 
         step = TrainStep(model, loss_fn, optimizer)
-        loss = step(x, y)          # device arrays stay resident
+        loss = step(x, y)          # DeferredLoss: dispatch returns early
+        float(loss)                # first host read blocks (recorded)
         step.sync_to_model()       # copy back into Parameters when needed
+
+    The returned loss is a `DeferredLoss` (still a Tensor): the host only
+    blocks when the value is actually read, so a steady train loop issues
+    step k+1 while step k computes. `accumulate(k, ...)` folds k
+    microbatches into one scanned update; `run_steps(n, ...)` scans whole
+    optimizer steps.
 
     Compile observability (the warm-start contract the persistent compile
     cache in framework/compile_cache.py is measured by):
@@ -435,40 +444,11 @@ class TrainStep:
 
         def step_fn(params, opt_state, scaler_state, buffers, key, lr,
                     step_i, *batch):
-            def loss_of(ps):
-                reset_aux_losses(model)
-                if model_returns_loss:
-                    out = functional_call(model, ps, buffers, batch,
-                                          rng_key=key, training=True)
-                    l = out.value if isinstance(out, Tensor) else out
-                else:
-                    out = functional_call(model, ps, buffers, batch[:-1],
-                                          rng_key=key, training=True)
-                    tgt = Tensor(batch[-1])
-                    loss_t = loss_fn(
-                        out if isinstance(out, Tensor) else Tensor(out),
-                        tgt)
-                    l = loss_t.value if isinstance(loss_t, Tensor) \
-                        else loss_t
-                aux = collect_aux_losses(model)
-                return l if aux is None else l + aux.astype(l.dtype)
-
-            if scaler is not None and scaler.is_enable():
-                scale = scaler_state["scale"]
-                scaled_loss, grads = jax.value_and_grad(
-                    lambda ps: loss_of(ps).astype(jnp.float32) * scale)(
-                        params)
-                loss = scaled_loss / scale
-                grads, found_inf, new_scaler_state = \
-                    scaler.jit_unscale_and_update(scaler_state, grads)
-            else:
-                loss, grads = jax.value_and_grad(loss_of)(params)
-                found_inf, new_scaler_state = None, scaler_state
-            from ..nn.clip import clip_grads_tree
-            grads = clip_grads_tree(grads, self.optimizer._grad_clip)
-            new_params, new_state = self.optimizer.apply_gradients_tree(
-                params, grads, opt_state, lr, step_i, found_inf=found_inf)
-            return loss, new_params, new_state, new_scaler_state
+            loss, grads = jax.value_and_grad(
+                lambda ps: self._objective(ps, scaler_state, buffers, key,
+                                           batch))(params)
+            return self._finish(loss, grads, params, opt_state,
+                                scaler_state, lr, step_i)
 
         donate_argnums = (0, 1, 2) if donate else ()
         self._donate = donate
@@ -478,6 +458,77 @@ class TrainStep:
         # timed, persistent-cache hit observed, cost_analysis free
         self._exec = {}
         self._scan_jit = {}
+        self._acc_jit = {}
+
+    # -- traced pieces (shared by __call__ / run_steps / accumulate) -----
+    def _loss_of(self, ps, buffers, key, batch):
+        """Scalar training loss of one (micro)batch under the trace."""
+        model, loss_fn = self.model, self.loss_fn
+        reset_aux_losses(model)
+        if self._model_returns_loss:
+            out = functional_call(model, ps, buffers, batch,
+                                  rng_key=key, training=True)
+            l = out.value if isinstance(out, Tensor) else out
+        else:
+            out = functional_call(model, ps, buffers, batch[:-1],
+                                  rng_key=key, training=True)
+            tgt = Tensor(batch[-1])
+            loss_t = loss_fn(
+                out if isinstance(out, Tensor) else Tensor(out), tgt)
+            l = loss_t.value if isinstance(loss_t, Tensor) else loss_t
+        aux = collect_aux_losses(model)
+        return l if aux is None else l + aux.astype(l.dtype)
+
+    def _objective(self, ps, scaler_state, buffers, key, batch):
+        """The differentiated quantity: the loss, scaled when a
+        GradScaler rides inside the step."""
+        l = self._loss_of(ps, buffers, key, batch)
+        if self.scaler is not None and self.scaler.is_enable():
+            return l.astype(jnp.float32) * scaler_state["scale"]
+        return l
+
+    def _finish(self, loss, grads, params, opt_state, scaler_state, lr,
+                step_i):
+        """From (possibly scaled) loss + grads to the updated carry: one
+        unscale/scale-adaptation, one clip, ONE optimizer update —
+        whether the grads came from one batch or a scanned accumulation
+        of k microbatches."""
+        scaler = self.scaler
+        if scaler is not None and scaler.is_enable():
+            loss = loss / scaler_state["scale"]
+            grads, found_inf, new_scaler_state = \
+                scaler.jit_unscale_and_update(scaler_state, grads)
+        else:
+            found_inf, new_scaler_state = None, scaler_state
+        from ..nn.clip import clip_grads_tree
+        grads = clip_grads_tree(grads, self.optimizer._grad_clip)
+        new_params, new_state = self.optimizer.apply_gradients_tree(
+            params, grads, opt_state, lr, step_i, found_inf=found_inf)
+        return loss, new_params, new_state, new_scaler_state
+
+    def _dispatch(self, cache, sig, make_jitted, args, span,
+                  max_entries=None):
+        """The ONE dispatch path every TrainStep program flavor
+        (per-step / scanned steps / scanned accumulation) goes through:
+        executable-cache lookup with optional LRU bound, AOT compile on
+        miss, retrace accounting, timed dispatch. Returns
+        (outputs, info, compiled_now, dispatch_s)."""
+        _stat.begin_span(span)
+        try:
+            entry = cache.get(sig)
+            compiled_now = entry is None
+            if compiled_now:
+                if max_entries and len(cache) >= max_entries:
+                    cache.pop(next(iter(cache)))  # bound compile growth
+                entry = cache[sig] = aot_compile(make_jitted(), args)
+            else:  # LRU: re-insert so cycling signatures don't thrash
+                cache[sig] = cache.pop(sig)
+            compiled, info = entry
+            count_train_use(self, info)
+            out = compiled(*args)
+        finally:
+            dispatch_s = _stat.end_span()
+        return out, info, compiled_now, dispatch_s
 
     def run_steps(self, n, *batch, data_per_step=False):
         """Run `n` optimizer steps in ONE XLA dispatch (lax.scan over the
@@ -517,7 +568,8 @@ class TrainStep:
         # cache below; prefer a fixed segment length plus a per-step tail
         sig = (n, bool(data_per_step),
                tuple((a.shape, str(a.dtype)) for a in arrays))
-        if sig not in self._scan_jit:
+
+        def make_jitted():
             step_fn = self._step_fn
 
             def multi(params, opt_state, scaler_state, buffers, key, lr,
@@ -537,34 +589,102 @@ class TrainStep:
                     jnp.arange(n, dtype=jnp.int32))
                 return losses, p, s, sc
 
-            if len(self._scan_jit) >= 8:  # bound compile-cache growth
-                self._scan_jit.pop(next(iter(self._scan_jit)))
-            jitted = jax.jit(
+            return jax.jit(
                 multi, donate_argnums=(0, 1, 2) if self._donate else ())
-            _stat.begin_span("train.run_steps")
-            try:
-                self._scan_jit[sig] = aot_compile(
-                    jitted, (self.params, self.opt_state, self.scaler_state,
-                             self.buffers, key, lr, base, *arrays))
-            finally:
-                _stat.end_span()
-        else:  # LRU: re-insert so cycling signatures don't thrash
-            self._scan_jit[sig] = self._scan_jit.pop(sig)
-        compiled, _info = self._scan_jit[sig]
-        count_train_use(self, _info)
-        _stat.begin_span("train.run_steps")
-        try:
-            losses, self.params, self.opt_state, self.scaler_state = \
-                compiled(self.params, self.opt_state, self.scaler_state,
-                         self.buffers, key, lr, base, *arrays)
-        finally:
-            dt = _stat.end_span()
+
+        args = (self.params, self.opt_state, self.scaler_state,
+                self.buffers, key, lr, base, *arrays)
+        out, info, compiled_now, dt = self._dispatch(
+            self._scan_jit, sig, make_jitted, args, "train.run_steps",
+            max_entries=8)
+        losses, self.params, self.opt_state, self.scaler_state = out
+        # telemetry keeps dispatch-only time: the first call's span also
+        # covered the compile
+        if compiled_now:
+            dt = max(dt - (info["lower_s"] + info["compile_s"]), 0.0)
         _monitor.histogram("train.run_steps_s").observe(dt)
-        _monitor.export_step({"steps": n, "dispatch_s": float(dt),
-                              "flops": float(_info.get("flops", 0.0))},
-                             kind="scan")
+        _monitor.export_step(
+            {"steps": n,
+             "dispatch_s": float(dt),  # hot-sync-ok: host perf counter
+             "flops": float(  # hot-sync-ok: python dict value, not device
+                 info.get("flops", 0.0))}, kind="scan")
         self._step_i += n
         return Tensor(losses)
+
+    def _make_acc_fn(self, k):
+        """The scanned-microbatch accumulation program: k microbatches
+        folded with ONE optimizer update (reuses the same traced pieces
+        as the per-step path, so GradScaler/clip/donation semantics are
+        identical)."""
+        def acc_fn(params, opt_state, scaler_state, buffers, key, lr,
+                   step_i, *batch):
+            def body(carry, xs):
+                i, micro = xs[0], xs[1:]
+                loss_sum, grads_sum = carry
+                l, g = jax.value_and_grad(
+                    lambda ps: self._objective(
+                        ps, scaler_state, buffers,
+                        jax.random.fold_in(key, i), micro))(params)
+                return (loss_sum + l.astype(jnp.float32),
+                        jax.tree.map(jnp.add, grads_sum, g)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss_sum, grads), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros),
+                (jnp.arange(k, dtype=jnp.int32), *batch))
+            # mean over microbatches: for mean-reduced losses this makes
+            # the update numerically identical to one k-times-larger
+            # batch (equal microbatch sizes)
+            loss = loss_sum / k
+            grads = jax.tree.map(lambda g: g / k, grads)
+            return self._finish(loss, grads, params, opt_state,
+                                scaler_state, lr, step_i)
+        return acc_fn
+
+    def accumulate(self, k, *batch):
+        """ONE optimizer update from `k` scanned microbatches in ONE XLA
+        dispatch. Every batch array carries a leading dim of `k` (one
+        microbatch per slot); gradients are averaged across microbatches
+        inside the scan, then the usual unscale/clip/update runs exactly
+        once — numerics match a single step over the k-times-larger batch
+        for mean-reduced losses, with only one microbatch's activations
+        live at a time. Params/opt/scaler state stay donated. This is
+        what `hapi.Model.fit(accumulate_grad_batches=k)` dispatches."""
+        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        for a in arrays:
+            if a.ndim == 0 or a.shape[0] != k:
+                raise ValueError(
+                    f"accumulate(k={k}) needs a leading microbatch dim of "
+                    f"{k} on every batch array, got shape {a.shape}")
+        if k == 1:
+            return self(*[a[0] for a in arrays])
+        self._step_i += 1
+        key = split_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        args = (self.params, self.opt_state, self.scaler_state,
+                self.buffers, key, lr, self._step_i, *arrays)
+        sig = (k, tuple((a.shape, str(a.dtype)) for a in arrays))
+
+        def make_jitted():
+            return jax.jit(
+                self._make_acc_fn(k),
+                donate_argnums=(0, 1, 2) if self._donate else ())
+
+        out, info, compiled_now, dispatch_s = self._dispatch(
+            self._acc_jit, sig, make_jitted, args, "train.accumulate",
+            max_entries=8)
+        loss, self.params, self.opt_state, self.scaler_state = out
+        export_step_metrics(self, dispatch_s, info, compiled_now)
+        return DeferredLoss(loss)
+
+    def input_sharding(self, arr):
+        """Sharding the compiled step expects for a batch leaf — the
+        device prefetch ring (io/device_prefetch.py) asks this so H2D
+        copies land placed for the step while the previous step computes.
+        The single-device step has no placement constraint (None =
+        default device)."""
+        return None
 
     def _prep(self, batch, step_i):
         """(sig, full arg tuple) for one dispatch — the ONE place the
@@ -583,20 +703,13 @@ class TrainStep:
     def __call__(self, *batch):
         self._step_i += 1
         sig, args = self._prep(batch, self._step_i)
-        _stat.begin_span("train.step")
-        try:
-            entry = self._exec.get(sig)
-            compiled_now = entry is None
-            if compiled_now:
-                entry = self._exec[sig] = aot_compile(self._jitted, args)
-            compiled, info = entry
-            count_train_use(self, info)
-            loss, self.params, self.opt_state, self.scaler_state = \
-                compiled(*args)
-        finally:
-            dispatch_s = _stat.end_span()
+        out, info, compiled_now, dispatch_s = self._dispatch(
+            self._exec, sig, lambda: self._jitted, args, "train.step")
+        loss, self.params, self.opt_state, self.scaler_state = out
         export_step_metrics(self, dispatch_s, info, compiled_now)
-        return Tensor(loss)
+        # non-blocking handle: dispatch has already returned; the host
+        # copy streams in the background and resolves on first read
+        return DeferredLoss(loss)
 
     def cost_analysis(self, *batch):
         """XLA's analytical cost report for THIS batch signature's

@@ -3,7 +3,16 @@ per-step exporter.
 
 Every framework hot path reports here (jit compiles and retraces, train
 steps, DataLoader batch waits, collectives, device memory peaks), so a
-training process carries its own always-on flight recorder:
+training process carries its own always-on flight recorder.
+
+Async-pipeline signals (the host-overlap story, docs/PERFORMANCE.md
+"Hiding the host"): `host.blocked_s` (histogram — every time the host
+actually blocked on a device read, recorded by DeferredLoss; sum via
+`host_blocked_s()`), `prefetch.h2d_bytes` (counter — bytes staged onto
+the device by the prefetch ring), `prefetch.depth` (gauge — ring fill
+level; pinned at 0 means the step loop is data-bound).
+
+Registry usage:
 
     from paddle_tpu.profiler import monitor
     monitor.counter("jit.retraces").inc()
@@ -25,7 +34,7 @@ import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "metrics_snapshot", "reset_metrics",
-           "rank", "metrics_file", "export_step"]
+           "rank", "metrics_file", "export_step", "host_blocked_s"]
 
 _lock = threading.RLock()
 _export_lock = threading.Lock()  # file appends only: registry ops must
@@ -137,6 +146,15 @@ def metrics_snapshot():
 def reset_metrics():
     with _lock:
         _registry.clear()
+
+
+def host_blocked_s():
+    """Total seconds the host has spent blocked on device reads (the
+    `host.blocked_s` histogram sum) — ~0 in a healthy async step loop,
+    where the only blocks are log_freq/epoch boundaries. bench.py
+    reports the steady-phase delta of this in its phase breakdown."""
+    m = get_metric("host.blocked_s")
+    return float(m.sum) if m is not None else 0.0
 
 
 def rank():

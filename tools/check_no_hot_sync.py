@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Static lint: no host synchronization in the designated hot-loop code.
+
+The async step pipeline (device prefetch ring, deferred loss handles,
+scanned accumulation — docs/PERFORMANCE.md "Hiding the host") only works
+while the steady-state loop never blocks the host on the device. This
+tool is the regression fence: it fails when a blocking read —
+`.item()`, `float(`, `.numpy()`, `block_until_ready` — appears inside a
+designated hot region. tests/test_async_pipeline.py runs it (like
+tools/check_metrics_schema.py), so a sync can't silently creep back into
+a step path.
+
+Hot regions (file -> function/method names; "*" = whole module):
+
+  paddle_tpu/jit/api.py                       TrainStep dispatch paths
+  paddle_tpu/hapi/model.py                    the fit loop
+  paddle_tpu/distributed/fleet/hybrid_train.py  hybrid dispatch paths
+  paddle_tpu/io/device_prefetch.py            the whole ring
+
+Allowlist: a line ending with a `# hot-sync-ok: <why>` comment is
+exempt — for host-side arithmetic that merely *looks* like a sync
+(`float(perf_counter_delta)`), never for an actual device read in a hot
+path. Multi-line string constants (docstrings) are skipped. A region
+name that no longer resolves is itself a violation: renaming a hot
+function must move the fence with it.
+
+Usage: python tools/check_no_hot_sync.py [REPO_ROOT]
+Exit 0 clean, 1 violations.
+"""
+import ast
+import os
+import re
+import sys
+
+HOT_REGIONS = {
+    "paddle_tpu/jit/api.py": [
+        "TrainStep.__call__", "TrainStep._prep", "TrainStep._dispatch",
+        "TrainStep.accumulate", "TrainStep.run_steps"],
+    "paddle_tpu/hapi/model.py": [
+        "Model.fit", "Model._fit_epochs", "Model._dispatch_micro"],
+    "paddle_tpu/distributed/fleet/hybrid_train.py": [
+        "HybridTrainStep.__call__", "HybridTrainStep._prep"],
+    "paddle_tpu/io/device_prefetch.py": ["*"],
+}
+
+PATTERNS = [
+    (re.compile(r"\.item\s*\("), ".item()"),
+    (re.compile(r"(?<![\w.])float\s*\("), "float()"),
+    (re.compile(r"\.numpy\s*\("), ".numpy()"),
+    (re.compile(r"block_until_ready"), "block_until_ready"),
+]
+
+ALLOW_MARKER = "hot-sync-ok"
+
+
+def _named_spans(tree):
+    """{qualified name: (first line, last line)} for module-level
+    functions and class methods."""
+    spans = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            spans[node.name] = (node.lineno, node.end_lineno)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    spans[f"{node.name}.{sub.name}"] = (sub.lineno,
+                                                        sub.end_lineno)
+    return spans
+
+
+def _string_lines(tree):
+    """Line numbers covered by multi-line string constants (docstrings
+    and other block strings) — not code, not linted."""
+    lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            end = getattr(node, "end_lineno", node.lineno)
+            if end > node.lineno:
+                lines.update(range(node.lineno, end + 1))
+    return lines
+
+
+def check_source(src, names, where):
+    """All violations for one file's source text. `names` is the list of
+    hot region names ("*" = whole module)."""
+    violations = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{where}: unparseable ({e})"]
+    lines = src.splitlines()
+    skip = _string_lines(tree)
+    if "*" in names:
+        regions = [("<module>", 1, len(lines))]
+    else:
+        spans = _named_spans(tree)
+        regions = []
+        for name in names:
+            if name not in spans:
+                violations.append(
+                    f"{where}: hot region {name!r} not found — update "
+                    "tools/check_no_hot_sync.py HOT_REGIONS")
+                continue
+            regions.append((name, *spans[name]))
+    for name, start, end in regions:
+        for ln in range(start, min(end, len(lines)) + 1):
+            if ln in skip:
+                continue
+            line = lines[ln - 1]
+            if ALLOW_MARKER in line:
+                continue
+            code = line.split("#", 1)[0]
+            for pat, label in PATTERNS:
+                if pat.search(code):
+                    violations.append(
+                        f"{where}:{ln}: {label} in hot region {name}: "
+                        f"{line.strip()}")
+    return violations
+
+
+def check_repo(repo):
+    errors = []
+    for rel, names in sorted(HOT_REGIONS.items()):
+        path = os.path.join(repo, rel)
+        if not os.path.exists(path):
+            errors.append(f"{rel}: hot file missing")
+            continue
+        with open(path) as f:
+            errors.extend(check_source(f.read(), names, rel))
+    return errors
+
+
+def main(argv):
+    repo = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    errors = check_repo(repo)
+    for err in errors:
+        print(err)
+    if errors:
+        print(f"FAIL: {len(errors)} hot-loop sync violation(s)")
+        return 1
+    print(f"OK: {len(HOT_REGIONS)} hot file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
