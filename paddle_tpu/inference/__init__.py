@@ -5,20 +5,35 @@ TPU-native: the serialized model is StableHLO (jit.save format); the
 Predictor deserializes it into a PjRt executable — XLA replaces the
 reference's IR analysis passes and TensorRT engine. Zero-copy handles map
 onto device arrays.
+
+Serving (beyond-parity, see docs/SERVING.md): `config.enable_serving()`
+routes every `Predictor.run()` — across threads AND across the clones of
+a `PredictorPool` — through ONE shared continuous-batching
+`InferenceEngine` (paddle_tpu/inference/serving.py): concurrent requests
+coalesce into padded bucket batches dispatched through AOT executables,
+so N serving threads cost ~1 batched dispatch instead of N serial ones.
 """
 import os
+import threading
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
+from .serving import (InferenceEngine, GenerationEngine, GenerationHandle,
+                      BucketLadder, ServingError, QueueFullError,
+                      DeadlineExceeded, EngineStopped)
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "DataType", "Tensor", "PredictorPool",
            "get_version", "get_trt_compile_version",
            "get_trt_runtime_version", "get_num_bytes_of_data_type",
-           "convert_to_mixed_precision"]
+           "convert_to_mixed_precision",
+           # serving engine re-exports
+           "InferenceEngine", "GenerationEngine", "GenerationHandle",
+           "BucketLadder", "ServingError", "QueueFullError",
+           "DeadlineExceeded", "EngineStopped"]
 
 
 class PrecisionType:
@@ -78,10 +93,14 @@ class Config:
         if model_path and model_path.endswith(".pdmodel"):
             model_path = model_path[:-len(".pdmodel")]
         self._prefix = model_path
+        self._params_path = params_path
         self._use_tpu = True
         self._precision = PrecisionType.Float32
         self._enable_memory_optim = True
         self._cpu_math_library_num_threads = 1
+        self._serving = None         # enable_serving() kwargs
+        self._serving_engine = None  # ONE engine per Config, lazily built
+        self._serving_lock = threading.Lock()
 
     def model_dir(self):
         return os.path.dirname(self._prefix or "")
@@ -90,7 +109,58 @@ class Config:
         return (self._prefix or "") + ".pdmodel"
 
     def params_file(self):
+        if self._params_path:
+            return self._params_path
         return (self._prefix or "") + ".pdiparams"
+
+    # -- continuous-batching serving (docs/SERVING.md) ------------------
+    def enable_serving(self, batch_sizes=(1, 2, 4, 8), seq_buckets=None,
+                       max_queue=64, max_wait_ms=2.0, deadline_ms=None):
+        """Route this Config's Predictors (and every PredictorPool slot
+        cloned from them) through one shared continuous-batching
+        InferenceEngine. `run()` keeps its synchronous signature — the
+        coalescing happens across the threads calling it. Calling again
+        RECONFIGURES: an already-built engine drains and is rebuilt with
+        the new settings on the next run()."""
+        with self._serving_lock:
+            old = self._serving_engine
+            self._serving_engine = None
+            self._serving = {"batch_sizes": batch_sizes,
+                             "seq_buckets": seq_buckets,
+                             "max_queue": max_queue,
+                             "max_wait_ms": max_wait_ms}
+            self._serving_deadline_ms = deadline_ms
+        if old is not None:
+            old.shutdown(wait=True)
+        return self
+
+    def disable_serving(self):
+        with self._serving_lock:
+            old = self._serving_engine
+            self._serving = self._serving_engine = None
+        if old is not None:
+            old.shutdown(wait=True)
+
+    def serving_enabled(self):
+        return self._serving is not None
+
+    def _engine_for(self, layer):
+        """The shared engine, built on first use around the loaded
+        layer (None when serving was disabled concurrently — the caller
+        falls back to the direct path). All Predictors of this Config
+        feed the same queue — that's what turns N concurrent run()
+        calls into one batch. Locked: N threads racing the first run()
+        must not each build an engine (split queues would defeat
+        coalescing and leak dispatcher threads), and a concurrent
+        disable_serving() must not resurrect one."""
+        if self._serving_engine is None:
+            with self._serving_lock:
+                if self._serving is None:  # raced disable_serving()
+                    return None
+                if self._serving_engine is None:
+                    self._serving_engine = InferenceEngine(
+                        layer, **self._serving)
+        return self._serving_engine
 
     # device knobs: XLA owns placement; these record intent for parity
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -139,10 +209,46 @@ class _IOHandle:
         self._is_input = is_input
 
     def copy_from_cpu(self, arr):
-        self._p._inputs[self._name] = jnp.asarray(np.asarray(arr))
+        arr = np.asarray(arr)
+        declared = self._p._declared_shapes.get(self._name)
+        if declared is not None and list(arr.shape) != declared:
+            raise ValueError(
+                f"copy_from_cpu got shape {list(arr.shape)} but "
+                f"reshape() declared {declared} for {self._name!r}")
+        # the declaration is CONSUMED by the copy it describes — a
+        # sticky one would pin dynamic dims (e.g. the batch) to the
+        # first reshape()'s value for every later feed on this handle
+        self._p._declared_shapes.pop(self._name, None)
+        if self._p._config.serving_enabled():
+            # host-side until dispatch: the engine batches first, then
+            # pays ONE H2D for the fused batch — an eager device_put
+            # here would cost per-request H2D plus a D2H at submit
+            self._p._inputs[self._name] = arr
+        else:
+            self._p._inputs[self._name] = jnp.asarray(arr)
 
     def reshape(self, shape):
-        pass
+        """Declare the shape about to be fed. Validated against the
+        saved input spec (rank, and every STATIC dim; symbolic/dynamic
+        dims accept anything) — the reference's silent no-op hid
+        rank/layout mistakes until an opaque XLA shape error."""
+        if not self._is_input:
+            raise ValueError("reshape() is only valid on input handles")
+        spec = self._p._specs_by_name.get(self._name)
+        shape = [int(s) for s in shape]
+        if spec is not None:
+            dims, _ = spec
+            if len(shape) != len(dims):
+                raise ValueError(
+                    f"reshape({shape}) rank {len(shape)} != saved spec "
+                    f"rank {len(dims)} for {self._name!r} (spec {dims})")
+            for got, want in zip(shape, dims):
+                if str(want).lstrip("-").isdigit() and got != int(want):
+                    raise ValueError(
+                        f"reshape({shape}) incompatible with saved spec "
+                        f"{dims} for {self._name!r}: dim {want} is "
+                        f"static")
+        self._p._declared_shapes[self._name] = shape
 
     def copy_to_cpu(self):
         return np.asarray(self._p._outputs[self._name])
@@ -156,12 +262,27 @@ class _IOHandle:
 
 
 class Predictor:
-    def __init__(self, config):
+    def __init__(self, config, _shared_layer=None):
         from ..jit import load as jit_load
         self._config = config
-        self._layer = jit_load(config._prefix)
-        n_in = len(self._layer._meta.get("input_specs", [])) or 1
+        # _shared_layer: clone() passes the already-loaded layer when
+        # serving is on — all slots feed one engine, so N pool slots
+        # must not pay N StableHLO deserializes + N param uploads
+        self._layer = _shared_layer if _shared_layer is not None else \
+            jit_load(config._prefix, params_path=config._params_path)
+        specs = self._layer._meta.get("input_specs")
+        if specs is None:
+            # artifact predates the .meta sidecar: input count unknown,
+            # assume the common single-input case
+            n_in = 1
+            specs = []
+        else:
+            # exactly as saved — a zero-spec save has zero inputs (the
+            # old `or 1` fallback invented a phantom handle)
+            n_in = len(specs)
         self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._specs_by_name = dict(zip(self._input_names, specs))
+        self._declared_shapes = {}
         self._output_names = []
         self._inputs = {}
         self._outputs = {}
@@ -175,6 +296,10 @@ class Predictor:
         return self._output_names
 
     def get_input_handle(self, name):
+        if name not in self._input_names:
+            raise KeyError(
+                f"unknown input {name!r}; this model has "
+                f"{self._input_names}")
         return _IOHandle(self, name, True)
 
     def get_output_handle(self, name):
@@ -182,12 +307,26 @@ class Predictor:
 
     def run(self, inputs=None):
         if inputs is not None:  # direct list API
-            arrs = [a.value if isinstance(a, Tensor) else jnp.asarray(a)
-                    for a in inputs]
+            if self._config.serving_enabled():
+                # keep host arrays host-side: the engine batches first,
+                # then does ONE H2D per fused batch — a per-request
+                # jnp.asarray here would pay request-granular transfers
+                arrs = [a.value if isinstance(a, Tensor) else np.asarray(a)
+                        for a in inputs]
+            else:
+                arrs = [a.value if isinstance(a, Tensor) else
+                        jnp.asarray(a) for a in inputs]
         else:
+            missing = [n for n in self._input_names if n not in self._inputs]
+            if missing:
+                raise RuntimeError(
+                    f"run() before copy_from_cpu on inputs {missing}")
             arrs = [self._inputs[n] for n in self._input_names]
-        out = self._layer(*arrs)
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        if self._config.serving_enabled():
+            outs = self._run_serving(arrs)
+        else:
+            out = self._layer(*arrs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
         self._output_names = [f"output_{i}" for i in range(len(outs))]
         for n, o in zip(self._output_names, outs):
             self._outputs[n] = o.value if isinstance(o, Tensor) else o
@@ -196,8 +335,41 @@ class Predictor:
                     for n in self._output_names]
         return True
 
+    def _run_serving(self, arrs):
+        """Blocking run() routed through the Config's shared
+        continuous-batching engine: this thread's request coalesces with
+        every other Predictor/thread on the same Config."""
+        engine = self._config._engine_for(self._layer)
+        if engine is not None:
+            try:
+                fut = engine.submit(
+                    *arrs, deadline_ms=getattr(
+                        self._config, "_serving_deadline_ms", None))
+            except EngineStopped:
+                # disable/reconfigure raced this run between engine
+                # fetch and submit — serve it directly, don't fail it
+                pass
+            except ValueError:
+                # submit()'s preconditions (batch within the top
+                # bucket, seq within the top seq bucket, inputs
+                # uniformly batch-leading) define what the ENGINE can
+                # coalesce — a request outside them was still a valid
+                # run() before enable_serving(), so dispatch it
+                # directly instead of failing the caller
+                pass
+            else:
+                out = fut.result()
+                return out if isinstance(out, list) else [out]
+        out = self._layer(*arrs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
     def clone(self):
-        return Predictor(self._config)
+        # eval-mode TranslatedLayer calls are pure, so serving-mode
+        # clones can share the loaded layer (per-slot state is only the
+        # io dicts); without serving each clone keeps its own load,
+        # preserving the reference's isolation semantics
+        shared = self._layer if self._config.serving_enabled() else None
+        return Predictor(self._config, _shared_layer=shared)
 
 
 def create_predictor(config):
@@ -207,7 +379,9 @@ def create_predictor(config):
 class PredictorPool:
     """`size` independently-cloned Predictors for thread-per-slot
     serving (reference: paddle_inference_api.h services::PredictorPool).
-    Each slot has its own io state so threads never share handles."""
+    Each slot has its own io state so threads never share handles —
+    but with `config.enable_serving()` all slots feed ONE shared
+    continuous-batching engine, so the pool's threads batch together."""
 
     def __init__(self, config, size=1):
         if size < 1:
@@ -215,7 +389,16 @@ class PredictorPool:
         main = Predictor(config)
         self._preds = [main] + [main.clone() for _ in range(size - 1)]
 
+    def __len__(self):
+        return len(self._preds)
+
     def retrive(self, idx):
+        idx = int(idx)
+        if not 0 <= idx < len(self._preds):
+            raise IndexError(
+                f"PredictorPool.retrive({idx}): pool has "
+                f"{len(self._preds)} predictor(s) (valid: 0.."
+                f"{len(self._preds) - 1})")
         return self._preds[idx]
 
     retrieve = retrive  # the reference spells it "Retrive"; keep both

@@ -1,0 +1,1102 @@
+"""Serving engine: continuous-batching inference over the XLA stack.
+
+Beyond-parity subsystem (the reference's AnalysisPredictor is strictly
+one-request-at-a-time): two engines share one scheduler core and tie
+together pieces that already exist in-repo — `jit.api.aot_compile` (AOT
+executables + the persistent compile cache), `ops.paged_attention.
+PagedKVCache` (paged decode state), `models.gpt` paged decode, and the
+`profiler.monitor` metrics registry.
+
+**InferenceEngine** — stateless models (classifiers, encoders, anything
+`jit.save`-able): callers `submit()` into a bounded queue and get a
+`concurrent.futures.Future`; a background dispatcher coalesces
+concurrent requests into ONE padded batch along a configurable ladder
+of shape buckets (batch rounded up to the ladder, sequence padded to a
+bucket), dispatched through an AOT executable compiled once per bucket
+— steady-state serving never retraces. Admission control is fast-fail:
+a full queue raises `QueueFullError` immediately (callers shed load
+instead of timing out), per-request deadlines expire in-queue, and
+`drain()`/`shutdown()` finish in-flight work before stopping.
+
+**GenerationEngine** — autoregressive decode over `GPTForCausalLM` +
+`PagedKVCache`: continuous batching in the vLLM/Ragged-Paged-Attention
+sense (see PAPERS.md). New requests prefill into free page-table slots
+between decode steps, every decode step advances ALL in-flight
+sequences by one token in a single fixed-shape jitted program (the
+batch is padded to a power-of-two bucket with rows that write to the
+reserved pad page, so admit/evict never changes the compiled shape),
+finished sequences (eos / max_new_tokens) are evicted without stalling
+their neighbors, and tokens stream back per request as they are
+sampled.
+
+Both report into `profiler/monitor`:
+
+    serve.queue_depth   gauge      requests waiting in the queue
+    serve.batch_size    histogram  real rows per dispatched batch
+    serve.latency_s     histogram  submit -> result, per request
+    serve.ttft_s        histogram  submit -> first token (generation)
+    serve.requests      counter    accepted requests
+    serve.rejected      counter    fast-fail queue-full rejections
+    serve.expired       counter    deadline expiries
+    serve.pad_tokens    counter    padding elements dispatched
+    serve.retraces      counter    bucket executables compiled
+    serve.errors        counter    batches/steps failed onto futures
+
+The dispatcher and decode loops are fenced by tools/check_no_hot_sync.py:
+the ONLY host blocks are the scheduler's queue wait and the one
+deliberate device read per batch (marked `# hot-sync-ok:`).
+"""
+import itertools
+import threading
+import time
+import weakref
+from collections import deque
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..profiler import monitor as _monitor
+from ..profiler import statistic as _stat
+
+__all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
+           "EngineStopped", "BucketLadder", "InferenceEngine",
+           "GenerationEngine", "GenerationHandle"]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-engine scheduling errors."""
+
+
+class QueueFullError(ServingError):
+    """Fast-fail backpressure: the bounded request queue is full."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before it was dispatched."""
+
+
+class EngineStopped(ServingError):
+    """submit() after shutdown()/drain() closed the engine."""
+
+
+class BucketLadder:
+    """The shape-bucket ladder: batch sizes round UP to the smallest
+    bucket that fits (requests above the top bucket are rejected at
+    submit), sequence lengths pad up to the smallest seq bucket. One
+    AOT executable per (batch bucket, seq bucket) serves every request
+    shape in that cell — the whole point is that steady-state serving
+    dispatches only pre-compiled programs."""
+
+    def __init__(self, batch_sizes=(1, 2, 4, 8), seq_buckets=None):
+        if not batch_sizes:
+            raise ValueError("BucketLadder needs at least one batch size")
+        self.batch_sizes = tuple(sorted(set(int(b) for b in batch_sizes)))
+        if self.batch_sizes[0] < 1:
+            raise ValueError("batch buckets must be >= 1")
+        self.seq_buckets = tuple(sorted(set(int(s) for s in seq_buckets))) \
+            if seq_buckets else None
+
+    @property
+    def max_batch(self):
+        return self.batch_sizes[-1]
+
+    def batch(self, n):
+        """Smallest batch bucket >= n (None when n exceeds the top)."""
+        for b in self.batch_sizes:
+            if n <= b:
+                return b
+        return None
+
+    def seq(self, t):
+        """Smallest seq bucket >= t; identity when no seq ladder."""
+        if self.seq_buckets is None:
+            return t
+        for s in self.seq_buckets:
+            if t <= s:
+                return s
+        raise ValueError(
+            f"sequence length {t} exceeds the largest seq bucket "
+            f"{self.seq_buckets[-1]} — extend the ladder")
+
+
+class _Request:
+    __slots__ = ("arrays", "n", "key", "future", "deadline", "t_submit")
+
+    def __init__(self, arrays, n, key, deadline):
+        self.arrays = arrays
+        self.n = n
+        self.key = key  # coalescing signature, computed once at submit
+        self.future = Future()
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+
+
+def _resolve_future(fut, value):
+    """set_result that tolerates a caller's concurrent cancel(): the
+    done() check and the set are not atomic, and a cancelled future
+    just means nobody is waiting — never a scheduler-thread error."""
+    try:
+        fut.set_result(value)
+    except InvalidStateError:
+        pass
+
+
+def _reject_future(fut, exc):
+    try:
+        fut.set_exception(exc)
+    except InvalidStateError:
+        pass
+
+
+def _to_ndarray(a):
+    """Normalize one request leaf to a host ndarray the ENGINE owns
+    (requests are tiny; keeping them host-side makes concat/pad cheap
+    and defers the single H2D to the batched dispatch). An ndarray
+    input is COPIED: submit() returns before dispatch, and a caller
+    reusing its buffer must not mutate a queued request. Device arrays
+    and lists already materialize fresh through np.asarray."""
+    if isinstance(a, Tensor):
+        a = a.value
+    if isinstance(a, np.ndarray):
+        return a.copy()
+    return np.asarray(a)
+
+
+def _as_jitted(model):
+    """Wrap any supported model flavor into a jax.jit-ed function of raw
+    arrays (the thing `aot_compile` lowers):
+
+    - a jax.jit wrapper (has .lower): used as-is
+    - a jit.save_load.TranslatedLayer: its exported call with the loaded
+      params/buffers closed over
+    - an nn.Layer: functional_call with a frozen eval-mode snapshot of
+      its parameters (rebuild the engine after mutating weights)
+    - any plain callable over arrays: jax.jit(fn)
+    """
+    if hasattr(model, "lower") and callable(model):
+        return model
+    from ..jit.save_load import TranslatedLayer
+    if isinstance(model, TranslatedLayer):
+        call = model._call
+        if model._meta.get("kind") == "function":
+            return jax.jit(lambda *xs: call(*xs))
+        # private copies, same reason as the Layer branch below: a
+        # later fine-tune step may DONATE the live parameter buffers,
+        # which would invalidate every warmed executable's closure
+        params = {k: jnp.array(p.value)
+                  for k, p in model.named_parameters()}
+        buffers = {k: jnp.array(v) for k, v in model._buffers.items()}
+        return jax.jit(lambda *xs: call(params, buffers, *xs))
+    from ..nn.layer.layers import Layer
+    if isinstance(model, Layer):
+        from ..jit.api import functional_call, state_arrays
+        params, buffers = state_arrays(model)
+        # private copies: the engine's executables must stay valid even
+        # if the caller later donates/mutates the live Parameters
+        params = jax.tree.map(jnp.array, params)
+        return jax.jit(lambda *xs: functional_call(
+            model, params, buffers, xs, training=False))
+    if callable(model):
+        return jax.jit(model)
+    raise TypeError(f"cannot serve {type(model).__name__}: expected a "
+                    "Layer, TranslatedLayer, jitted or plain callable")
+
+
+_STOP = object()
+# serve.* metrics and kind:"serve" records are process-global: the
+# per-engine name stamped on each record is what keeps the telemetry of
+# multiple engines in one process attributable
+_ENGINE_IDS = itertools.count()
+
+
+def _run_scheduler(ref):
+    """Scheduler thread entry. Holds only a WEAKREF to the engine
+    between iterations: an engine abandoned without shutdown() becomes
+    garbage-collectible (a bound-method target would pin it via the
+    thread registry forever), and once collected the thread simply
+    exits — no leaked 50 ms-wakeup thread, no leaked parameter
+    copies. An exception ESCAPING the loop core would kill this thread
+    with callers still parked in Future.result() — the catch-all fails
+    all outstanding work loudly instead."""
+    while True:
+        eng = ref()
+        if eng is None:
+            return
+        try:
+            alive = eng._loop_once()
+        except BaseException as e:
+            eng._scheduler_crashed(e)
+            return
+        if not alive:
+            return
+        del eng  # drop the strong ref before the next iteration
+
+
+class _SchedulerLifecycle:
+    """The scheduler core both engines share: stop-the-world admission
+    gate (`_stopping`), drain-to-empty, shutdown with optional cancel.
+    Subclasses provide `_outstanding()` (any queued OR claimed work?),
+    `_take_pending()`/`_take_outstanding()` (detach doomed work UNDER
+    the lock) and `_reject_detached()` (reject it OUTSIDE the lock —
+    set_exception fires done-callbacks synchronously, and one that
+    re-enters the engine would deadlock under `_cv`), and keep
+    `_outstanding()` truthful across every lock release — that's the
+    whole drain() contract."""
+
+    _paused = False  # engines without pause() still drain through here
+
+    def drain(self, timeout=None):
+        """Stop admission, then block until every queued and in-flight
+        request has resolved. Returns True when fully drained."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            self._stopping = True
+            self._paused = False  # a paused engine must still drain
+            self._cv.notify_all()
+            while self._outstanding():
+                left = None if end is None else end - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(0.05 if left is None else min(left, 0.05))
+        return True
+
+    def shutdown(self, wait=True):
+        """Drain (wait=True) or cancel pending work (wait=False), then
+        stop the scheduler thread. Idempotent; submit() afterwards
+        raises EngineStopped."""
+        if wait:
+            self.drain()
+        doomed = []
+        with self._cv:
+            self._stopping = True
+            self._paused = False
+            if not wait:
+                doomed = self._take_pending()
+            self._cv.notify_all()
+        # rejections OUTSIDE the lock: set_exception fires done-
+        # callbacks synchronously, and one that re-enters the engine
+        # would deadlock here (same discipline as _flush_expired)
+        self._reject_detached(doomed, EngineStopped("engine shut down"))
+        self._thread.join(timeout=10)
+
+    def __del__(self):
+        cv = getattr(self, "_cv", None)  # __init__ may have raised early
+        if cv is None:
+            return
+        with cv:
+            self._stopping = True
+            # weakrefs were cleared before __del__, so the scheduler
+            # thread is exiting (or already gone) and will never claim
+            # what's still queued: detach it all and reject below —
+            # callers blocked in Future.result() fail loudly instead
+            # of hanging forever
+            doomed = self._take_outstanding()
+            cv.notify_all()
+        self._reject_detached(
+            doomed, EngineStopped("engine abandoned without shutdown()"))
+
+    def _scheduler_crashed(self, exc):
+        """Last resort (called by _run_scheduler's catch-all): the loop
+        core itself escaped. Fail every outstanding request with the
+        cause chained — a silent thread death would hang callers
+        forever — and refuse new submits."""
+        _monitor.counter("serve.errors").inc()
+        err = ServingError(
+            "scheduler thread crashed; this engine is dead — rebuild it")
+        err.__cause__ = exc
+        with self._cv:
+            self._stopping = True
+            doomed = self._take_outstanding()
+            self._cv.notify_all()
+        self._reject_detached(doomed, err)
+
+
+class InferenceEngine(_SchedulerLifecycle):
+    """Continuous-batching engine for stateless models.
+
+        engine = InferenceEngine(layer, batch_sizes=(1, 2, 4, 8))
+        engine.warm(example)           # one AOT executable per bucket
+        fut = engine.submit(x)         # Future; x has a leading batch dim
+        y = fut.result()
+
+    Scheduling: a bounded queue (fast-fail `QueueFullError` when full —
+    backpressure belongs at admission, not in a timeout) feeds one
+    dispatcher thread. The dispatcher pops the oldest request, waits up
+    to `max_wait_ms` to coalesce more SAME-SIGNATURE requests (same
+    dtype / trailing shape after seq bucketing) up to the top batch
+    bucket, pads the fused batch to the ladder, and runs ONE executable.
+    Results come back as host ndarrays sliced per request — the single
+    device read per batch is the engine's only hot-path sync.
+
+    Requests whose deadline (`submit(..., deadline_ms=)`) passes while
+    queued fail with `DeadlineExceeded` instead of wasting a bucket
+    slot. `drain()` stops admission and finishes everything in flight;
+    `shutdown()` drains (or cancels, `wait=False`) and joins the
+    thread. `pause()`/`resume()` hold dispatch — a scheduling hook for
+    tests and for atomically swapping warmed executables.
+
+    NOTE on ragged traffic: with `seq_buckets=None` (the default) every
+    NOVEL sequence length lazily compiles — and retains — one more
+    executable per batch bucket, stalling that batch for the compile.
+    Fixed-shape workloads are fine; for variable-length inputs always
+    set a seq ladder so the executable set stays bounded."""
+
+    def __init__(self, model, batch_sizes=(1, 2, 4, 8), seq_buckets=None,
+                 seq_axis=1, max_queue=64, max_wait_ms=2.0, pad_value=0,
+                 pipeline=2, name=None):
+        self.name = name or f"infer{next(_ENGINE_IDS)}"
+        self.ladder = BucketLadder(batch_sizes, seq_buckets)
+        self.seq_axis = int(seq_axis)
+        self.max_queue = int(max_queue)
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.pad_value = pad_value
+        # pipeline: batches in flight on the device before the
+        # dispatcher blocks reading the oldest result — XLA executes
+        # batch k while the dispatcher coalesces and dispatches k+1, so
+        # scheduler overhead hides under device compute (1 = fully
+        # synchronous; 2 is the sweet spot, mirroring the train-side
+        # prefetch ring depth)
+        self.pipeline = max(1, int(pipeline))
+        self._jitted = _as_jitted(model)
+        self._exec = {}          # sig -> (compiled, info)
+        self._compile_lock = threading.Lock()  # warm() vs lazy dispatch
+        self.retraces = 0        # bucket executables compiled (AOT or lazy)
+        self._buf = deque()
+        self._cv = threading.Condition()
+        self._stopping = False   # no new submits
+        self._paused = False
+        self._inflight = 0       # requests claimed but not yet resolved
+        self._expired_reqs = deque()  # deferred rejections (dispatcher)
+        self._pending_results = deque()  # dispatched, awaiting resolution
+        self._thread = threading.Thread(
+            target=_run_scheduler, args=(weakref.ref(self),),
+            name="serve-dispatch", daemon=True)
+        self._thread.start()
+
+    # -- admission -------------------------------------------------------
+    def submit(self, *args, deadline_ms=None):
+        """Enqueue one request (every arg carries a leading batch dim,
+        all args the same) and return its Future. The Future resolves to
+        the model output(s) as host ndarrays sliced to this request's
+        rows. Raises QueueFullError / EngineStopped immediately; a
+        deadline_ms that expires in-queue fails the Future with
+        DeadlineExceeded."""
+        arrays = [_to_ndarray(a) for a in args]
+        if not arrays:
+            raise ValueError("submit() needs at least one input array")
+        n = int(arrays[0].shape[0]) if arrays[0].ndim else 0
+        for a in arrays:
+            if a.ndim == 0 or a.shape[0] != n:
+                raise ValueError(
+                    "every input must carry the same leading batch dim; "
+                    f"got {[tuple(x.shape) for x in arrays]}")
+        if n < 1 or self.ladder.batch(n) is None:
+            raise ValueError(
+                f"request batch {n} does not fit the ladder "
+                f"{self.ladder.batch_sizes} (max "
+                f"{self.ladder.max_batch} rows per request)")
+        # the coalescing key doubles as validation: an over-bucket seq
+        # length raises HERE, at the caller — discovered at dispatch it
+        # would raise inside the scheduler thread and kill it for all
+        key = self._key_of(arrays)
+        deadline = None if deadline_ms is None else \
+            time.perf_counter() + float(deadline_ms) / 1000.0
+        req = _Request(arrays, n, key, deadline)
+        with self._cv:
+            if self._stopping:
+                raise EngineStopped("engine is drained/shut down")
+            if len(self._buf) >= self.max_queue:
+                _monitor.counter("serve.rejected").inc()
+                raise QueueFullError(
+                    f"serving queue full ({self.max_queue} waiting) — "
+                    "shed load or raise max_queue")
+            self._buf.append(req)
+            _monitor.counter("serve.requests").inc()
+            _monitor.gauge("serve.queue_depth").set(len(self._buf))
+            self._cv.notify_all()
+        return req.future
+
+    def __call__(self, *args, deadline_ms=None, timeout=None):
+        """Synchronous convenience: submit + result."""
+        return self.submit(*args, deadline_ms=deadline_ms).result(timeout)
+
+    # -- warmup ----------------------------------------------------------
+    def warm(self, *example):
+        """AOT-compile one executable per batch bucket for this
+        example's signature (trailing shape/dtype after seq bucketing;
+        the example's own leading dim is ignored). Returns the number of
+        executables compiled NOW — already-warm buckets are free, and
+        with the persistent compile cache (PR 1) even a fresh process
+        reloads instead of recompiling. Call once per distinct input
+        signature before serving; steady state then never retraces."""
+        arrays = [_to_ndarray(a) for a in example]
+        compiled_now = 0
+        for b in self.ladder.batch_sizes:
+            if self._ensure_compiled(self._bucket_specs(arrays, b))[1]:
+                compiled_now += 1
+        return compiled_now
+
+    def _bucket_specs(self, arrays, b):
+        """ShapeDtypeStructs of the padded batch for bucket b."""
+        specs = []
+        for a in arrays:
+            shape = list(a.shape)
+            shape[0] = b
+            if a.ndim > self.seq_axis:
+                shape[self.seq_axis] = self.ladder.seq(
+                    shape[self.seq_axis])
+            specs.append(jax.ShapeDtypeStruct(tuple(shape), a.dtype))
+        return specs
+
+    @staticmethod
+    def _sig(specs):
+        return tuple((tuple(s.shape), str(s.dtype)) for s in specs)
+
+    def _ensure_compiled(self, specs):
+        """(executable entry, compiled_now). Serialized against the
+        concurrent warm()-vs-lazy-dispatch race: without the lock both
+        threads could compile (and count) the same bucket twice."""
+        sig = self._sig(specs)
+        entry = self._exec.get(sig)
+        if entry is not None:
+            return entry, False
+        from ..jit.api import aot_compile
+        with self._compile_lock:
+            entry = self._exec.get(sig)
+            if entry is not None:
+                return entry, False
+            entry = aot_compile(self._jitted, tuple(specs))
+            self._exec[sig] = entry
+            self.retraces += 1
+            _monitor.counter("serve.retraces").inc()
+            return entry, True
+
+    # -- scheduler core --------------------------------------------------
+    def _key_of(self, arrays):
+        """Coalescing key: requests fuse only when their padded trailing
+        shapes and dtypes agree (the batch dim is the ladder's job).
+        Computed ONCE at submit — the dispatcher's queue scans compare
+        stored tuples instead of rebuilding shapes under the lock."""
+        parts = []
+        for a in arrays:
+            shape = list(a.shape[1:])
+            if a.ndim > self.seq_axis:
+                shape[self.seq_axis - 1] = self.ladder.seq(
+                    a.shape[self.seq_axis])
+            parts.append((tuple(shape), str(a.dtype)))
+        return tuple(parts)
+
+    def _expired(self, req, now):
+        """Drop a dead request. Runs UNDER self._cv — the rejection is
+        deferred to _flush_expired (outside the lock) because
+        set_exception fires done-callbacks synchronously, and a
+        callback that re-enters the engine would deadlock here."""
+        if req.deadline is not None and now > req.deadline:
+            _monitor.counter("serve.expired").inc()
+            self._expired_reqs.append(req)
+            return True
+        # a cancelled future occupies no bucket row either
+        return req.future.cancelled()
+
+    def _flush_expired(self):
+        """Reject deferred deadline expiries. Dispatcher thread only,
+        never holding self._cv."""
+        while self._expired_reqs:
+            req = self._expired_reqs.popleft()
+            _reject_future(req.future, DeadlineExceeded(
+                "deadline passed before dispatch"))
+
+    def _take_batch(self, block=True):
+        """Pop the oldest live request, then coalesce same-signature
+        followers up to the top batch bucket, waiting at most max_wait_s
+        for stragglers. Returns a non-empty list; _STOP when shutting
+        down with nothing left; None when the queue is idle and
+        block=False (the dispatcher has results to resolve instead)."""
+        with self._cv:
+            while True:
+                if self._stopping and not self._buf:
+                    return _STOP
+                if self._paused or not self._buf:
+                    if not block:
+                        return None
+                    self._cv.wait(0.05)  # the scheduler's one legit block
+                    if self._paused or not self._buf:
+                        # still idle: hand control back so the runner
+                        # drops its strong ref (GC-ability of abandoned
+                        # engines depends on this bound wait)
+                        return None
+                    continue
+                first = self._buf.popleft()
+                now = time.perf_counter()
+                if self._expired(first, now):
+                    # hand control back so the dispatcher rejects the
+                    # deferred expiry OUTSIDE the lock before blocking
+                    return None
+                key = first.key
+                # counted the instant it leaves the queue: the
+                # coalescing wait below RELEASES the lock, and drain()
+                # must never observe "queue empty, nothing in flight"
+                # while claimed requests sit in this local batch
+                self._inflight += 1
+                batch, rows = [first], first.n
+                t_end = now + self.max_wait_s
+                while rows < self.ladder.max_batch:
+                    got = self._scan_matching(batch, rows, key)
+                    rows += got
+                    if rows >= self.ladder.max_batch:
+                        break
+                    left = t_end - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)  # coalescing window
+                _monitor.gauge("serve.queue_depth").set(len(self._buf))
+                return batch
+
+    def _scan_matching(self, batch, rows, key):
+        """Move queued same-key requests into `batch` (expiring dead
+        ones on the way); returns rows added. Holds self._cv."""
+        added, keep, now = 0, deque(), time.perf_counter()
+        while self._buf:
+            r = self._buf.popleft()
+            if self._expired(r, now):
+                continue
+            if r.key == key \
+                    and rows + added + r.n <= self.ladder.max_batch:
+                batch.append(r)
+                added += r.n
+                self._inflight += 1  # claimed: see _take_batch
+            else:
+                keep.append(r)
+        self._buf.extend(keep)  # emptied above: order preserved
+        return added
+
+    def _loop_once(self):
+        """One scheduler iteration (False = thread exits): coalesce/
+        dispatch up to `pipeline` batches onto the device before
+        blocking on the oldest result — XLA computes batch k while
+        Python pads, compiles and dispatches k+1 (the serving twin of
+        the training prefetch ring)."""
+        pending = self._pending_results  # (batch, out, meta)
+        batch = self._take_batch(block=not pending)
+        self._flush_expired()  # outside the lock: callbacks may re-enter
+        if batch is not None and batch is not _STOP:
+            try:
+                pending.append(self._dispatch_batch(batch))
+            except Exception as e:  # engine survives a bad batch
+                self._fail_batch(batch, e)
+        if pending and (batch is None or batch is _STOP
+                        or len(pending) >= self.pipeline):
+            done = pending.popleft()
+            try:
+                self._resolve_batch(*done)
+            except Exception as e:
+                self._fail_batch(done[0], e)
+        return not (batch is _STOP and not pending)
+
+    def _fail_batch(self, batch, exc):
+        _monitor.counter("serve.errors").inc()
+        for r in batch:
+            _reject_future(r.future, exc)
+        with self._cv:
+            self._inflight -= len(batch)
+            self._cv.notify_all()
+
+    def _dispatch_batch(self, batch):
+        """Pad + fuse the coalesced requests and dispatch the bucket's
+        executable ASYNCHRONOUSLY — returns (batch, device outputs,
+        meta) for _resolve_batch; nothing here blocks on the device."""
+        rows = sum(r.n for r in batch)
+        b = self.ladder.batch(rows)
+        cols, pad_elems = [], 0
+        for j in range(len(batch[0].arrays)):
+            parts = []
+            for r in batch:
+                a = r.arrays[j]
+                if a.ndim > self.seq_axis:
+                    s = self.ladder.seq(a.shape[self.seq_axis])
+                    if s != a.shape[self.seq_axis]:
+                        pad = [(0, 0)] * a.ndim
+                        pad[self.seq_axis] = (0, s - a.shape[self.seq_axis])
+                        pad_elems += (s - a.shape[self.seq_axis]) * \
+                            (a.size // max(a.shape[self.seq_axis], 1))
+                        a = np.pad(a, pad, constant_values=self.pad_value)
+                parts.append(a)
+            col = np.concatenate(parts, axis=0) if len(parts) > 1 \
+                else parts[0]
+            if b > rows:
+                fill = np.full((b - rows,) + col.shape[1:], self.pad_value,
+                               col.dtype)
+                pad_elems += fill.size
+                col = np.concatenate([col, fill], axis=0)
+            cols.append(col)
+        # un-warmed bucket: compiled lazily (counted) and kept
+        entry, _ = self._ensure_compiled(
+            [jax.ShapeDtypeStruct(c.shape, c.dtype) for c in cols])
+        compiled, _ = entry
+        _stat.begin_span("serve.batch")
+        try:
+            out = compiled(*cols)  # async dispatch: returns immediately
+        finally:
+            _stat.end_span()
+        _monitor.histogram("serve.batch_size").observe(rows)
+        _monitor.counter("serve.pad_tokens").inc(int(pad_elems))
+        return batch, out, (rows, b, pad_elems)
+
+    def _resolve_batch(self, batch, out, meta):
+        """Block on one dispatched batch's outputs (the engine's ONE
+        deliberate device read), slice per request, resolve futures."""
+        rows, b, pad_elems = meta
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        host = [np.asarray(o) for o in outs]  # hot-sync-ok: batch result read
+        for h in host:
+            if h.ndim == 0 or h.shape[0] != b:
+                # a model whose outputs don't carry the leading batch
+                # dim cannot be sliced per request — fail LOUDLY rather
+                # than hand each caller a slice of the wrong axis
+                raise ValueError(
+                    f"model output shape {h.shape} does not carry the "
+                    f"batch dim (expected leading {b}); the engine can "
+                    "only serve batch-leading outputs")
+        single = not isinstance(out, (list, tuple))
+        now = time.perf_counter()
+        off = 0
+        lat_sum = 0.0
+        # a view into the padded batch would pin the whole bucket-sized
+        # host array for as long as any caller retains its result: copy
+        # per request, except when one request IS the whole batch
+        share = len(batch) == 1 and batch[0].n == b
+        for r in batch:
+            sl = [h[off:off + r.n] if share else h[off:off + r.n].copy()
+                  for h in host]
+            off += r.n
+            lat = now - r.t_submit
+            lat_sum += lat
+            _monitor.histogram("serve.latency_s").observe(lat)
+            _resolve_future(r.future, sl[0] if single else sl)
+        with self._cv:
+            self._inflight -= len(batch)
+            self._cv.notify_all()
+        _monitor.export_step(
+            {"engine": self.name, "requests": len(batch),
+             "batch_size": rows, "bucket_batch": b,
+             "queue_depth": len(self._buf), "pad_tokens": int(pad_elems),
+             "latency_s": lat_sum / len(batch)}, kind="serve")
+
+    # -- lifecycle -------------------------------------------------------
+    def pause(self):
+        """Hold dispatch (queued requests wait; submits still accepted)."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self):
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def _outstanding(self):
+        # _expired_reqs counts: those futures are still unresolved
+        # until the dispatcher's next _flush_expired, and drain()
+        # promises "every queued request has resolved"
+        return bool(self._buf or self._inflight or self._expired_reqs)
+
+    def _take_pending(self):
+        """Detach the queued, never-claimed requests (under self._cv);
+        the caller rejects them outside the lock."""
+        out = list(self._buf)
+        self._buf.clear()
+        return out
+
+    def _take_outstanding(self):
+        # _take_pending plus the work only the (dead) scheduler thread
+        # could have resolved: deferred expiries and dispatched-but-
+        # unresolved batches
+        out = self._take_pending()
+        out.extend(self._expired_reqs)
+        self._expired_reqs.clear()
+        while self._pending_results:
+            out.extend(self._pending_results.popleft()[0])
+        return out
+
+    def _reject_detached(self, reqs, exc):
+        for r in reqs:
+            _reject_future(r.future, exc)
+
+
+# ---------------------------------------------------------------------------
+# Generation: continuous batching over the paged KV cache
+# ---------------------------------------------------------------------------
+
+_GEN_END = object()
+
+
+class GenerationHandle:
+    """Per-request view of an in-flight generation: `tokens()` streams
+    token ids as the decode loop produces them; `result()` blocks for
+    the full generated sequence (np.int64 array, prompt excluded)."""
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.future = Future()
+        self._stream = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self.t_submit = time.perf_counter()
+
+    def _push(self, tok):
+        with self._cv:
+            self._stream.append(tok)
+            self._cv.notify_all()
+
+    def _close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def tokens(self):
+        """Iterator of token ids, yielding each as soon as it is
+        decoded; ends when the sequence finishes (or its error is
+        raised)."""
+        while True:
+            with self._cv:
+                while not self._stream and not self._closed:
+                    self._cv.wait(0.05)
+                if self._stream:
+                    tok = self._stream.popleft()
+                else:
+                    break
+            yield tok
+        # a CANCELLED stream just ends (nobody is waiting for more) —
+        # and Future.exception() would RAISE CancelledError here, not
+        # return it, so the guard is load-bearing
+        exc = self.future.exception() \
+            if self.future.done() and not self.future.cancelled() else None
+        if exc is not None:
+            raise exc
+
+    def result(self, timeout=None):
+        return self.future.result(timeout)
+
+
+class _ActiveSeq:
+    __slots__ = ("sid", "handle", "generated", "last", "reserve")
+
+    def __init__(self, sid, handle, reserve):
+        self.sid = sid
+        self.handle = handle
+        self.generated = []
+        self.last = None
+        self.reserve = reserve  # worst-case pages this request may draw
+
+
+class GenerationEngine(_SchedulerLifecycle):
+    """Continuous-batching autoregressive serving over a shared
+    `PagedKVCache`.
+
+        engine = GenerationEngine(model, n_pages=256, max_batch=8,
+                                  eos_token_id=50256)
+        h = engine.submit(prompt_ids, max_new_tokens=64)
+        for tok in h.tokens(): ...      # streamed as decoded
+        full = h.result()               # np.int64 [n_generated]
+
+    The decode loop alternates two phases without ever stalling
+    in-flight work: (1) ADMIT — while a slot and enough free pages for
+    the worst case (prompt + max_new_tokens; conservative reservation =
+    no mid-decode preemption) exist, prefill the next queued prompt
+    into the shared page pool and stream its first token; (2) DECODE —
+    one fixed-shape jitted step advances every active sequence by one
+    token (batch padded to a power-of-two bucket with rows targeting
+    the reserved pad page, so admits/evicts never change the compiled
+    shape). Finished sequences free their pages immediately. Greedy
+    (argmax) decoding — deterministic, token-for-token equal to a
+    single-sequence paged decode of the same prompt."""
+
+    def __init__(self, model, n_pages=256, page_size=16, max_batch=8,
+                 max_queue=64, max_new_tokens=64, eos_token_id=None,
+                 cache=None, name=None):
+        self.name = name or f"gen{next(_ENGINE_IDS)}"
+        for need in ("paged_decode_step", "make_paged_cache"):
+            if not hasattr(model, need):
+                raise TypeError(
+                    f"GenerationEngine needs a model with {need}() "
+                    "(e.g. models.gpt.GPTForCausalLM)")
+        self.model = model
+        self.cache = cache if cache is not None else \
+            model.make_paged_cache(n_pages, page_size)
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.default_max_new = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.retraces = 0  # decode executables compiled in THIS engine
+        self._synced_traces = getattr(model, "_paged_decode_traces", 0)
+        self._pending = deque()
+        self._active = []        # list of _ActiveSeq, decode-batch order
+        self._admitting = 0      # popped from pending, prefill in flight
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._abort = False      # no-wait shutdown: fail active too
+        self._next_sid = 0
+        self._thread = threading.Thread(
+            target=_run_scheduler, args=(weakref.ref(self),),
+            name="serve-decode", daemon=True)
+        self._thread.start()
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None):
+        """Queue one prompt (1-D int array) for generation; returns a
+        GenerationHandle. Rejects immediately (QueueFullError) when the
+        queue is full, and validates the context limit up front."""
+        prompt = np.asarray(
+            prompt_ids.value if isinstance(prompt_ids, Tensor)
+            else prompt_ids).astype(np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else self.default_max_new
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new}")
+        limit = getattr(getattr(self.model, "cfg", None),
+                        "max_position_embeddings", None)
+        if limit is not None and prompt.size + max_new > limit:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new} "
+                f"exceeds max_position_embeddings {limit}")
+        usable = self.cache.n_pages - 1  # page 0 is the reserved pad page
+        if self.cache.pages_needed(prompt.size + max_new) > usable:
+            raise ValueError(
+                f"request needs {self.cache.pages_needed(prompt.size + max_new)} "
+                f"pages (prompt {prompt.size} + max_new {max_new}) but the "
+                f"cache only has {usable} usable — it could NEVER be "
+                "admitted; grow n_pages or shorten the request")
+        eos = self.eos_token_id if eos_token_id is None else eos_token_id
+        handle = GenerationHandle(prompt, max_new, eos)
+        with self._cv:
+            if self._stopping:
+                raise EngineStopped("engine is drained/shut down")
+            if len(self._pending) >= self.max_queue:
+                _monitor.counter("serve.rejected").inc()
+                raise QueueFullError(
+                    f"generation queue full ({self.max_queue} waiting)")
+            self._pending.append(handle)
+            _monitor.counter("serve.requests").inc()
+            _monitor.gauge("serve.queue_depth").set(len(self._pending))
+            self._cv.notify_all()
+        return handle
+
+    # -- the scheduler/decode loop --------------------------------------
+    def _loop_once(self):
+        """One admit+decode iteration (False = thread exits). The
+        runner (_run_scheduler) re-calls while we return True, holding
+        no strong engine ref in between."""
+        with self._cv:
+            if not self._pending and not self._active:
+                if self._stopping:
+                    return False
+                self._cv.wait(0.05)  # idle: wait for work
+                if not self._pending and not self._active:
+                    return True  # still idle: let the runner drop its ref
+        if self._abort:
+            # shutdown(wait=False): a long in-flight generation must
+            # not keep this thread decoding past the join — fail the
+            # active set (loop thread owns the cache) and exit
+            self._fail_all(EngineStopped("engine shut down"))
+            return False
+        try:
+            self._admit()
+            if self._active:
+                self._decode_step()
+            else:
+                # pending work that could not admit yet (pages held
+                # by nothing — transient) must not busy-spin the
+                # scheduler; submissions/evictions notify
+                with self._cv:
+                    if self._pending and not self._stopping:
+                        self._cv.wait(0.01)
+        except Exception as e:
+            _monitor.counter("serve.errors").inc()
+            self._fail_all(e)
+        return True
+
+    def _admit(self):
+        """Prefill queued prompts into free slots between decode steps.
+        Admission reserves the worst case (prompt + max_new tokens of
+        pages) so a decoding sequence can never hit out-of-pages."""
+        while True:
+            with self._cv:
+                if not self._pending or len(self._active) >= self.max_batch:
+                    return
+                handle = self._pending[0]
+                if handle.future.cancelled():
+                    # cancelled while queued: drop it BEFORE paying the
+                    # prefill (the priciest per-request op here) or
+                    # reserving its pages
+                    self._pending.popleft()
+                    _monitor.gauge("serve.queue_depth").set(
+                        len(self._pending))
+                    handle._close()
+                    continue
+                need = self.cache.pages_needed(
+                    handle.prompt.size + handle.max_new_tokens)
+                # allocation is LAZY: active sequences still hold claims
+                # on pages they haven't drawn yet — admit only against
+                # what's free AFTER every outstanding reservation
+                outstanding = sum(
+                    max(s.reserve - self.cache.pages_held(s.sid), 0)
+                    for s in self._active)
+                if not self.cache.can_allocate(
+                        handle.prompt.size + handle.max_new_tokens,
+                        reserved=outstanding):
+                    return  # wait for evictions to free pages
+                self._pending.popleft()
+                self._admitting += 1  # drain() must see the handoff
+                _monitor.gauge("serve.queue_depth").set(len(self._pending))
+            try:
+                sid = f"g{self._next_sid}"
+                self._next_sid += 1
+                self.cache.add_sequence(sid)
+                seq = _ActiveSeq(sid, handle, need)
+                try:
+                    logits = self.model.paged_decode_step(
+                        self.cache, [sid],
+                        Tensor(jnp.asarray(handle.prompt[None, :])))
+                    # .value, not the Tensor: Tensor has no __array__,
+                    # so np.asarray on it builds a dtype=object array
+                    # element-by-element — minutes per step at real
+                    # vocab sizes
+                    tok = int(np.asarray(logits.value)[0].argmax())  # hot-sync-ok: sampling is the prefill's sync point
+                except Exception as e:
+                    self.cache.free_sequence(sid)
+                    _reject_future(handle.future, e)
+                    handle._close()
+                    continue
+                _monitor.histogram("serve.ttft_s").observe(
+                    time.perf_counter() - handle.t_submit)
+                self._sync_retraces()
+                self._active.append(seq)
+                self._emit(seq, tok)
+            finally:
+                with self._cv:
+                    self._admitting -= 1
+                    self._cv.notify_all()
+
+    def _decode_step(self):
+        """ONE jitted step for every active sequence: the decode batch
+        is padded to a power-of-two bucket (rows that scatter into the
+        reserved pad page), so the compiled program's shapes are fixed
+        while sequences join and leave."""
+        sids = [s.sid for s in self._active]
+        toks = np.asarray([[s.last] for s in self._active], np.int64)  # hot-sync-ok: host int list, not a device read
+        b = len(sids)
+        pad_to = min(1 << (b - 1).bit_length(),
+                     1 << (self.max_batch - 1).bit_length())
+        pad_to = max(pad_to, b)
+        logits = self.model.paged_decode_step(
+            self.cache, sids, Tensor(jnp.asarray(toks)), pad_to=pad_to)
+        # .value, not the Tensor (no __array__ -> dtype=object), see _admit
+        nxt = np.asarray(logits.value).argmax(-1)  # hot-sync-ok: sampling is the step's sync point
+        self._sync_retraces()
+        now = time.perf_counter()
+        _monitor.histogram("serve.batch_size").observe(b)
+        _monitor.counter("serve.pad_tokens").inc(int(pad_to - b))
+        _monitor.export_step(
+            {"engine": self.name, "requests": b, "batch_size": b,
+             "bucket_batch": int(pad_to),
+             "queue_depth": len(self._pending),
+             "pad_tokens": int(pad_to - b),
+             # for decode batches latency_s is the mean IN-FLIGHT age of
+             # the step's requests (they are not finished yet)
+             "latency_s": sum(now - s.handle.t_submit
+                              for s in self._active) / b}, kind="serve")
+        for seq, tok in zip(list(self._active), nxt):
+            self._emit(seq, int(tok))
+
+    def _emit(self, seq, tok):
+        """Record one decoded token; stream it; evict on finish — or on
+        caller cancel(), which must free the pages and the batch slot
+        instead of decoding a sequence nobody is waiting for."""
+        h = seq.handle
+        if h.future.cancelled():
+            self.cache.free_sequence(seq.sid)
+            self._active.remove(seq)
+            h._close()
+            with self._cv:
+                self._cv.notify_all()  # pages freed: admission may proceed
+            return
+        seq.generated.append(tok)
+        seq.last = tok
+        seq.handle._push(tok)
+        if (h.eos_token_id is not None and tok == h.eos_token_id) \
+                or len(seq.generated) >= h.max_new_tokens:
+            self.cache.free_sequence(seq.sid)
+            self._active.remove(seq)
+            _monitor.histogram("serve.latency_s").observe(
+                time.perf_counter() - h.t_submit)
+            final = np.asarray(seq.generated, np.int64)  # hot-sync-ok: host int list, not a device read
+            _resolve_future(h.future, final)
+            h._close()
+            with self._cv:
+                self._cv.notify_all()  # pages freed: admission may proceed
+
+    def _sync_retraces(self):
+        """Fold the model's trace-time decode-compile counter (see
+        GPTForCausalLM._paged_decode_jit) into serve.retraces, delta
+        since the last sync. The steady-state health signal: a growing
+        count means admit/evict is changing the compiled shapes —
+        exactly what plan_decode(pad_to=) exists to prevent."""
+        n = getattr(self.model, "_paged_decode_traces", 0)
+        if n > self._synced_traces:
+            d = n - self._synced_traces
+            self._synced_traces = n
+            self.retraces += d
+            _monitor.counter("serve.retraces").inc(d)
+
+    def _fail_all(self, exc):
+        """A decode-step failure poisons shared state (donated pools):
+        fail every in-flight request loudly rather than hang them."""
+        with self._cv:
+            seqs, self._active = list(self._active), []
+            pend, self._pending = list(self._pending), deque()
+        for seq in seqs:
+            try:
+                self.cache.free_sequence(seq.sid)
+            except Exception:
+                pass
+            _reject_future(seq.handle.future, exc)
+            seq.handle._close()
+        for h in pend:
+            _reject_future(h.future, exc)
+            h._close()
+
+    # -- lifecycle (drain/shutdown via _SchedulerLifecycle) --------------
+    def _outstanding(self):
+        return bool(self._pending or self._active or self._admitting)
+
+    def _take_pending(self):
+        self._abort = True  # the loop thread fails _active itself
+        out = [(h, None) for h in self._pending]
+        self._pending.clear()
+        return out
+
+    def _take_outstanding(self):
+        # the loop thread is gone (or dying) with the engine, so the
+        # _abort flag set by _take_pending has no reader — detach the
+        # active set too or their handles hang forever
+        out = self._take_pending()
+        out += [(s.handle, s.sid) for s in self._active]
+        self._active = []
+        return out
+
+    def _reject_detached(self, items, exc):
+        for h, sid in items:
+            if sid is not None:
+                try:
+                    self.cache.free_sequence(sid)
+                except Exception:
+                    pass
+            _reject_future(h.future, exc)
+            h._close()

@@ -74,7 +74,7 @@ def _put(a, sharding_fn):
             return a
         a = jax.device_put(a, sh)
     else:
-        a = np.asarray(a)
+        a = np.asarray(a)  # hot-sync-ok: host ndarray normalization, not a device read
         a = jax.device_put(a, sh) if sh is not None else jax.device_put(a)
     try:
         _monitor.counter("prefetch.h2d_bytes").inc(int(a.nbytes))

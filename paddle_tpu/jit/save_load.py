@@ -277,10 +277,14 @@ class TranslatedLayer:
             p.clear_grad()
 
 
-def load(path, **configs):
+def load(path, params_path=None, **configs):
+    """Load a saved artifact. `params_path` overrides the default
+    `<path>.pdiparams` sibling — the inference Config(model_path,
+    params_path) pair maps straight onto it (reference AnalysisConfig
+    keeps the program and the weights as two independent files)."""
     with open(path + ".pdmodel", "rb") as f:
         exported = jax_export.deserialize(bytearray(f.read()))
-    with open(path + ".pdiparams", "rb") as f:
+    with open(params_path or (path + ".pdiparams"), "rb") as f:
         state = pickle.load(f)
     meta = {}
     if os.path.exists(path + ".meta"):

@@ -469,7 +469,7 @@ class GPTForCausalLM(nn.Layer):
             cfg.hidden_size // cfg.num_heads,
             dtype or self.gpt.wte.weight.value.dtype)
 
-    def paged_decode_step(self, cache, seq_ids, input_ids):
+    def paged_decode_step(self, cache, seq_ids, input_ids, pad_to=None):
         """One continuous-batching step over a shared PagedKVCache:
         prefill when input_ids has T>1 (new request joining the batch),
         decode when T==1. Rows are independent sequences; lengths may be
@@ -478,7 +478,13 @@ class GPTForCausalLM(nn.Layer):
 
         Decode runs as ONE jitted program (page pools donated, k/v rows
         scatter-written in batch) — the host only plans page ids; the
-        per-layer host loop remains for prefill, where T varies."""
+        per-layer host loop remains for prefill, where T varies.
+
+        pad_to (decode only): pad the traced batch to a fixed size with
+        rows targeting the reserved pad page (PagedKVCache.plan_decode),
+        so a serving scheduler's decode program keeps ONE compiled shape
+        while sequences join/leave; returned logits are sliced back to
+        the real B."""
         B, T = input_ids.shape
         # poisoned-cache guard hoisted here so BOTH paths (T>1 prefill and
         # T==1 decode) fail with the explicit message instead of an opaque
@@ -499,7 +505,8 @@ class GPTForCausalLM(nn.Layer):
                 f"max_position_embeddings={limit} after {T} token(s); "
                 "free them or raise the limit")
         if T == 1:
-            return self._paged_decode_jit(cache, seq_ids, input_ids)
+            return self._paged_decode_jit(cache, seq_ids, input_ids,
+                                          pad_to=pad_to)
         caches = [PagedCacheSlot(cache, l, list(seq_ids), None)
                   for l in range(self.cfg.num_layers)]
         logits, _ = self(input_ids, caches=caches)
@@ -512,17 +519,16 @@ class GPTForCausalLM(nn.Layer):
         traced arguments, so the executables stay valid."""
         self._paged_params = None
 
-    def _paged_decode_jit(self, cache, seq_ids, input_ids):
+    def _paged_decode_jit(self, cache, seq_ids, input_ids, pad_to=None):
         import jax
         from ..jit.api import functional_call, state_arrays
 
         L = self.cfg.num_layers
-        if cache.k is None:
-            raise RuntimeError(
-                "this PagedKVCache was poisoned by an earlier failed "
-                "step — rebuild it with make_paged_cache() and "
-                "re-prefill in-flight sequences")
-        pages, in_pages, pt, lens = cache.plan_decode(seq_ids)
+        B = len(seq_ids)
+        # poisoned-cache guard lives in paged_decode_step (the only
+        # caller), hoisted to cover the prefill path too
+        pages, in_pages, pt, lens = cache.plan_decode(seq_ids,
+                                                     pad_to=pad_to)
         # params are frozen during serving: snapshot once (see
         # clear_decode_cache for mid-serving weight swaps)
         params = getattr(self, "_paged_params", None)
@@ -533,6 +539,12 @@ class GPTForCausalLM(nn.Layer):
             model = self
 
             def step(ps, kps, vps, toks, pages, in_pages, pt, lens):
+                # Python side effects run at TRACE time only: this is
+                # an exact count of decode executables compiled (one
+                # per novel (B, table width) signature) — the serving
+                # engine folds its delta into serve.retraces
+                model._paged_decode_traces = getattr(
+                    model, "_paged_decode_traces", 0) + 1
                 slots = [PagedJitSlot(kps[l], vps[l], pages, in_pages,
                                       pt, lens) for l in range(L)]
                 logits, out_slots = functional_call(
@@ -545,6 +557,11 @@ class GPTForCausalLM(nn.Layer):
             # own cache keys on (B, table width) shapes
             fn = self._paged_jit_fn = jax.jit(step, donate_argnums=(1, 2))
         toks = input_ids.value.astype(jnp.int32)
+        if pad_to is not None and pad_to > B:
+            # pad rows decode token 0 at position 0 into the reserved
+            # pad page — garbage by construction, sliced off below
+            toks = jnp.concatenate(
+                [toks, jnp.zeros((int(pad_to) - B, 1), jnp.int32)])
         try:
             logits, new_k, new_v = fn(
                 params, list(cache.k), list(cache.v), toks, pages,
@@ -568,7 +585,7 @@ class GPTForCausalLM(nn.Layer):
         cache.v = list(new_v)
         for sid in seq_ids:
             cache.advance(sid, 1)
-        return Tensor(logits)
+        return Tensor(logits[:B])
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None):

@@ -97,6 +97,30 @@ class PagedKVCache:
     def n_free_pages(self):
         return len(self._free)
 
+    def pages_needed(self, n_tokens):
+        """Pages a FRESH sequence of n_tokens would consume (pages are
+        never shared across sequences)."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def pages_held(self, seq_id):
+        """Pages currently allocated to a sequence. Allocation is lazy
+        (pages are drawn as tokens arrive), so a scheduler reserving
+        worst cases must count each active sequence's outstanding claim
+        (reservation - held), not just n_free_pages()."""
+        return len(self._tables[seq_id])
+
+    def can_allocate(self, n_tokens, reserved=0):
+        """Admission control: True when a new sequence of n_tokens fits
+        the free list AFTER `reserved` pages of outstanding claims.
+        Allocation is lazy, so the free list alone overstates what is
+        safely available: a scheduler reserving each request's worst
+        case (prompt + max_new_tokens) must pass the sum of
+        (reservation - pages_held) over its active sequences — with
+        that term a mid-decode out-of-pages is impossible (see
+        GenerationEngine._admit)."""
+        return self.pages_needed(n_tokens) + int(reserved) \
+            <= len(self._free)
+
     def _ensure_capacity(self, seq_id, n_new):
         need = self._len[seq_id] + n_new
         have = len(self._tables[seq_id]) * self.page_size
@@ -140,13 +164,19 @@ class PagedKVCache:
         """Commit n_tokens appended to EVERY layer."""
         self._len[seq_id] += n_tokens
 
-    def plan_decode(self, seq_ids):
+    def plan_decode(self, seq_ids, pad_to=None):
         """Host-side plan for ONE fully-jitted decode step: allocate
         capacity for one new token per sequence and return
         (pages [B], in_pages [B], page_table [B, width], lengths [B])
         — the write coordinates and read views the jitted step needs.
         Lengths are the PRE-write token counts; call advance(sid, 1)
-        after the step commits."""
+        after the step commits.
+
+        pad_to > B pads the plan with rows that scatter into the
+        reserved pad page 0 (in_page 0, empty table, length 0): a
+        continuous-batching scheduler keeps the decode step's compiled
+        shape FIXED while sequences join and leave the batch — pad-row
+        outputs are garbage by construction and must be sliced off."""
         if len(set(seq_ids)) != len(seq_ids):
             # duplicates would scatter two rows to the same (page,
             # in_page) — one silently lost — then advance twice
@@ -155,12 +185,22 @@ class PagedKVCache:
         for s in seq_ids:
             self._ensure_capacity(s, 1)
         P = self.page_size
+        B = len(seq_ids)
+        n_pad = 0
+        if pad_to is not None:
+            if pad_to < B:
+                raise ValueError(f"pad_to={pad_to} < batch size {B}")
+            n_pad = int(pad_to) - B
         pages = np.asarray(
-            [self._tables[s][self._len[s] // P] for s in seq_ids],
-            np.int32)
-        in_pages = np.asarray([self._len[s] % P for s in seq_ids],
-                              np.int32)
+            [self._tables[s][self._len[s] // P] for s in seq_ids]
+            + [0] * n_pad, np.int32)
+        in_pages = np.asarray([self._len[s] % P for s in seq_ids]
+                              + [0] * n_pad, np.int32)
         pt, lens = self.batch_views(seq_ids)
+        if n_pad:
+            pt = jnp.concatenate(
+                [pt, jnp.zeros((n_pad, pt.shape[1]), jnp.int32)])
+            lens = jnp.concatenate([lens, jnp.zeros((n_pad,), jnp.int32)])
         return jnp.asarray(pages), jnp.asarray(in_pages), pt, lens
 
     # ---- reads --------------------------------------------------------

@@ -12,6 +12,15 @@ actually blocked on a device read, recorded by DeferredLoss; sum via
 the device by the prefetch ring), `prefetch.depth` (gauge — ring fill
 level; pinned at 0 means the step loop is data-bound).
 
+Serving signals (the continuous-batching engines, docs/SERVING.md):
+`serve.queue_depth` gauge, `serve.batch_size` / `serve.latency_s` /
+`serve.ttft_s` histograms, `serve.requests` / `serve.rejected` /
+`serve.expired` / `serve.pad_tokens` / `serve.retraces` /
+`serve.errors` counters.
+Histograms keep a bounded reservoir of recent observations, so tail
+latency is queryable in-process: `histogram("serve.latency_s")
+.percentile(99)`.
+
 Registry usage:
 
     from paddle_tpu.profiler import monitor
@@ -27,6 +36,7 @@ HybridTrainStep call it once per optimizer step with the documented step
 schema (step, step_time_s, compile_s, cache_hit, peak_bytes, flops, mfu
 — validated by tools/check_metrics_schema.py); see docs/OBSERVABILITY.md.
 """
+import collections
 import json
 import os
 import threading
@@ -76,8 +86,12 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming count/sum/min/max/last of observations (durations)."""
+    """Streaming count/sum/min/max/last of observations (durations),
+    plus a bounded reservoir of the most recent `RESERVOIR` samples for
+    percentile queries (serving tail latency: p50/p99)."""
     kind = "histogram"
+
+    RESERVOIR = 2048  # recent-window size for percentile()
 
     def __init__(self, name):
         self.name = name
@@ -86,6 +100,7 @@ class Histogram:
         self.min = float("inf")
         self.max = 0.0
         self.last = 0.0
+        self._samples = collections.deque(maxlen=self.RESERVOIR)
 
     def observe(self, v):
         v = float(v)
@@ -93,6 +108,7 @@ class Histogram:
             self.count += 1
             self.sum += v
             self.last = v
+            self._samples.append(v)
             if v < self.min:
                 self.min = v
             if v > self.max:
@@ -101,6 +117,18 @@ class Histogram:
     @property
     def avg(self):
         return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, p):
+        """Nearest-rank percentile (p in [0, 100]) over the reservoir of
+        the last RESERVOIR observations — a recent window, not all-time
+        (all-time min/max/avg stay exact in the streaming fields)."""
+        with _lock:
+            s = sorted(self._samples)
+        if not s:
+            return 0.0
+        idx = min(len(s) - 1,
+                  max(0, int(round(float(p) / 100.0 * (len(s) - 1)))))
+        return s[idx]
 
     def snapshot(self):
         return {"count": self.count, "sum": self.sum, "avg": self.avg,

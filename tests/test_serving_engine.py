@@ -1,0 +1,978 @@
+"""Serving engine (ISSUE 4): continuous-batching InferenceEngine /
+GenerationEngine, shape-bucket AOT warmup, paged-KV decode scheduling,
+Predictor IO fixes, and the serve metrics contract.
+
+Proof points:
+- bucket coalescing: warm() compiles exactly one executable per batch
+  bucket; steady-state concurrent serving adds ZERO retraces, and
+  concurrent requests fuse into one padded batch.
+- scheduling semantics: fast-fail queue-full rejection, in-queue
+  deadline expiry, drain()/shutdown() with in-flight work, engine
+  survival of a poisoned request.
+- continuous-batching greedy decode is token-for-token equal to
+  single-sequence paged decode, including mid-stream admit/evict, and
+  tokens stream back per request as they are produced.
+- serve.* metrics exist and the JSONL "serve" records validate against
+  tools/check_metrics_schema.py.
+- throughput: under 8 concurrent clients the engine beats the serial
+  one-request-at-a-time Predictor.run loop >= 2x (calibrated best-of-3,
+  2-CPU container pattern from test_async_pipeline.py).
+"""
+import importlib.util
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.inference.serving import (
+    BucketLadder, InferenceEngine, GenerationEngine, GenerationHandle,
+    QueueFullError, DeadlineExceeded, EngineStopped, ServingError)
+from paddle_tpu.profiler import monitor, statistic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    statistic.reset_statistics()
+    monitor.reset_metrics()
+    yield
+
+
+def _mlp(din=8, dout=4, seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(din, 16), nn.Tanh(),
+                         nn.Linear(16, dout))
+
+
+def _x(n=1, d=8, seed=0):
+    return np.random.RandomState(seed).randn(n, d).astype(np.float32)
+
+
+# -- bucket ladder ------------------------------------------------------
+
+def test_bucket_ladder_rounding_and_bounds():
+    lad = BucketLadder(batch_sizes=(1, 2, 4, 8), seq_buckets=(16, 64))
+    assert lad.batch(1) == 1 and lad.batch(3) == 4 and lad.batch(8) == 8
+    assert lad.batch(9) is None  # beyond the top bucket
+    assert lad.seq(5) == 16 and lad.seq(16) == 16 and lad.seq(17) == 64
+    with pytest.raises(ValueError, match="largest seq bucket"):
+        lad.seq(65)
+    assert BucketLadder((4, 2)).batch(3) == 4  # unsorted input ok
+    with pytest.raises(ValueError):
+        BucketLadder(())
+
+
+# -- bucket warmup / zero steady-state retraces -------------------------
+
+def test_warm_compiles_one_executable_per_bucket_then_zero_retraces():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2, 4, 8))
+    try:
+        x = _x()
+        warmed = eng.warm(x)
+        assert warmed == 4  # one per batch bucket
+        assert eng.retraces == 4
+        assert monitor.get_metric("serve.retraces").value == 4
+        assert eng.warm(x) == 0  # idempotent
+
+        ref = _mlp()(paddle.to_tensor(x)).numpy()
+        errs = []
+
+        def client(i):
+            try:
+                for j in range(10):
+                    out = eng(x)
+                    np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                               atol=1e-6)
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # the steady-state contract: traffic added NO executables
+        assert eng.retraces == warmed
+        assert monitor.get_metric("serve.retraces").value == warmed
+        assert monitor.counter("serve.requests").value == 80
+    finally:
+        eng.shutdown()
+
+
+def test_concurrent_requests_coalesce_into_one_padded_batch():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2, 4, 8),
+                          max_wait_ms=20.0)
+    try:
+        eng.pause()
+        futs = [eng.submit(_x(seed=i)) for i in range(7)]
+        eng.resume()
+        for f in futs:
+            assert f.result(timeout=30).shape == (1, 4)
+        bs = monitor.get_metric("serve.batch_size")
+        assert bs.count == 1          # ONE fused dispatch
+        assert bs.last == 7           # all seven real rows
+        # 7 rows pad to the 8-bucket: one pad row of 8 features
+        assert monitor.get_metric("serve.pad_tokens").value == 8
+    finally:
+        eng.shutdown()
+
+
+def test_seq_bucket_padding_and_per_request_slicing():
+    # raw-callable model: per-row sum over the (padded) seq axis — zero
+    # padding must not leak into results
+    import jax.numpy as jnp
+    eng = InferenceEngine(lambda x: jnp.sum(x, axis=1),
+                          batch_sizes=(1, 2, 4), seq_buckets=(8,),
+                          max_wait_ms=20.0)
+    try:
+        a = np.ones((1, 5), np.float32)
+        b = 2 * np.ones((2, 7), np.float32)
+        eng.pause()
+        fa, fb = eng.submit(a), eng.submit(b)
+        eng.resume()
+        np.testing.assert_allclose(fa.result(timeout=30), [5.0])
+        np.testing.assert_allclose(fb.result(timeout=30), [14.0, 14.0])
+        # both bucketed to seq 8 -> same signature -> ONE fused batch
+        assert monitor.get_metric("serve.batch_size").count == 1
+        assert monitor.get_metric("serve.pad_tokens").value > 0
+    finally:
+        eng.shutdown()
+
+
+def test_mixed_signatures_do_not_fuse_but_both_complete():
+    eng = InferenceEngine(lambda x: x * 2, batch_sizes=(1, 2, 4),
+                          max_wait_ms=5.0)
+    try:
+        eng.pause()
+        f1 = eng.submit(np.ones((1, 3), np.float32))
+        f2 = eng.submit(np.ones((1, 5), np.float32))
+        eng.resume()
+        assert f1.result(timeout=30).shape == (1, 3)
+        assert f2.result(timeout=30).shape == (1, 5)
+        assert monitor.get_metric("serve.batch_size").count == 2
+    finally:
+        eng.shutdown()
+
+
+# -- scheduling: deadlines, backpressure, drain/shutdown ----------------
+
+def test_cancelled_future_does_not_kill_dispatcher():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    try:
+        eng.pause()
+        f = eng.submit(_x(), deadline_ms=1)
+        assert f.cancel()  # caller gives up: future now CANCELLED
+        time.sleep(0.05)   # deadline also expires in-queue
+        eng.resume()
+        # a set_exception on the cancelled future would raise
+        # InvalidStateError in the scheduler thread — prove it survived
+        out = eng(_x())
+        assert out.shape == (1, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_deadline_expires_in_queue():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    try:
+        eng.pause()
+        f = eng.submit(_x(), deadline_ms=1)
+        time.sleep(0.05)
+        eng.resume()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+        assert monitor.get_metric("serve.expired").value == 1
+    finally:
+        eng.shutdown()
+
+
+def test_expiry_done_callback_may_reenter_engine():
+    # rejections are deferred OUTSIDE the scheduler lock, so a
+    # done-callback that re-enters the engine (retry pattern) must not
+    # deadlock the dispatcher
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    try:
+        retried = []
+
+        def retry(fut):
+            retried.append(eng.submit(_x()))
+
+        eng.pause()
+        f = eng.submit(_x(), deadline_ms=1)
+        f.add_done_callback(retry)
+        time.sleep(0.05)
+        eng.resume()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+        # the callback runs in the dispatcher thread; give it a moment
+        for _ in range(100):
+            if retried:
+                break
+            time.sleep(0.01)
+        assert retried and retried[0].result(timeout=30).shape == (1, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_constructor_error_before_thread_start_is_clean():
+    import gc
+    with pytest.raises(ValueError):
+        InferenceEngine(_mlp(), batch_sizes=())
+    gc.collect()  # __del__ on the half-built engine must not raise
+
+
+def test_abandoned_engine_is_collectible_and_thread_exits():
+    # the scheduler thread holds only a WEAKREF between iterations: an
+    # engine dropped without shutdown() must not leak the thread (or
+    # the engine itself, pinned via the thread registry) forever
+    import gc
+    import weakref
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    assert eng(_x()).shape == (1, 4)
+    thread = eng._thread
+    ref = weakref.ref(eng)
+    del eng
+    for _ in range(100):
+        gc.collect()
+        if ref() is None and not thread.is_alive():
+            break
+        time.sleep(0.02)
+    assert ref() is None, "scheduler thread still pins the engine"
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+
+
+def test_abandoned_engine_rejects_queued_requests():
+    # an engine GC'd without shutdown() must not strand queued work:
+    # __del__ rejects it (EngineStopped) so a caller blocked in
+    # Future.result() fails loudly instead of hanging forever
+    import gc
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    eng.pause()  # never claimed: the request sits in the queue
+    fut = eng.submit(_x())
+    del eng
+    for _ in range(100):
+        gc.collect()
+        if fut.done():
+            break
+        time.sleep(0.02)
+    with pytest.raises(EngineStopped):
+        fut.result(timeout=5)
+
+
+def test_scheduler_crash_fails_queued_requests_loudly():
+    # an exception ESCAPING the loop core must not silently kill the
+    # dispatcher with callers parked in result(): the runner's
+    # catch-all fails outstanding work with the cause chained and the
+    # engine refuses new submits
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    eng.pause()
+    fut = eng.submit(_x())
+
+    def boom(block=True):
+        raise RuntimeError("loop core escaped")
+
+    # the crash cleanup must reject OUTSIDE the engine lock: a done-
+    # callback that re-enters the engine must not deadlock the teardown
+    reentered = []
+    fut.add_done_callback(
+        lambda f: reentered.append(_reenter_submit(eng)))
+    eng._take_batch = boom
+    with pytest.raises(ServingError, match="scheduler thread crashed"):
+        fut.result(timeout=10)
+    assert isinstance(fut.exception().__cause__, RuntimeError)
+    assert reentered == ["EngineStopped"]
+    with pytest.raises(EngineStopped):
+        eng.submit(_x())
+    eng._thread.join(timeout=5)
+    assert not eng._thread.is_alive()
+
+
+def _reenter_submit(eng):
+    try:
+        eng.submit(_x())
+        return "accepted"
+    except Exception as e:
+        return type(e).__name__
+
+
+def test_cancelled_generation_stream_ends_cleanly():
+    # Future.exception() on a cancelled future RAISES CancelledError
+    # instead of returning it — tokens() must treat a cancel as plain
+    # end-of-stream, mid-stream tokens still delivered
+    h = GenerationHandle(np.array([1]), 4, None)
+    h._push(7)
+    assert h.future.cancel()
+    h._close()
+    assert list(h.tokens()) == [7]
+
+
+def test_submit_copies_caller_buffer():
+    # submit() returns before dispatch: a caller reusing its input
+    # buffer must not mutate the queued request
+    eng = InferenceEngine(_mlp(), batch_sizes=(1,))
+    try:
+        eng.warm(_x())
+        buf = _x()
+        ref = eng(buf.copy())
+        eng.pause()
+        fut = eng.submit(buf)
+        buf[:] = 99.0  # overwrite while the request is still queued
+        eng.resume()
+        np.testing.assert_array_equal(fut.result(timeout=30), ref)
+    finally:
+        eng.shutdown()
+
+
+def test_results_do_not_pin_the_padded_batch():
+    # a coalesced/padded batch's per-request results must OWN their
+    # data: a view would pin the whole bucket-sized host array for as
+    # long as any caller retains its slice
+    eng = InferenceEngine(_mlp(), batch_sizes=(4,), max_wait_ms=50.0)
+    try:
+        eng.warm(_x())
+        x = _x()
+        futs = [eng.submit(x) for _ in range(2)]  # padded 2 -> bucket 4
+        for f in futs:
+            out = f.result(timeout=30)
+            assert out.shape == (1, 4)
+            assert out.base is None, "result is a view into the batch"
+    finally:
+        eng.shutdown()
+
+
+def test_queue_full_fast_fail_rejection():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2), max_queue=3)
+    try:
+        eng.pause()
+        x = _x()
+        futs = [eng.submit(x) for _ in range(3)]
+        with pytest.raises(QueueFullError, match="queue full"):
+            eng.submit(x)
+        assert monitor.get_metric("serve.rejected").value == 1
+        eng.resume()
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        eng.shutdown()
+
+
+def test_request_batch_must_fit_ladder():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2, 4))
+    try:
+        with pytest.raises(ValueError, match="does not fit the ladder"):
+            eng.submit(_x(5))
+        with pytest.raises(ValueError, match="leading batch dim"):
+            eng.submit(_x(2), _x(3))
+    finally:
+        eng.shutdown()
+
+
+def test_over_bucket_seq_rejected_at_submit_not_in_dispatcher():
+    import jax.numpy as jnp
+    eng = InferenceEngine(lambda x: x * 2, batch_sizes=(1, 2),
+                          seq_buckets=(8,))
+    try:
+        # an over-bucket length must fail THE CALLER — discovered at
+        # dispatch it would kill the scheduler thread for everyone
+        with pytest.raises(ValueError, match="largest seq bucket"):
+            eng.submit(np.ones((1, 9), np.float32))
+        # and the dispatcher is still alive afterwards
+        out = eng(np.ones((1, 8), np.float32))
+        np.testing.assert_allclose(out, 2.0)
+    finally:
+        eng.shutdown()
+
+
+def test_drain_resolves_inflight_then_shutdown_rejects():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2, 4, 8))
+    try:
+        eng.pause()
+        futs = [eng.submit(_x(seed=i)) for i in range(5)]
+        assert eng.drain(timeout=60)  # drain() lifts the pause itself
+        assert all(f.done() for f in futs)
+        for f in futs:
+            assert f.result().shape == (1, 4)
+        with pytest.raises(EngineStopped):
+            eng.submit(_x())
+    finally:
+        eng.shutdown()  # idempotent
+
+
+def test_drain_during_coalescing_window_waits_for_claimed_request():
+    # a long max_wait window: the dispatcher pops the request and SITS
+    # in coalescing with it claimed off the queue — drain() must still
+    # count it as in flight, not return with the future unresolved
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2, 4, 8),
+                          max_wait_ms=500.0)
+    try:
+        f = eng.submit(_x())
+        time.sleep(0.05)  # let the dispatcher claim it
+        assert eng.drain(timeout=60)
+        assert f.done() and f.result().shape == (1, 4)
+    finally:
+        eng.shutdown()
+
+
+def test_engine_survives_poisoned_request():
+    def fn(x):
+        if x.shape[-1] == 3:
+            raise ValueError("bad feature dim")
+        return x * 2
+
+    eng = InferenceEngine(fn, batch_sizes=(1, 2))
+    try:
+        good = eng.submit(np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(good.result(timeout=30), 2.0)
+        bad = eng.submit(np.ones((1, 3), np.float32))
+        with pytest.raises(ValueError, match="bad feature dim"):
+            bad.result(timeout=30)
+        assert monitor.get_metric("serve.errors").value >= 1
+        # the dispatcher thread survived and keeps serving
+        good2 = eng.submit(np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(good2.result(timeout=30), 2.0)
+    finally:
+        eng.shutdown()
+
+
+# -- metrics contract ---------------------------------------------------
+
+def test_serve_metrics_keys_present_after_traffic():
+    eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+    try:
+        eng(_x())
+        snap = monitor.metrics_snapshot()
+        for key in ("serve.queue_depth", "serve.batch_size",
+                    "serve.latency_s", "serve.requests",
+                    "serve.pad_tokens", "serve.retraces"):
+            assert key in snap, f"missing {key}"
+        assert snap["serve.requests"] == 1
+        assert snap["serve.latency_s"]["count"] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_histogram_percentile_reservoir():
+    h = monitor.histogram("serve.test_lat")
+    for v in range(1, 101):
+        h.observe(v / 100.0)
+    assert h.percentile(0) == pytest.approx(0.01)
+    assert h.percentile(50) == pytest.approx(0.50, abs=0.02)
+    assert h.percentile(99) == pytest.approx(0.99, abs=0.02)
+    assert monitor.histogram("serve.empty").percentile(99) == 0.0
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_jsonl_records_validate_against_schema(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    os.environ["PADDLE_TPU_METRICS_FILE"] = path
+    try:
+        eng = InferenceEngine(_mlp(), batch_sizes=(1, 2))
+        try:
+            eng(_x())
+            eng(_x(2))
+        finally:
+            eng.shutdown()
+    finally:
+        os.environ.pop("PADDLE_TPU_METRICS_FILE", None)
+    tool = _load_tool("check_metrics_schema")
+    assert tool.validate_file(path) == []
+    import json
+    with open(path) as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    serve = [r for r in recs if r["kind"] == "serve"]
+    assert len(serve) == 2
+    # each record names its emitting engine (the only per-engine key in
+    # the process-global telemetry)
+    assert all(r["engine"] == eng.name for r in serve)
+    # and the tool really rejects a malformed serve record
+    assert tool.validate_line(
+        '{"ts": 1, "rank": 0, "kind": "serve", "requests": 1, '
+        '"batch_size": 2, "bucket_batch": 1, "queue_depth": 0, '
+        '"pad_tokens": 0, "latency_s": 0.1}')
+    assert tool.validate_line(
+        '{"ts": 1, "rank": 0, "kind": "serve", "engine": "", '
+        '"requests": 1, "batch_size": 1, "bucket_batch": 1, '
+        '"queue_depth": 0, "pad_tokens": 0, "latency_s": 0.1}')
+
+
+def test_no_hot_sync_lint_covers_serving():
+    tool = _load_tool("check_no_hot_sync")
+    assert "paddle_tpu/inference/serving.py" in tool.HOT_REGIONS
+    assert tool.main([REPO]) == 0
+    # a planted device read in a dispatcher region is caught
+    src = "\n".join([
+        "class InferenceEngine:",
+        "    def _resolve_batch(self, batch, out, meta):",
+        "        return " + "out.numpy()",
+    ])
+    errs = tool.check_source(src, ["InferenceEngine._resolve_batch"],
+                             "x.py")
+    assert len(errs) == 1
+
+
+# -- paged-KV plan padding (the fixed-shape decode enabler) -------------
+
+def test_plan_decode_pad_to_and_can_allocate():
+    from paddle_tpu.ops.paged_attention import PagedKVCache
+    cache = PagedKVCache(n_layers=1, n_pages=8, page_size=4, n_heads=1,
+                         head_dim=2)
+    assert cache.can_allocate(4 * 7)       # 7 usable pages
+    assert not cache.can_allocate(4 * 7 + 1)
+    cache.add_sequence("s")
+    import jax.numpy as jnp
+    cache.extend("s", 0, jnp.ones((3, 1, 2)), jnp.ones((3, 1, 2)))
+    cache.advance("s", 3)
+    pages, in_pages, pt, lens = cache.plan_decode(["s"], pad_to=4)
+    assert pages.shape == (4,) and in_pages.shape == (4,)
+    assert pt.shape[0] == 4 and lens.shape == (4,)
+    # pad rows target the reserved page 0 at position 0, length 0
+    assert np.all(np.asarray(pages)[1:] == 0)
+    assert np.all(np.asarray(in_pages)[1:] == 0)
+    assert np.all(np.asarray(lens)[1:] == 0)
+    assert np.asarray(lens)[0] == 3
+    with pytest.raises(ValueError, match="pad_to"):
+        cache.plan_decode(["s"], pad_to=0)
+    # reservation-aware admission: "s" holds 1 page (3 tokens) — a
+    # worst-case scheduler with 2 pages of outstanding claims must see
+    # them subtracted from the 6 remaining free pages
+    assert cache.pages_held("s") == 1
+    assert cache.can_allocate(4 * 4, reserved=2)
+    assert not cache.can_allocate(4 * 4 + 1, reserved=2)
+
+
+# -- Predictor IO satellite fixes ---------------------------------------
+
+class TestPredictorIO:
+    def _save(self, tmp_path, dim=8):
+        from paddle_tpu.jit import save, InputSpec
+        m = _mlp(dim)
+        prefix = str(tmp_path / "model")
+        save(m, prefix, input_spec=[InputSpec([None, dim], "float32")])
+        return m, prefix
+
+    def test_input_names_derive_from_saved_specs(self, tmp_path):
+        _, prefix = self._save(tmp_path)
+        p = inference.create_predictor(inference.Config(prefix))
+        assert p.get_input_names() == ["input_0"]  # exactly as saved
+        with pytest.raises(KeyError, match="unknown input"):
+            p.get_input_handle("input_1")
+
+    def test_reshape_validates_against_saved_spec(self, tmp_path):
+        _, prefix = self._save(tmp_path)
+        p = inference.create_predictor(inference.Config(prefix))
+        h = p.get_input_handle("input_0")
+        h.reshape([4, 8])  # dynamic batch, static 8: ok
+        with pytest.raises(ValueError, match="static"):
+            h.reshape([4, 9])
+        with pytest.raises(ValueError, match="rank"):
+            h.reshape([8])
+        with pytest.raises(ValueError, match="input handles"):
+            p.get_output_handle("output_0").reshape([1])
+        # the declared shape is ENFORCED at feed time, not write-only
+        with pytest.raises(ValueError, match="declared"):
+            h.copy_from_cpu(np.zeros((2, 8), np.float32))
+        h.copy_from_cpu(np.zeros((4, 8), np.float32))  # matches: ok
+        # ...and CONSUMED by that copy: the dynamic batch dim is not
+        # pinned to 4 for later feeds without a fresh reshape()
+        h.copy_from_cpu(np.zeros((2, 8), np.float32))
+
+    def test_params_path_config_arg_is_honored(self, tmp_path):
+        import shutil
+        m, prefix = self._save(tmp_path)
+        x = _x()
+        ref = inference.create_predictor(
+            inference.Config(prefix)).run([x])[0]
+        moved = str(tmp_path / "weights.bin")
+        shutil.move(prefix + ".pdiparams", moved)
+        # without params_path the default sibling is gone
+        with pytest.raises(FileNotFoundError):
+            inference.create_predictor(inference.Config(prefix))
+        cfg = inference.Config(prefix + ".pdmodel", moved)
+        assert cfg.params_file() == moved
+        out = inference.create_predictor(cfg).run([x])[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_run_without_inputs_is_a_clear_error(self, tmp_path):
+        _, prefix = self._save(tmp_path)
+        p = inference.create_predictor(inference.Config(prefix))
+        with pytest.raises(RuntimeError, match="copy_from_cpu"):
+            p.run()
+
+    def test_serving_pool_shares_one_loaded_layer(self, tmp_path):
+        _, prefix = self._save(tmp_path)
+        cfg = inference.Config(prefix)
+        cfg.enable_serving()
+        try:
+            pool = inference.PredictorPool(cfg, size=3)
+            # one engine -> one artifact load; clones share the layer
+            assert pool.retrive(1)._layer is pool.retrive(0)._layer
+            assert pool.retrive(2)._layer is pool.retrive(0)._layer
+        finally:
+            cfg.disable_serving()
+        # without serving, slots keep isolated loads (reference
+        # semantics: independent predictors)
+        pool2 = inference.PredictorPool(inference.Config(prefix), size=2)
+        assert pool2.retrive(0)._layer is not pool2.retrive(1)._layer
+
+    def test_serving_run_wider_than_top_bucket_falls_back(self, tmp_path):
+        # requests a pre-serving run() handled — 16 rows above the top
+        # batch bucket, or a "seq" dim above the top seq bucket — must
+        # be served directly, not failed, when serving is enabled
+        m, prefix = self._save(tmp_path)
+        x16 = _x(16)
+        p_ref = inference.create_predictor(inference.Config(prefix))
+        ref16 = p_ref.run([x16])[0]
+        ref1 = p_ref.run([_x()])[0]
+        cfg = inference.Config(prefix)
+        cfg.enable_serving(seq_buckets=(4,))  # dim 8 exceeds the top
+        try:
+            p = inference.create_predictor(cfg)
+            np.testing.assert_allclose(p.run([x16])[0], ref16,
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(p.run([_x()])[0], ref1,
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            cfg.disable_serving()
+
+    def test_pool_retrive_bounds_checked(self, tmp_path):
+        _, prefix = self._save(tmp_path)
+        pool = inference.PredictorPool(inference.Config(prefix), size=2)
+        assert len(pool) == 2
+        assert pool.retrive(1) is pool.retrieve(1)
+        with pytest.raises(IndexError, match="valid: 0..1"):
+            pool.retrive(2)
+        with pytest.raises(IndexError):
+            pool.retrive(-1)
+
+    def test_enable_serving_routes_pool_through_shared_engine(
+            self, tmp_path):
+        _, prefix = self._save(tmp_path)
+        x = _x()
+        ref = inference.create_predictor(
+            inference.Config(prefix)).run([x])[0]
+        cfg = inference.Config(prefix)
+        cfg.enable_serving(batch_sizes=(1, 2, 4), max_wait_ms=2.0)
+        pool = inference.PredictorPool(cfg, size=4)
+        try:
+            outs, errs = {}, []
+
+            def client(i):
+                try:
+                    outs[i] = pool.retrive(i).run([x])[0]
+                except Exception as e:
+                    errs.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs
+            for o in outs.values():
+                np.testing.assert_allclose(o, ref, rtol=1e-5, atol=1e-6)
+            # ONE engine behind all four slots
+            assert cfg._serving_engine is not None
+            assert monitor.counter("serve.requests").value == 4
+            # re-enabling RECONFIGURES: the old engine is drained and a
+            # fresh one (new settings) is built on the next run()
+            old = cfg._serving_engine
+            cfg.enable_serving(batch_sizes=(1, 2), max_queue=128)
+            assert cfg._serving_engine is None
+            pool.retrive(0).run([x])
+            assert cfg._serving_engine is not old
+            assert cfg._serving_engine.max_queue == 128
+        finally:
+            cfg.disable_serving()
+        assert cfg._serving_engine is None
+
+
+# -- generation: continuous batching == single-sequence decode ----------
+
+def _tiny_lm(seed=0):
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _ref_greedy(m, prompt, max_new):
+    """Oracle: single-sequence paged decode, one request alone."""
+    cache = m.make_paged_cache(n_pages=64, page_size=4)
+    cache.add_sequence("s")
+    logits = m.paged_decode_step(
+        cache, ["s"], paddle.to_tensor(prompt[None].astype(np.int64)))
+    toks = [int(np.asarray(logits)[0].argmax())]
+    while len(toks) < max_new:
+        logits = m.paged_decode_step(
+            cache, ["s"], paddle.to_tensor(
+                np.array([[toks[-1]]], np.int64)))
+        toks.append(int(np.asarray(logits)[0].argmax()))
+    return toks
+
+
+@pytest.mark.heavy
+class TestGenerationEngine:
+    def test_continuous_batching_equals_single_sequence_decode(self):
+        m = _tiny_lm()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 64, (n,)) for n in (5, 3, 7)]
+        refs = [_ref_greedy(m, p, 6) for p in prompts]
+
+        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+                               max_batch=4, max_new_tokens=6)
+        try:
+            handles = [eng.submit(p) for p in prompts]
+            outs = [h.result(timeout=300).tolist() for h in handles]
+            assert outs == refs  # token-for-token, despite batching
+            assert monitor.get_metric("serve.ttft_s").count == 3
+            assert monitor.get_metric("serve.latency_s").count == 3
+        finally:
+            eng.shutdown()
+
+    def test_mid_stream_admit_and_evict(self):
+        m = _tiny_lm()
+        rng = np.random.RandomState(1)
+        p1, p2, p3 = (rng.randint(0, 64, (n,)) for n in (4, 6, 3))
+        r1 = _ref_greedy(m, p1, 2)    # finishes early -> evicted
+        r2 = _ref_greedy(m, p2, 10)   # keeps decoding past the evict
+        r3 = _ref_greedy(m, p3, 4)    # admitted mid-stream into the slot
+
+        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+                               max_batch=2, max_new_tokens=10)
+        try:
+            h1 = eng.submit(p1, max_new_tokens=2)
+            h2 = eng.submit(p2, max_new_tokens=10)
+            # stream h1 to completion: its slot frees while h2 is still
+            # in flight, then h3 takes the slot (max_batch=2)
+            streamed1 = list(h1.tokens())
+            h3 = eng.submit(p3, max_new_tokens=4)
+            assert streamed1 == r1
+            assert h2.result(timeout=300).tolist() == r2
+            assert h3.result(timeout=300).tolist() == r3
+        finally:
+            eng.shutdown()
+
+    def test_streaming_matches_result(self):
+        m = _tiny_lm()
+        prompt = np.random.RandomState(2).randint(0, 64, (5,))
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=4)
+        try:
+            h = eng.submit(prompt)
+            streamed = list(h.tokens())
+            assert streamed == h.result(timeout=300).tolist()
+            assert len(streamed) == 4
+        finally:
+            eng.shutdown()
+
+    def test_generation_rejection_and_context_limit(self):
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_queue=0, max_new_tokens=4)
+        try:
+            with pytest.raises(QueueFullError):
+                eng.submit(np.array([1, 2, 3]))
+            with pytest.raises(ValueError, match="max_position"):
+                # prompt + max_new over the 64-token context
+                eng.submit(np.arange(60) % 64, max_new_tokens=10)
+            with pytest.raises(ValueError, match="max_new_tokens"):
+                # explicit 0 must reject, not silently become default
+                eng.submit(np.array([1, 2]), max_new_tokens=0)
+        finally:
+            eng.shutdown()
+
+    def test_never_admittable_request_rejected_at_submit(self):
+        # 3 usable pages = 12 tokens: a request needing 5 pages could
+        # never admit — it must fail the caller, not spin the scheduler
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=4, page_size=4, max_batch=2,
+                               max_new_tokens=4)
+        try:
+            with pytest.raises(ValueError, match="NEVER"):
+                eng.submit(np.arange(16) % 64, max_new_tokens=4)
+            # a feasible request still serves
+            h = eng.submit(np.array([1, 2, 3]), max_new_tokens=2)
+            assert len(h.result(timeout=300)) == 2
+        finally:
+            eng.shutdown()
+
+    def test_generation_drain_and_stop(self):
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=3)
+        try:
+            h = eng.submit(np.array([1, 2, 3]))
+            assert eng.drain(timeout=300)
+            assert h.future.done()
+            with pytest.raises(EngineStopped):
+                eng.submit(np.array([1]))
+        finally:
+            eng.shutdown()
+
+    def test_cancelled_generation_is_evicted_mid_stream(self):
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=1,
+                               max_new_tokens=40)
+        try:
+            h = eng.submit(np.array([1, 2, 3]), max_new_tokens=40)
+            next(h.tokens())  # generation live
+            assert h.future.cancel()
+            # the evicted slot frees (max_batch=1): a new request can
+            # only complete because the cancelled one stopped decoding
+            h2 = eng.submit(np.array([4, 5]), max_new_tokens=2)
+            assert len(h2.result(timeout=300)) == 2
+            assert not eng._active
+        finally:
+            eng.shutdown()
+
+    def test_cancelled_while_queued_skips_prefill(self):
+        # a request cancelled before admission must not pay the prefill
+        # (nor reserve pages, nor skew serve.ttft_s)
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=4)
+        try:
+            # holding the engine's cv keeps the scheduler from popping
+            # the queue (RLock: submit from this thread still works)
+            with eng._cv:
+                h = eng.submit(np.array([1, 2, 3]))
+                assert h.future.cancel()
+            assert list(h.tokens()) == []
+            assert eng.drain(timeout=60)
+            ttft = monitor.get_metric("serve.ttft_s")
+            assert ttft is None or ttft.count == 0
+        finally:
+            eng.shutdown()
+
+    def test_generation_retraces_counted_then_stable(self):
+        # the decode program compiles on first use (counted into
+        # serve.retraces via the trace-time hook) and a same-shape
+        # follow-up request adds ZERO new compiles
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=3)
+        try:
+            eng.submit(np.array([5, 9, 4])).result(timeout=300)
+            warm = eng.retraces
+            assert warm >= 1
+            assert monitor.get_metric("serve.retraces").value == warm
+            eng.submit(np.array([8, 1, 2])).result(timeout=300)
+            assert eng.retraces == warm  # steady state: no new compiles
+        finally:
+            eng.shutdown()
+
+    def test_no_wait_shutdown_aborts_active_generation(self):
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=50)
+        h = eng.submit(np.array([1, 2, 3]))
+        it = h.tokens()
+        next(it)  # generation is live
+        eng.shutdown(wait=False)
+        assert not eng._thread.is_alive()  # did NOT decode to 50 tokens
+        with pytest.raises(EngineStopped):
+            h.result(timeout=30)
+
+    def test_admission_reserves_pages_no_mid_decode_oom(self):
+        # pool sized so both requests can NEVER fit at once: 7 usable
+        # pages, each request reserves ceil((3+9)/4)=3 pages -> the
+        # engine serializes them instead of deadlocking mid-decode
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=8, page_size=4, max_batch=4,
+                               max_new_tokens=9)
+        try:
+            rng = np.random.RandomState(3)
+            hs = [eng.submit(rng.randint(0, 64, (3,))) for _ in range(3)]
+            for h in hs:
+                assert len(h.result(timeout=300)) == 9
+        finally:
+            eng.shutdown()
+
+
+# -- the acceptance bar: >= 2x the serial Predictor.run loop ------------
+
+@pytest.mark.heavy
+def test_throughput_2x_vs_serial_predictor_loop(tmp_path):
+    """8 concurrent clients through the shared engine vs the same
+    requests through one Predictor.run at a time. dim=2048 makes a
+    single-row forward memory-bound (two 16 MB weight matrices), so the
+    batched GEMM's one-pass-over-weights advantage dominates 2-CPU
+    scheduling noise. Best-of-3, freshly measured per round (the
+    test_async_pipeline.py container pattern)."""
+    from paddle_tpu.jit import save, InputSpec
+    dim, clients, per_client = 2048, 8, 10
+    n = clients * per_client
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(dim, dim), nn.Tanh(),
+                          nn.Linear(dim, dim))
+    prefix = str(tmp_path / "model")
+    save(model, prefix, input_spec=[InputSpec([None, dim], "float32")])
+    x = np.random.RandomState(0).randn(1, dim).astype(np.float32)
+
+    serial = inference.create_predictor(inference.Config(prefix))
+    serial.run([x])  # compile
+
+    cfg = inference.Config(prefix)
+    cfg.enable_serving(batch_sizes=(1, 2, 4, 8), max_wait_ms=2.0,
+                       max_queue=256)
+    pool = inference.PredictorPool(cfg, size=clients)
+    engine = cfg._engine_for(pool.retrive(0)._layer)
+    warmed = engine.warm(x)
+
+    def engine_round():
+        def client(i):
+            pred = pool.retrive(i)
+            for _ in range(per_client):
+                pred.run([x])
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    try:
+        engine_round()  # execution warmup outside the measured rounds
+        retraces_before = engine.retraces
+        ratios = []
+        for attempt in range(3):
+            # serial baseline RE-MEASURED inside every round: suite-wide
+            # contention drifts, a stale calibration fakes regressions
+            t0 = time.perf_counter()
+            for _ in range(n):
+                serial.run([x])
+            serial_s = time.perf_counter() - t0
+            serve_s = engine_round()
+            ratios.append(serial_s / serve_s)
+            if ratios[-1] >= 2.0:
+                break
+        assert max(ratios) >= 2.0, (
+            f"continuous batching under {clients} clients only "
+            f"{max(ratios):.2f}x the serial Predictor.run loop "
+            f"(rounds: {[round(r, 2) for r in ratios]})")
+        # and the whole run retraced NOTHING after warmup
+        assert engine.retraces == retraces_before == warmed
+    finally:
+        cfg.disable_serving()

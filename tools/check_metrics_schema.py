@@ -22,6 +22,22 @@ Schema (documented in docs/OBSERVABILITY.md):
                   flops        number  per-step FLOPs (XLA cost analysis;
                                        0.0 when unavailable)
                   mfu          number  in [0, ~1]; 0.0 when unknown
+  kind == "serve" (one record per dispatched serving batch —
+                  paddle_tpu/inference/serving.py) additionally requires:
+                  requests     int     requests fused into the batch (>= 1)
+                  batch_size   int     real rows dispatched (>= 1)
+                  bucket_batch int     ladder bucket the batch padded to
+                                       (>= batch_size)
+                  queue_depth  int     requests still waiting at dispatch
+                  pad_tokens   int     padding elements dispatched (>= 0)
+                  latency_s    number  mean submit->result latency of the
+                                       batch's requests (generation
+                                       decode batches: mean in-flight
+                                       request age at the step)
+                  and optionally:
+                  engine       str     emitting engine's name (non-empty;
+                                       the per-engine key that keeps
+                                       multi-engine JSONL attributable)
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
@@ -37,6 +53,9 @@ STEP_REQUIRED = {"step": int, "step_time_s": (int, float),
                  "compile_s": (int, float), "cache_hit": bool,
                  "peak_bytes": int, "flops": (int, float),
                  "mfu": (int, float)}
+SERVE_REQUIRED = {"requests": int, "batch_size": int, "bucket_batch": int,
+                  "queue_depth": int, "pad_tokens": int,
+                  "latency_s": (int, float)}
 
 
 def _check_types(rec, required, where, errors):
@@ -69,6 +88,38 @@ def validate_line(line, where="<line>"):
         if isinstance(rec.get("step"), int) and \
                 not isinstance(rec.get("step"), bool) and rec["step"] < 1:
             errors.append(f"{where}: step must be >= 1, got {rec['step']}")
+    elif rec.get("kind") == "serve":
+        _check_types(rec, SERVE_REQUIRED, where, errors)
+        # engine (the emitting engine's name) is optional for forward
+        # compat, but when present it must be a non-empty string —
+        # it is the only key that keeps multi-engine JSONL attributable
+        if "engine" in rec and (not isinstance(rec["engine"], str)
+                                or not rec["engine"]):
+            errors.append(
+                f"{where}: engine must be a non-empty string, "
+                f"got {rec['engine']!r}")
+
+        def _ok_int(key):
+            v = rec.get(key)
+            return isinstance(v, int) and not isinstance(v, bool)
+
+        for key, lo in (("requests", 1), ("batch_size", 1),
+                        ("pad_tokens", 0), ("queue_depth", 0)):
+            if _ok_int(key) and rec[key] < lo:
+                errors.append(
+                    f"{where}: {key} must be >= {lo}, got {rec[key]}")
+        lat = rec.get("latency_s")
+        if isinstance(lat, (int, float)) and not isinstance(lat, bool) \
+                and lat < 0:
+            errors.append(
+                f"{where}: latency_s must be >= 0, got {lat} (negative "
+                "latency means a clock/accounting bug upstream)")
+        if _ok_int("bucket_batch") and _ok_int("batch_size") and \
+                rec["bucket_batch"] < rec["batch_size"]:
+            errors.append(
+                f"{where}: bucket_batch {rec['bucket_batch']} < "
+                f"batch_size {rec['batch_size']} — the bucket must fit "
+                "the rows it padded")
     return errors
 
 

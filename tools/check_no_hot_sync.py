@@ -16,6 +16,7 @@ Hot regions (file -> function/method names; "*" = whole module):
   paddle_tpu/hapi/model.py                    the fit loop
   paddle_tpu/distributed/fleet/hybrid_train.py  hybrid dispatch paths
   paddle_tpu/io/device_prefetch.py            the whole ring
+  paddle_tpu/inference/serving.py             dispatcher + decode loops
 
 Allowlist: a line ending with a `# hot-sync-ok: <why>` comment is
 exempt — for host-side arithmetic that merely *looks* like a sync
@@ -41,6 +42,17 @@ HOT_REGIONS = {
     "paddle_tpu/distributed/fleet/hybrid_train.py": [
         "HybridTrainStep.__call__", "HybridTrainStep._prep"],
     "paddle_tpu/io/device_prefetch.py": ["*"],
+    # the serving engine's scheduler core: the only legitimate blocks
+    # are the queue wait and the ONE device read per dispatched batch /
+    # decode step (marked hot-sync-ok at the sampling / result-slicing
+    # sync points)
+    "paddle_tpu/inference/serving.py": [
+        "_run_scheduler",
+        "InferenceEngine._take_batch", "InferenceEngine._scan_matching",
+        "InferenceEngine._loop_once", "InferenceEngine._dispatch_batch",
+        "InferenceEngine._resolve_batch",
+        "GenerationEngine._loop_once", "GenerationEngine._admit",
+        "GenerationEngine._decode_step", "GenerationEngine._emit"],
 }
 
 PATTERNS = [
@@ -48,6 +60,9 @@ PATTERNS = [
     (re.compile(r"(?<![\w.])float\s*\("), "float()"),
     (re.compile(r"\.numpy\s*\("), ".numpy()"),
     (re.compile(r"block_until_ready"), "block_until_ready"),
+    # np.asarray of a device array is a blocking D2H read — the serving
+    # dispatcher idiom (jnp.asarray stays device-side and is NOT matched)
+    (re.compile(r"(?<![\w.])np\.asarray\s*\("), "np.asarray()"),
 ]
 
 ALLOW_MARKER = "hot-sync-ok"
