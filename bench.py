@@ -41,8 +41,10 @@ Robustness contract (round-6; round-5 history in git):
     "Hiding the host").
 """
 import json
+import math
 import os
 import sys
+import tempfile
 import time
 import traceback
 
@@ -216,7 +218,10 @@ def _run():
         return nn.functional.cross_entropy(
             logits.reshape([-1, V]), labels.reshape([-1]))
 
-    step = TrainStep(model, loss_fn, o)
+    # monitor_health: the in-graph health vector (grad norm / update
+    # ratio) rides the compiled step on the async path — the headline
+    # carries the final values, and an anomalous run says so itself
+    step = TrainStep(model, loss_fn, o, monitor_health=True)
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
@@ -272,6 +277,17 @@ def _run():
     tokens_per_sec = batch * seq * iters / dt
     loss_val = round(float(loss.item()), 4)
 
+    # training-health tail + unified Perfetto trace (ring snapshot —
+    # milliseconds; both before the headline print so they ride in it)
+    health = step.flush_health() or {}
+    anomalies = step.anomalies.drain() if step.anomalies else []
+    try:
+        from paddle_tpu.profiler import trace_export
+        trace_file = trace_export.write_chrome_trace(os.path.join(
+            tempfile.gettempdir(), "paddle_tpu_bench_trace.json"))
+    except Exception as e:  # telemetry never costs the record
+        trace_file = f"unavailable: {type(e).__name__}"
+
     # ---- the headline is now measured: print it IMMEDIATELY (the parent
     # tees this line straight through, so any later kill cannot lose it)
     peak = _peak_flops(jax) if on_tpu else 197e12
@@ -321,6 +337,17 @@ def _run():
         "flops_per_step": flops_per_step,
         "mfu_cost_analysis": round(
             flops_per_step * iters / dt / peak, 4) if on_tpu else 0.0,
+        # in-graph health observatory (monitor_health=True): final grad
+        # norm / update ratio, plus how many anomaly events the host
+        # detectors emitted over the run (0 = numerically clean)
+        "health": {k: (round(v, 6) if isinstance(v, float)
+                       and math.isfinite(v) else repr(v))
+                   for k, v in health.items()
+                   if k in ("grad_norm", "update_ratio", "found_inf")},
+        "anomaly_events": len(anomalies),
+        # unified Chrome-trace export (open in Perfetto; merge per-rank
+        # files with tools/merge_traces.py)
+        "trace_file": trace_file,
         "phases": dict(_PHASES),
     }
     print(json.dumps(headline), flush=True)
@@ -624,8 +651,15 @@ def _stream_child(extra_env, budget):
         proc.wait(timeout=budget)
         rc = proc.returncode
     except subprocess.TimeoutExpired:
-        proc.kill()
-        proc.wait()
+        # SIGTERM first: the child's flight recorder dumps a debug
+        # bundle (ring tail + thread stacks — WHERE it hung) on the way
+        # down; SIGKILL only if it wedged too hard even for that
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
         rc = "timeout"
     t_out.join(timeout=5)
     t_err.join(timeout=5)
@@ -666,6 +700,15 @@ def main():
             _run()
         except Exception as e:
             tb = traceback.format_exc()
+            # flight-recorder debug bundle: ring tail + HLO of every
+            # compiled train step + all-thread stacks — the evidence a
+            # 0.0 headline needs (requires paddle_tpu to have imported)
+            bundle = None
+            try:
+                from paddle_tpu.profiler import flight_recorder as _fr
+                bundle = _fr.dump("bench_failure", exc=e)
+            except Exception:
+                pass
             print(json.dumps({
                 "metric": "gpt_medium_train_tokens_per_sec_per_chip",
                 "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
@@ -673,9 +716,18 @@ def main():
                 # how far the attempt got and what each phase cost — the
                 # diagnosis BENCH_r05's bare 0.0 lacked
                 "phases": dict(_PHASES),
+                "debug_bundle": bundle,
                 "traceback_tail": tb[-800:]}), flush=True)
             raise SystemExit(1)
         return
+
+    # crash/hang debuggability for the child attempts: give them a dump
+    # dir (unless the operator already points one elsewhere), so a
+    # failed/timed-out attempt leaves a flight-recorder bundle — the
+    # child dumps on its own exceptions; a timeout kill's SIGTERM
+    # triggers the flight recorder's signal dump
+    os.environ.setdefault("PADDLE_TPU_DEBUG_DUMP", os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_bench_debug"))
 
     t_start = time.perf_counter()
     total_budget = int(os.environ.get("BENCH_TOTAL_BUDGET", "480"))
@@ -748,7 +800,11 @@ def main():
                 best = result
         else:
             fail = {"attempt": tag, "rc": rc, "budget_s": round(budget),
-                    "evidence": _evidence(json_lines, err_tail)}
+                    "evidence": _evidence(json_lines, err_tail),
+                    # where this attempt's flight-recorder bundle (ring
+                    # tail, HLO, thread stacks) landed — if it got far
+                    # enough to write one
+                    "debug_bundle": os.environ["PADDLE_TPU_DEBUG_DUMP"]}
             # phase breakdown even for a timed-out child (streamed over
             # stderr) or a crashed one (embedded in its diagnostic JSON)
             diag = _last_json(json_lines, lambda c: "phases" in c)

@@ -24,11 +24,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...framework.core import Tensor, no_grad, _Slot
 from ...framework.random import split_key
 from ...jit.api import (functional_call, state_arrays, aot_compile,
-                        count_train_use, export_step_metrics)
+                        count_train_use, export_step_metrics,
+                        HealthMonitorMixin)
 from ...jit.deferred import DeferredLoss
 from ...profiler import statistic as _stat
 from ...profiler import monitor as _monitor
 from ...profiler import cost as _cost
+from ...profiler import flight_recorder as _flight
 
 __all__ = ["HybridTrainStep", "default_param_rules"]
 
@@ -84,12 +86,12 @@ def _zero_spec(pspec, mesh, arr):
     return pspec
 
 
-class HybridTrainStep:
+class HybridTrainStep(HealthMonitorMixin):
     """Build once, call per batch. See module docstring."""
 
     def __init__(self, model, loss_fn, optimizer, mesh, recompute=False,
                  accumulate_steps=1, donate=True, param_dtype=None,
-                 sharding_stage=1, scaler=None):
+                 sharding_stage=1, scaler=None, monitor_health=False):
         """sharding_stage selects the ZeRO behavior over the 'sharding'
         mesh axis (ref sharding/sharding_stage2.py:43, sharding_stage3.py:51):
           1 — optimizer state sharded (grads allreduced, params replicated)
@@ -100,7 +102,11 @@ class HybridTrainStep:
               all-gather back to their param specs
           3 — + parameters THEMSELVES stored sharded; XLA all-gathers
               weights at use sites and frees them after use
-        """
+
+        monitor_health=True appends the training-health vector (global
+        grad norm, param norm, update ratio — jit/api.py
+        HealthMonitorMixin) to the compiled SPMD program, replicated
+        over the mesh, resolved on the async is_ready-gated path."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -119,6 +125,7 @@ class HybridTrainStep:
         self.retraces = 0
         self.compile_s = 0.0
         self.last_compile_s = None
+        self._init_health(monitor_health)
 
         params, buffers = state_arrays(model)
         if param_dtype is not None:
@@ -187,6 +194,7 @@ class HybridTrainStep:
             return run(micro)
 
         scaler_ref = scaler
+        mon_health = self.monitor_health
 
         def step_fn(params_, opt_state_, scaler_state_, bufs, key, lr,
                     step_i, *batch):
@@ -218,6 +226,10 @@ class HybridTrainStep:
                 loss, grads = jax.value_and_grad(
                     lambda ps: objective(ps, batch))(params_)
 
+            # the health vector norms the RAW (possibly scale-multiplied)
+            # grads — _health_vec unscales by division, so a non-finite
+            # gradient stays visible as a non-finite grad_norm
+            raw_grads = grads if mon_health else None
             if scaling:
                 loss = loss / scale
                 grads, found_inf, new_scaler_state = \
@@ -239,6 +251,11 @@ class HybridTrainStep:
             new_params, new_state = opt.apply_gradients_tree(
                 params_, grads, opt_state_, lr, step_i,
                 found_inf=found_inf)
+            if mon_health:
+                health = self._health_vec(loss, raw_grads, scaler_state_,
+                                          params_, new_params)
+                return loss, health, new_params, new_state, \
+                    new_scaler_state
             return loss, new_params, new_state, new_scaler_state
 
         # mirror each state leaf's structure (tuple, or the
@@ -252,11 +269,15 @@ class HybridTrainStep:
             for k in self.opt_state}
         scaler_shardings = jax.tree.map(
             lambda _: NamedSharding(mesh, P()), self.scaler_state)
+        out_shardings = (loss_sharding, self.param_shardings,
+                         state_shardings, scaler_shardings)
+        if mon_health:  # health vector rides replicated, like the loss
+            out_shardings = (loss_sharding, NamedSharding(mesh, P()),
+                             *out_shardings[1:])
         self._jitted = jax.jit(
             step_fn,
             donate_argnums=(0, 1, 2) if donate else (),
-            out_shardings=(loss_sharding, self.param_shardings,
-                           state_shardings, scaler_shardings))
+            out_shardings=out_shardings)
         # AOT executables keyed by batch signature (jit.api.aot_compile):
         # trace/compile phases timed, persistent-cache hit observed,
         # cost_analysis free
@@ -294,16 +315,48 @@ class HybridTrainStep:
     def __call__(self, *batch):
         self._step_i += 1
         sig, args = self._prep(batch, self._step_i)
+        _flight.heartbeat(self._step_i)  # watchdog liveness pulse
         _stat.begin_span("fleet.hybrid_step")
         try:
             entry = self._exec.get(sig)
             compiled_now = entry is None
             if compiled_now:
-                entry = self._exec[sig] = aot_compile(self._jitted, args)
+                entry = self._exec[sig] = aot_compile(
+                    self._jitted, args, tag="fleet.hybrid_step")
             compiled, info = entry
             count_train_use(self, info)
-            loss, self.params, self.opt_state, self.scaler_state = \
-                compiled(*args)
+            try:
+                out = compiled(*args)
+            except (FloatingPointError, RuntimeError) as e:
+                # jax_debug_nans found a non-finite value: flight-record
+                # and write a debug bundle before re-raising (same
+                # contract as TrainStep._dispatch, incl. the donated-
+                # buffer re-run surfacing as a deleted-array error)
+                donated_rerun = (
+                    isinstance(e, RuntimeError)
+                    and jax.config.jax_debug_nans
+                    and "deleted" in str(e))
+                if isinstance(e, RuntimeError) and not donated_rerun:
+                    raise
+                _flight.record_event("nan_detected",
+                                     where="fleet.hybrid_step",
+                                     step=int(self._step_i),
+                                     error=str(e)[:300])
+                _flight.dump("nan", exc=e)
+                if donated_rerun:
+                    raise FloatingPointError(
+                        "jax_debug_nans detected a non-finite value in "
+                        "the compiled fleet.hybrid_step program (the "
+                        "op-level re-run could not localize it because "
+                        "the step donates its buffers; build with "
+                        "donate=False to localize)") from e
+                raise
+            if self.monitor_health:
+                loss, health, self.params, self.opt_state, \
+                    self.scaler_state = out
+                self._queue_health(self._step_i, health)
+            else:
+                loss, self.params, self.opt_state, self.scaler_state = out
         finally:
             dispatch_s = _stat.end_span()
         export_step_metrics(self, dispatch_s, info, compiled_now)
@@ -325,7 +378,8 @@ class HybridTrainStep:
         sig, args = self._prep(batch, self._step_i + 1)
         entry = self._exec.get(sig)
         if entry is None:
-            entry = self._exec[sig] = aot_compile(self._jitted, args)
+            entry = self._exec[sig] = aot_compile(
+                self._jitted, args, tag="fleet.hybrid_step")
         return entry[0]
 
     def sync_to_model(self):

@@ -133,6 +133,15 @@ def _rank_env(args, coordinator, local_rank, restart_count):
     if args.devices is not None:
         env["PADDLE_VISIBLE_DEVICES"] = _rank_devices(
             args.devices, args.nproc_per_node, local_rank)
+    # hang/crash debuggability (profiler/flight_recorder.py): every
+    # worker arms a SIGQUIT faulthandler stack dump (`kill -QUIT <pid>`
+    # prints all-thread stacks to the rank's workerlog without dying),
+    # and an operator-set PADDLE_TPU_DEBUG_DUMP fans out to a per-rank
+    # subdirectory so concurrent crash bundles never clobber each other
+    env.setdefault("PADDLE_TPU_SIGQUIT_STACKS", "1")
+    if env.get("PADDLE_TPU_DEBUG_DUMP"):
+        env["PADDLE_TPU_DEBUG_DUMP"] = os.path.join(
+            env["PADDLE_TPU_DEBUG_DUMP"], f"rank{rank}")
     return env
 
 

@@ -3,8 +3,12 @@
 
 TPU-native: eager mode checks each op output on the host; under jit use
 enable_jit_nan_checks() which flips jax's debug_nans (XLA-level check that
-re-runs the failing computation op-by-op to localize the NaN).
+re-runs the failing computation op-by-op to localize the NaN). Both paths
+flight-record a structured `nan_detected` event before raising (the
+profiler/flight_recorder.py ring + kind:"event" JSONL), so the failure is
+on the timeline and in the crash bundle, not just in a traceback.
 """
+import functools
 import os
 
 import numpy as np
@@ -26,13 +30,56 @@ def nan_check_enabled():
     return _nan_check_enabled[0]
 
 
-def check_numerics(arr, op_name="op"):
+def _record_nan_event(op_name, n_nan, n_inf, where):
+    """One structured anomaly into the flight-recorder ring (+ metrics
+    JSONL when configured). Never raises — it runs inside jax host
+    callbacks and right before user-visible exceptions."""
+    try:
+        from ..profiler import flight_recorder
+        flight_recorder.record_event("nan_detected", op=str(op_name),
+                                     n_nan=int(n_nan), n_inf=int(n_inf),
+                                     where=where)
+    except Exception:
+        pass
+
+
+def _jit_nan_tag(op_name, n_nan, n_inf):
+    """Host side of the traced check_numerics tagging path
+    (jax.debug.callback target): flight-record the hit, then raise — jax
+    surfaces the FloatingPointError at the next synchronization point
+    (or logs it, backend-dependent); either way the EVENT is durable."""
+    n_nan, n_inf = int(n_nan), int(n_inf)
+    if not (n_nan or n_inf):
+        return
+    _record_nan_event(op_name, n_nan, n_inf, "jit")
+    raise FloatingPointError(
+        f"NaN/Inf detected in traced output of '{op_name}': "
+        f"{n_nan} NaNs, {n_inf} Infs")
+
+
+def check_numerics(arr, op_name="op", jit_check=None):
+    """Raise FloatingPointError when `arr` holds NaN/Inf (eager), and
+    flight-record the detection first.
+
+    Under tracing the check used to silently no-op; now a traced array
+    routes through a `jax.debug.callback` tagging path: the non-finite
+    COUNTS are computed in-graph (two reductions — the array itself
+    never crosses to the host) and the callback records the anomaly
+    event / raises when they are non-zero. The path is armed by
+    `jit_check=True`, or by default when FLAGS_check_nan_inf /
+    set_nan_inf_check is on; otherwise tracing stays zero-cost."""
     if isinstance(arr, jax.core.Tracer):
+        armed = nan_check_enabled() if jit_check is None else jit_check
+        if armed and jnp.issubdtype(arr.dtype, jnp.floating):
+            jax.debug.callback(
+                functools.partial(_jit_nan_tag, op_name),
+                jnp.sum(jnp.isnan(arr)), jnp.sum(jnp.isinf(arr)))
         return arr
     if jnp.issubdtype(arr.dtype, jnp.floating) and \
             bool(jnp.any(~jnp.isfinite(arr))):
         n_nan = int(jnp.sum(jnp.isnan(arr)))
         n_inf = int(jnp.sum(jnp.isinf(arr)))
+        _record_nan_event(op_name, n_nan, n_inf, "eager")
         raise FloatingPointError(
             f"NaN/Inf detected in output of '{op_name}': "
             f"{n_nan} NaNs, {n_inf} Infs, shape {arr.shape}")
@@ -40,6 +87,11 @@ def check_numerics(arr, op_name="op"):
 
 
 def enable_jit_nan_checks(enabled=True):
+    """Flip jax_debug_nans: compiled programs re-run op-by-op on a NaN
+    and raise FloatingPointError at dispatch. The train-step dispatch
+    paths (jit/api.py, fleet/hybrid_train.py) catch that error,
+    flight-record a `nan_detected` event, and write a debug bundle
+    (PADDLE_TPU_DEBUG_DUMP) before re-raising."""
     jax.config.update("jax_debug_nans", bool(enabled))
 
 

@@ -112,6 +112,14 @@ class ProgBarLogger(Callback):
         self._tb = now
         _monitor.histogram("hapi.step_s").observe(dt)
         self.steps += 1
+        if self.verbose:
+            # training-health anomalies (Model.prepare(monitor_health=
+            # True)): rare, so always worth a line when they fire
+            for ev in (logs or {}).get("anomalies", ()):
+                detail = {k: v for k, v in ev.items()
+                          if k not in ("event", "step")}
+                print(f"[health] step {ev.get('step', step)}: "
+                      f"{ev.get('event')} {detail}")
         if self.verbose and step % self.log_freq == 0:
             loss = logs.get("loss")
             # float() resolves a deferred loss handle — log_freq

@@ -63,13 +63,23 @@ class Model:
         self._loss = None
         self._metrics = []
         self._train_step = None
+        self._monitor_health = False
         self.stop_training = False
 
     # -- setup ---------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, monitor_health=False):
+        """monitor_health=True: the jitted train step computes the
+        training-health scalars (global grad norm, param norm, update
+        ratio) inside the compiled program (jit/api.py
+        HealthMonitorMixin) and the fit loop surfaces anomaly events
+        (loss spike, grad-norm spike, found_inf streak, retrace storm)
+        in callback `logs["anomalies"]` per batch and the resolved
+        health dict in `logs["health"]` at epoch end — zero new host
+        syncs on the hot path."""
         self._optimizer = optimizer
         self._loss = loss
+        self._monitor_health = bool(monitor_health)
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
@@ -83,8 +93,9 @@ class Model:
     def _ensure_train_step(self):
         if self._train_step is None:
             from ..jit import TrainStep
-            self._train_step = TrainStep(self.network, self._loss_fn,
-                                         self._optimizer)
+            self._train_step = TrainStep(
+                self.network, self._loss_fn, self._optimizer,
+                monitor_health=self._monitor_health)
 
     # -- steps ---------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
@@ -224,6 +235,12 @@ class Model:
                 cbks.on_batch_begin("train", step, logs)
                 loss = self._dispatch_micro(group)
                 logs = {"loss": [loss], "step": step}
+                # anomaly events from health vectors that have LANDED by
+                # now (is_ready-gated — draining them is host-only work,
+                # never a device read)
+                det = getattr(self._train_step, "anomalies", None)
+                if det is not None and det.events:
+                    logs["anomalies"] = det.drain()
                 cbks.on_batch_end("train", step, logs)
                 step += 1
                 steps_done += 1
@@ -266,6 +283,16 @@ class Model:
                 micro = []
             if "loss" in logs:  # epoch boundary: the deliberate sync
                 logs["loss"] = _resolve_scalars(logs["loss"])
+            if getattr(self._train_step, "monitor_health", False):
+                # epoch boundary: blocking drain of the pending health
+                # vectors; detectors observe the tail before on_epoch_end
+                health = self._train_step.flush_health()
+                if health:
+                    logs["health"] = health
+                det = self._train_step.anomalies
+                if det is not None and det.events:
+                    logs["anomalies"] = (logs.get("anomalies") or []) + \
+                        det.drain()
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 # evaluate() drops the train step to free its device
                 # state — release the loader's reference too, or the

@@ -304,6 +304,14 @@ class _SchedulerLifecycle:
         cause chained — a silent thread death would hang callers
         forever — and refuse new submits."""
         _monitor.counter("serve.errors").inc()
+        # on the flight-recorder timeline + crash bundle: a dead engine
+        # mid-traffic is exactly the state the ring is for
+        from ..profiler import flight_recorder as _flight
+        _flight.record_event("serve_scheduler_crashed",
+                             engine=getattr(self, "name", "serve"),
+                             type=type(exc).__name__,
+                             message=str(exc)[:300])
+        _flight.dump("serve_crash", exc=exc)
         err = ServingError(
             "scheduler thread crashed; this engine is dead — rebuild it")
         err.__cause__ = exc
@@ -468,7 +476,11 @@ class InferenceEngine(_SchedulerLifecycle):
             entry = self._exec.get(sig)
             if entry is not None:
                 return entry, False
-            entry = aot_compile(self._jitted, tuple(specs))
+            # tag: debug bundles dump this bucket's HLO + cost analysis
+            # (flight recorder executable registry)
+            bucket = specs[0].shape[0] if specs else 0
+            entry = aot_compile(self._jitted, tuple(specs),
+                                tag=f"serve.{self.name}.batch{bucket}")
             self._exec[sig] = entry
             self.retraces += 1
             _monitor.counter("serve.retraces").inc()

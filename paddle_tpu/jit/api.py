@@ -12,6 +12,7 @@ binds traced arrays into the layer's Parameters so the ordinary eager
 forward runs under trace, with the tape disabled (jax.grad provides
 differentiation on this path).
 """
+import collections
 import functools
 import time
 
@@ -24,14 +25,15 @@ from ..framework.random import rng_scope, split_key
 from ..profiler import statistic as _stat
 from ..profiler import monitor as _monitor
 from ..profiler import cost as _cost
+from ..profiler import flight_recorder as _flight
 from .deferred import DeferredLoss
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
            "aot_compile", "count_train_use", "export_step_metrics",
-           "DeferredLoss"]
+           "DeferredLoss", "HealthMonitorMixin"]
 
 
-def aot_compile(jitted, args):
+def aot_compile(jitted, args, tag=None):
     """Explicitly lower + compile a jax.jit function for `args` — the
     AOT dispatch path TrainStep/HybridTrainStep use instead of jax.jit's
     implicit first-call compile. This is the telemetry keystone: the
@@ -40,6 +42,10 @@ def aot_compile(jitted, args):
     (framework/compile_cache.py) hit/miss is observed (hit = compile
     added no new on-disk entry), and the returned executable exposes
     cost_analysis() for free — no re-lower, no re-compile.
+
+    `tag` names the executable in the flight recorder's registry, so a
+    crash/hang debug bundle (profiler/flight_recorder.py) carries its
+    HLO text + cost analysis.
 
     Returns (compiled, info) where info carries lower_s / compile_s /
     cache_hit / flops / bytes. The global jit.* metrics count every
@@ -73,6 +79,8 @@ def aot_compile(jitted, args):
             "cache_hit": cache_hit,
             "flops": float(ca.get("flops", 0.0)),
             "bytes": float(ca.get("bytes accessed", 0.0))}
+    if tag:  # debug bundles dump this executable's HLO + cost analysis
+        _flight.register_executable(tag, compiled)
     return compiled, info
 
 
@@ -120,8 +128,8 @@ def export_step_metrics(step, dispatch_s, info, compiled_now):
     _monitor.gauge("train.bytes_per_step").set(
         float(info.get("bytes", 0.0)))
     _monitor.gauge("train.mfu").set(m)
-    if not _monitor.metrics_file():
-        return
+    # export_step always runs: file or no file, the record lands in the
+    # flight-recorder ring so a debug bundle carries the step tail
     from .. import device as _device
     _monitor.export_step({
         "step": int(step._step_i),
@@ -381,7 +389,106 @@ def to_static(layer_or_function=None, input_spec=None, build_strategy=None,
     return wrap(layer_or_function)
 
 
-class TrainStep:
+class HealthMonitorMixin:
+    """Host half of the in-graph training-health observatory, shared by
+    TrainStep and HybridTrainStep (`monitor_health=True`).
+
+    The in-graph half appends `_health_vec` — ONE tiny f32 vector of
+    [loss, grad_norm, param_norm, update_ratio, found_inf] — to the
+    already-compiled step. The host half here starts an async D2H copy
+    at dispatch and folds vectors into the detectors only once they have
+    LANDED (is_ready-gated): zero new host syncs on the hot path.
+    `flush_health()` is the blocking drain (epoch end, tests)."""
+
+    def _init_health(self, monitor_health):
+        self.monitor_health = bool(monitor_health)
+        self._health_pending = collections.deque()
+        self.last_health = None
+        if self.monitor_health:
+            from ..profiler.health import AnomalyDetector
+            self.anomalies = AnomalyDetector()
+        else:
+            self.anomalies = None
+
+    def _health_vec(self, loss, grads, scaler_state, params, new_params):
+        """[loss, grad_norm, param_norm, update_ratio, found_inf] as ONE
+        f32 device vector, computed under the trace (monitor_health=True
+        appends this to the compiled step). `grads` are the raw
+        (possibly scale-multiplied) gradients from value_and_grad; the
+        norm is unscaled by division, so a non-finite gradient shows up
+        as a non-finite grad_norm — which is also the found_inf signal
+        (no second tree traversal)."""
+        def sumsq(tree):
+            leaves = [jnp.sum(jnp.square(l.astype(jnp.float32)))
+                      for l in jax.tree.leaves(tree)]
+            total = leaves[0] if leaves else jnp.zeros((), jnp.float32)
+            for l in leaves[1:]:
+                total = total + l
+            return total
+
+        grad_norm = jnp.sqrt(sumsq(grads))
+        found_inf = (~jnp.isfinite(grad_norm)).astype(jnp.float32)
+        if self.scaler is not None and self.scaler.is_enable():
+            grad_norm = grad_norm / scaler_state["scale"]
+        param_norm = jnp.sqrt(sumsq(new_params))
+        delta = jax.tree.map(
+            lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
+            new_params, params)
+        update_ratio = jnp.sqrt(sumsq(delta)) / jnp.maximum(param_norm,
+                                                            1e-12)
+        return jnp.stack([loss.astype(jnp.float32).reshape(()), grad_norm,
+                          param_norm, update_ratio, found_inf])
+
+    def _queue_health(self, step_i, vec):
+        """Start the async D2H copy of one step's health vector, then
+        fold any vectors that have ALREADY landed into the detectors.
+        Never blocks the step loop — resolution is is_ready-gated;
+        `flush_health()` is the blocking drain."""
+        try:
+            vec.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # non-jax array or backend without async copy
+        self._health_pending.append((step_i, vec))
+        self._drain_health(block=False)
+
+    def _drain_health(self, block):
+        while self._health_pending:
+            step_i, vec = self._health_pending[0]
+            if not block:
+                ready = getattr(vec, "is_ready", None)
+                if ready is not None and not ready():
+                    return  # still computing/copying: check next step
+            self._health_pending.popleft()
+            self._observe_health(step_i, vec)
+
+    def _observe_health(self, step_i, vec):
+        vals = [float(v) for v in np.asarray(vec)]  # hot-sync-ok: vector already landed (is_ready-gated or explicit flush)
+        h = dict(zip(("loss", "grad_norm", "param_norm", "update_ratio",
+                      "found_inf"), vals))
+        self.last_health = {"step": int(step_i), **h}
+        _monitor.gauge("health.grad_norm").set(h["grad_norm"])
+        _monitor.gauge("health.update_ratio").set(h["update_ratio"])
+        # JSONL strictness: a bare NaN token is not valid JSON — export
+        # non-finite values as their repr strings (the anomaly event
+        # carries the signal; tools/check_metrics_schema.py accepts both)
+        import math as _math
+        rec = {k: (v if _math.isfinite(v) else repr(v))
+               for k, v in h.items()}
+        rec["step"] = int(step_i)
+        _monitor.export_step(rec, kind="health")
+        if self.anomalies is not None:
+            self.anomalies.observe(step_i, h, retraces=self.retraces)
+
+    def flush_health(self):
+        """Blocking drain of the pending health vectors (epoch end,
+        shutdown, tests). Returns the most recent resolved health dict
+        (`{"step", "loss", "grad_norm", "param_norm", "update_ratio",
+        "found_inf"}`) or None when monitor_health is off / no step ran."""
+        self._drain_health(block=True)
+        return self.last_health
+
+
+class TrainStep(HealthMonitorMixin):
     """One fully-jitted training step: forward + loss + grads + optimizer.
 
     The TPU-native analogue of the reference's whole-program executor path:
@@ -411,7 +518,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
                  in_shardings=None, donate=True, model_returns_loss=False,
-                 scaler=None):
+                 scaler=None, monitor_health=False):
         """model_returns_loss=True: the model's forward(*batch) IS the
         scalar loss (e.g. GPTForCausalLM.fused_loss via a wrapper) —
         loss_fn is ignored. Lets memory-fused loss formulations (chunked
@@ -419,7 +526,19 @@ class TrainStep:
 
         scaler: an amp.GradScaler whose dynamic loss scaling runs INSIDE
         the compiled step (scaled loss, unscale, found_inf update skip,
-        scale adaptation) with its state donated alongside params."""
+        scale adaptation) with its state donated alongside params.
+
+        monitor_health=True: the compiled step additionally computes the
+        training-health scalars — loss, global grad norm, param norm,
+        update ratio, found_inf — INSIDE the already-fused XLA program
+        (a handful of reductions next to terms XLA already computes) and
+        returns them as one tiny f32 vector on the DeferredLoss-style
+        async path: the host starts a D2H copy at dispatch and folds the
+        vector into `self.anomalies` (profiler/health.AnomalyDetector)
+        only once it has LANDED (is_ready-gated — zero new host syncs on
+        the hot path; `flush_health()` is the blocking drain). Each
+        resolved step also exports a `kind:"health"` metrics record.
+        Donation and GradScaler semantics are unchanged."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -441,6 +560,7 @@ class TrainStep:
         self.retraces = 0
         self.compile_s = 0.0
         self.last_compile_s = None
+        self._init_health(monitor_health)
 
         def step_fn(params, opt_state, scaler_state, buffers, key, lr,
                     step_i, *batch):
@@ -450,10 +570,25 @@ class TrainStep:
             return self._finish(loss, grads, params, opt_state,
                                 scaler_state, lr, step_i)
 
+        def step_fn_health(params, opt_state, scaler_state, buffers, key,
+                           lr, step_i, *batch):
+            loss, grads = jax.value_and_grad(
+                lambda ps: self._objective(ps, scaler_state, buffers, key,
+                                           batch))(params)
+            out_loss, new_params, new_state, new_scaler = self._finish(
+                loss, grads, params, opt_state, scaler_state, lr, step_i)
+            health = self._health_vec(out_loss, grads, scaler_state,
+                                      params, new_params)
+            return out_loss, health, new_params, new_state, new_scaler
+
         donate_argnums = (0, 1, 2) if donate else ()
         self._donate = donate
+        # the plain flavor stays: run_steps scans it (the scanned path
+        # keeps the 4-tuple carry; health rides the per-step programs)
         self._step_fn = step_fn
-        self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+        self._jitted = jax.jit(
+            step_fn_health if self.monitor_health else step_fn,
+            donate_argnums=donate_argnums)
         # AOT executables keyed by batch signature (aot_compile): phases
         # timed, persistent-cache hit observed, cost_analysis free
         self._exec = {}
@@ -513,6 +648,7 @@ class TrainStep:
         executable-cache lookup with optional LRU bound, AOT compile on
         miss, retrace accounting, timed dispatch. Returns
         (outputs, info, compiled_now, dispatch_s)."""
+        _flight.heartbeat(self._step_i)  # watchdog liveness pulse
         _stat.begin_span(span)
         try:
             entry = cache.get(sig)
@@ -520,12 +656,41 @@ class TrainStep:
             if compiled_now:
                 if max_entries and len(cache) >= max_entries:
                     cache.pop(next(iter(cache)))  # bound compile growth
-                entry = cache[sig] = aot_compile(make_jitted(), args)
+                entry = cache[sig] = aot_compile(make_jitted(), args,
+                                                 tag=span)
             else:  # LRU: re-insert so cycling signatures don't thrash
                 cache[sig] = cache.pop(sig)
             compiled, info = entry
             count_train_use(self, info)
-            out = compiled(*args)
+            try:
+                out = compiled(*args)
+            except (FloatingPointError, RuntimeError) as e:
+                # jax_debug_nans (framework.debug.enable_jit_nan_checks)
+                # found a non-finite value: flight-record it and write a
+                # debug bundle (ring tail + this executable's HLO +
+                # all-thread stacks) before re-raising to the caller.
+                # With donated buffers the op-level re-run cannot replay
+                # (inputs already consumed) and surfaces as a
+                # RuntimeError over deleted arrays — same detection,
+                # reported as the FloatingPointError it is.
+                donated_rerun = (
+                    isinstance(e, RuntimeError)
+                    and jax.config.jax_debug_nans
+                    and "deleted" in str(e))
+                if isinstance(e, RuntimeError) and not donated_rerun:
+                    raise
+                _flight.record_event("nan_detected", where=span,
+                                     step=int(self._step_i),
+                                     error=str(e)[:300])
+                _flight.dump("nan", exc=e)
+                if donated_rerun:
+                    raise FloatingPointError(
+                        "jax_debug_nans detected a non-finite value in "
+                        f"the compiled {span} program (the op-level "
+                        "re-run could not localize it because the step "
+                        "donates its buffers; build with donate=False "
+                        "to localize)") from e
+                raise
         finally:
             dispatch_s = _stat.end_span()
         return out, info, compiled_now, dispatch_s
@@ -637,8 +802,13 @@ class TrainStep:
             # batch (equal microbatch sizes)
             loss = loss_sum / k
             grads = jax.tree.map(lambda g: g / k, grads)
-            return self._finish(loss, grads, params, opt_state,
-                                scaler_state, lr, step_i)
+            out_loss, new_params, new_state, new_scaler = self._finish(
+                loss, grads, params, opt_state, scaler_state, lr, step_i)
+            if self.monitor_health:
+                health = self._health_vec(out_loss, grads, scaler_state,
+                                          params, new_params)
+                return out_loss, health, new_params, new_state, new_scaler
+            return out_loss, new_params, new_state, new_scaler
         return acc_fn
 
     def accumulate(self, k, *batch):
@@ -674,7 +844,12 @@ class TrainStep:
         out, info, compiled_now, dispatch_s = self._dispatch(
             self._acc_jit, sig, make_jitted, args, "train.accumulate",
             max_entries=8)
-        loss, self.params, self.opt_state, self.scaler_state = out
+        if self.monitor_health:
+            loss, health, self.params, self.opt_state, \
+                self.scaler_state = out
+            self._queue_health(self._step_i, health)
+        else:
+            loss, self.params, self.opt_state, self.scaler_state = out
         export_step_metrics(self, dispatch_s, info, compiled_now)
         return DeferredLoss(loss)
 
@@ -705,7 +880,12 @@ class TrainStep:
         sig, args = self._prep(batch, self._step_i)
         out, info, compiled_now, dispatch_s = self._dispatch(
             self._exec, sig, lambda: self._jitted, args, "train.step")
-        loss, self.params, self.opt_state, self.scaler_state = out
+        if self.monitor_health:
+            loss, health, self.params, self.opt_state, \
+                self.scaler_state = out
+            self._queue_health(self._step_i, health)
+        else:
+            loss, self.params, self.opt_state, self.scaler_state = out
         export_step_metrics(self, dispatch_s, info, compiled_now)
         # non-blocking handle: dispatch has already returned; the host
         # copy streams in the background and resolves on first read
@@ -727,7 +907,8 @@ class TrainStep:
         sig, args = self._prep(batch, self._step_i + 1)
         entry = self._exec.get(sig)
         if entry is None:
-            entry = self._exec[sig] = aot_compile(self._jitted, args)
+            entry = self._exec[sig] = aot_compile(self._jitted, args,
+                                                  tag="train.step")
         return entry[0]
 
     def sync_to_model(self):

@@ -20,15 +20,25 @@ import time
 
 import jax
 
+from . import flight_recorder
 from . import statistic
 from . import monitor
 from . import cost
+from . import trace_export
+from . import health
 from .statistic import SortedKeys
+from .health import AnomalyDetector
+
+# arm the crash/hang debug-bundle triggers when the operator asked via
+# env (PADDLE_TPU_DEBUG_DUMP / PADDLE_TPU_WATCHDOG_S /
+# PADDLE_TPU_SIGQUIT_STACKS); otherwise installs nothing
+flight_recorder.auto_install()
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing",
            "load_profiler_result", "ProfilerResult", "SortedKeys",
-           "statistic", "monitor", "cost"]
+           "statistic", "monitor", "cost", "flight_recorder",
+           "trace_export", "health", "AnomalyDetector"]
 
 
 class ProfilerTarget:
@@ -60,8 +70,12 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """Reference-signature on_trace_ready handler: when the profiler
+    stops, write the unified Chrome trace (host spans + counter tracks +
+    step/serve records, see trace_export.py) into `dir_name`."""
     def handler(prof):
         prof._export_dir = dir_name
+        prof._worker_name = worker_name
     return handler
 
 
@@ -73,6 +87,7 @@ class Profiler:
         self._on_ready = on_trace_ready
         self._timer_only = timer_only
         self._export_dir = None
+        self._worker_name = None
         self._dir = os.environ.get("PADDLE_PROFILER_DIR",
                                    "/tmp/paddle_tpu_profile")
         self._active = False
@@ -94,6 +109,28 @@ class Profiler:
         self.export_host_stats()
         if self._on_ready:
             self._on_ready(self)
+        if self._export_dir:  # export_chrome_tracing(dir) handler
+            try:
+                self.export_chrome_tracing(self._export_dir)
+            except Exception:
+                pass  # telemetry never takes the process down
+
+    def export_chrome_tracing(self, path, worker_name=None):
+        """Write the unified Chrome-trace-event JSON (host spans as
+        per-thread tracks, metric counter tracks, train-step / serving
+        batch tracks, anomaly markers — trace_export.py) to `path` and
+        return the file path. `path` may be a directory (reference
+        export_chrome_tracing semantics): the file lands there as
+        `<worker_name or paddle_tpu_trace.rank<r>>.json`. Opens in
+        Perfetto / chrome://tracing; `tools/merge_traces.py` merges
+        per-rank files."""
+        name = worker_name or getattr(self, "_worker_name", None)
+        if os.path.isdir(path) or not path.endswith(".json"):
+            fname = f"{name or f'paddle_tpu_trace.rank{monitor.rank()}'}" \
+                    f".json"
+            path = os.path.join(path, fname)
+        return trace_export.write_chrome_trace(
+            path, extra={"step_times_s": list(self._step_times)})
 
     def export_host_stats(self, path=None):
         """Write the aggregated host spans + metrics registry to

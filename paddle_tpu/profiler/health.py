@@ -1,0 +1,134 @@
+"""Host-side training-health anomaly detectors.
+
+The in-graph half lives in the train steps (`jit/api.py`
+`TrainStep(monitor_health=True)` computes global grad norm, param norm,
+and update ratio INSIDE the compiled step and returns them through the
+deferred async path — no new host syncs). This module is the host half:
+once those scalars land (is_ready-gated, never blocking the step loop),
+`AnomalyDetector.observe()` runs cheap streaming checks and emits
+structured `kind:"event"` records into the metrics JSONL, the metrics
+registry (`health.anomalies` counter), and the flight recorder — so a
+loss spike at step 40312 is in the ring when the crash dump fires at
+step 40319, and in the Perfetto timeline as an instant marker.
+
+Detectors (all windowed, all O(1) per step):
+
+- **loss_spike / grad_norm_spike** — value > `spike_factor` × the
+  trailing-window median (armed after `min_history` finite samples);
+- **loss_nonfinite / grad_norm_nonfinite** — NaN/Inf the moment it
+  lands (the async-path replacement for a per-step `check_numerics`);
+- **found_inf_streak** — the GradScaler skipped `streak` consecutive
+  updates (scale is collapsing faster than it can adapt);
+- **retrace_storm** — ≥ `retrace_threshold` fresh compiles within the
+  last `retrace_window` observed steps (shape instability: every
+  retrace is a multi-second stall and a new executable).
+
+Spike events re-arm only after the signal returns below threshold, so a
+level shift emits ONE event, not one per step.
+"""
+import collections
+import math
+
+from . import flight_recorder
+from . import monitor
+
+__all__ = ["AnomalyDetector"]
+
+
+def _finite(v):
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+class AnomalyDetector:
+    """Streaming anomaly checks over per-step health scalars. One
+    instance per train step object; `observe()` returns the events it
+    emitted for that step (also queued on `.events`)."""
+
+    def __init__(self, window=64, spike_factor=10.0, min_history=8,
+                 found_inf_streak=4, retrace_window=20,
+                 retrace_threshold=3):
+        self.window = int(window)
+        self.spike_factor = float(spike_factor)
+        self.min_history = int(min_history)
+        self.found_inf_streak = int(found_inf_streak)
+        self.retrace_window = int(retrace_window)
+        self.retrace_threshold = int(retrace_threshold)
+        self._hist = {"loss": collections.deque(maxlen=self.window),
+                      "grad_norm": collections.deque(maxlen=self.window)}
+        self._spiking = {"loss": False, "grad_norm": False}
+        self._inf_streak = 0
+        self._retraces = collections.deque(maxlen=self.retrace_window)
+        self._storming = False
+        self.events = []
+
+    # -- emission --------------------------------------------------------
+    def _emit(self, etype, step, **fields):
+        rec = {"event": etype, "step": int(step)}
+        rec.update(fields)
+        monitor.counter("health.anomalies").inc()
+        # record_event lands the record in the events ring AND (when
+        # configured) the metrics JSONL — one emission point, no dup line
+        flight_recorder.record_event(**rec)
+        self.events.append(rec)
+        return rec
+
+    def drain(self):
+        """Pop and return the accumulated events (hapi's callback feed)."""
+        out, self.events = self.events, []
+        return out
+
+    # -- checks ----------------------------------------------------------
+    def _check_spike(self, key, value, step, out):
+        hist = self._hist[key]
+        if not _finite(value):
+            out.append(self._emit(f"{key}_nonfinite", step,
+                                  value=repr(value)))
+            return
+        spiking = False
+        if len(hist) >= self.min_history:
+            med = sorted(hist)[len(hist) // 2]
+            floor = max(abs(med), 1e-12)
+            if value > self.spike_factor * floor:
+                spiking = True
+                if not self._spiking[key]:  # edge-triggered
+                    out.append(self._emit(
+                        f"{key}_spike", step, value=float(value),
+                        median=float(med),
+                        threshold=float(self.spike_factor * floor)))
+        self._spiking[key] = spiking
+        if not spiking:  # a spike must not poison its own baseline
+            hist.append(float(value))
+
+    def observe(self, step, values, retraces=None):
+        """Feed one step's resolved health scalars (dict with any of
+        loss / grad_norm / found_inf) plus the step object's cumulative
+        retrace counter. Returns the list of events emitted NOW."""
+        out = []
+        for key in ("loss", "grad_norm"):
+            if key in values and values[key] is not None:
+                self._check_spike(key, values[key], step, out)
+
+        fi = values.get("found_inf")
+        if fi is not None:
+            if _finite(fi) and fi >= 0.5:
+                self._inf_streak += 1
+                if self._inf_streak == self.found_inf_streak:
+                    out.append(self._emit(
+                        "found_inf_streak", step,
+                        streak=self._inf_streak))
+            else:
+                self._inf_streak = 0
+
+        if retraces is not None:
+            self._retraces.append(int(retraces))
+            fresh = self._retraces[-1] - self._retraces[0]
+            if len(self._retraces) >= 2 and \
+                    fresh >= self.retrace_threshold:
+                if not self._storming:
+                    self._storming = True
+                    out.append(self._emit(
+                        "retrace_storm", step, retraces=fresh,
+                        window_steps=len(self._retraces)))
+            else:
+                self._storming = False
+        return out

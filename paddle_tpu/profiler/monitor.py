@@ -42,6 +42,8 @@ import os
 import threading
 import time
 
+from . import flight_recorder
+
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "metrics_snapshot", "reset_metrics",
            "rank", "metrics_file", "export_step", "host_blocked_s"]
@@ -62,7 +64,9 @@ class Counter:
     def inc(self, v=1):
         with _lock:
             self.value += v
-        return self.value
+            out = self.value
+        flight_recorder.record_sample(self.name, "counter", out)
+        return out
 
     def snapshot(self):
         return self.value
@@ -79,6 +83,7 @@ class Gauge:
     def set(self, v):
         with _lock:
             self.value = v
+        flight_recorder.record_sample(self.name, "gauge", v)
         return v
 
     def snapshot(self):
@@ -113,6 +118,7 @@ class Histogram:
                 self.min = v
             if v > self.max:
                 self.max = v
+        flight_recorder.record_sample(self.name, "histogram", v)
 
     @property
     def avg(self):
@@ -204,15 +210,20 @@ def metrics_file():
     return os.environ.get("PADDLE_TPU_METRICS_FILE") or None
 
 
-def export_step(record, kind="step"):
+def export_step(record, kind="step", _ring=True):
     """Append one rank-tagged JSON line to PADDLE_TPU_METRICS_FILE.
-    No-op (returns False) when the env var is unset; never raises —
-    telemetry must not take down a train loop."""
+    The record also lands in the flight-recorder ring (always on, file
+    or no file), so a debug bundle carries the recent step/serve/health
+    tail even for a process that never configured an export path.
+    Returns False when the env var is unset or the write failed; never
+    raises — telemetry must not take down a train loop."""
+    rec = {"ts": time.time(), "rank": rank(), "kind": kind}
+    rec.update(record)
+    if _ring:  # events ring-record themselves (flight_recorder)
+        flight_recorder.record_record(rec)
     path = metrics_file()
     if not path:
         return False
-    rec = {"ts": time.time(), "rank": rank(), "kind": kind}
-    rec.update(record)
     try:
         line = json.dumps(rec)
     except (TypeError, ValueError):

@@ -19,6 +19,8 @@ thread.
 import threading
 import time
 
+from . import flight_recorder
+
 __all__ = ["SpanNode", "span", "begin_span", "end_span", "record_span",
            "reset_statistics", "snapshot", "summary_table", "get_events",
            "SortedKeys"]
@@ -96,7 +98,7 @@ def end_span():
         return 0.0
     name, t0 = st.pop()
     dt = time.perf_counter() - t0
-    _record(name, dt, [n for n, _ in st])
+    _record(name, dt, [n for n, _ in st], t0)
     return dt
 
 
@@ -104,16 +106,24 @@ def record_span(name, seconds):
     """Record an already-measured duration as a span nested under this
     thread's currently-open spans (used by instrumentation that times a
     region itself, e.g. the DataLoader batch wait)."""
-    _record(name, float(seconds), [n for n, _ in _stack()])
+    seconds = float(seconds)
+    _record(name, seconds, [n for n, _ in _stack()],
+            time.perf_counter() - seconds)
 
 
-def _record(name, seconds, parent_names):
+def _record(name, seconds, parent_names, t0=None):
     ident = threading.get_ident()
     with _lock:
         node = _root
         for p in parent_names:
             node = node.child(p)
         node.child(name).add(seconds, ident)
+    # raw event tail for the timeline view (trace_export.py): the
+    # aggregation above answers "how much", the flight-recorder ring
+    # answers "when" — a bounded deque append, negligible per span
+    flight_recorder.record_span_event(
+        name, t0 if t0 is not None else time.perf_counter() - seconds,
+        seconds, ident, len(parent_names))
 
 
 class span:

@@ -1,0 +1,174 @@
+"""Unified Chrome-trace-event export: one JSON file, openable in
+Perfetto (ui.perfetto.dev) or chrome://tracing, carrying everything the
+process recorded — host spans as per-thread duration tracks, metric
+updates as counter tracks, train-step / serving-batch records as
+synthetic tracks, and structured anomalies as instant markers.
+
+Parity: the reference profiler's `export_chrome_tracing` — but where
+the reference serializes its C++ HostTraceLevel events, this renders
+the flight-recorder ring (`flight_recorder.py`), which every hot path
+already feeds. The export is therefore available at ANY moment of a
+live process (it is a snapshot of the recent tail, ring-bounded), not
+only inside a Profiler start/stop window.
+
+Track layout (what you see in Perfetto):
+
+- pid = the process rank (launch env), process name "paddle_tpu rank N"
+  — `tools/merge_traces.py` merges per-rank files into one timeline;
+- one thread track per real host thread (named: MainThread,
+  serve-dispatch, prefetch producer, ...), duration events from spans;
+- synthetic tracks "train steps" / "serve batches" rendering the
+  exported step/serve records with their metadata as args;
+- a counter track per metric (queue depth, prefetch depth, device
+  memory, host.blocked_s, ...);
+- instant markers for `kind:"event"` anomalies (NaN, loss spike,
+  watchdog, ...).
+
+Timestamps are unix-epoch microseconds (spans carry a perf_counter →
+wall anchor), so traces from different ranks on one host line up.
+"""
+import json
+import math
+import os
+import threading
+
+from . import flight_recorder
+from . import monitor
+
+__all__ = ["chrome_trace_events", "write_chrome_trace",
+           "TRAIN_TID", "SERVE_TID", "EVENT_TID"]
+
+# synthetic track ids for record-derived events; real thread idents are
+# pointer-sized on linux, so single digits can never collide with them
+TRAIN_TID = 1
+SERVE_TID = 2
+EVENT_TID = 3
+
+
+def _sanitize(obj):
+    """JSON-strict copy: non-finite floats become strings (Perfetto's
+    JSON parser rejects bare NaN/Infinity tokens)."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return repr(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def _thread_names(tids):
+    """ident -> human name for the threads still alive at export time."""
+    alive = {t.ident: t.name for t in threading.enumerate()}
+    return {tid: alive.get(tid, f"thread-{tid}") for tid in tids}
+
+
+def chrome_trace_events(snap=None, rank=None):
+    """The flight-recorder snapshot as a list of Chrome trace events
+    (dicts), sorted by timestamp — ready to wrap in {"traceEvents": …}."""
+    if snap is None:
+        snap = flight_recorder.snapshot()
+    if rank is None:
+        rank = monitor.rank()
+    pid = int(rank)
+    meta = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0, "ts": 0,
+         "args": {"name": f"paddle_tpu rank {rank}"}},
+        {"ph": "M", "name": "process_sort_index", "pid": pid, "tid": 0,
+         "ts": 0, "args": {"sort_index": int(rank)}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": TRAIN_TID,
+         "ts": 0, "args": {"name": "train steps"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": SERVE_TID,
+         "ts": 0, "args": {"name": "serve batches"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": EVENT_TID,
+         "ts": 0, "args": {"name": "events"}},
+    ]
+    events = []
+
+    # host spans -> per-thread duration ("X" complete) events; Perfetto
+    # reconstructs nesting from ts/dur containment, which the recorder's
+    # child-closes-before-parent ordering guarantees
+    for s in snap.get("spans", ()):
+        events.append({
+            "name": s["name"], "ph": "X", "cat": "host_span",
+            "ts": s["ts"] * 1e6, "dur": max(s["dur_s"], 0.0) * 1e6,
+            "pid": pid, "tid": s["tid"],
+            "args": {"depth": s.get("depth", 0)}})
+
+    # metric updates -> counter tracks (one per metric name)
+    for m in snap.get("samples", ()):
+        events.append({
+            "name": m["name"], "ph": "C", "cat": "metric",
+            "ts": m["ts"] * 1e6, "pid": pid, "tid": 0,
+            "args": {"value": _sanitize(m["value"])}})
+
+    # exported records -> synthetic tracks; the record itself rides in
+    # args so a slice click shows step/compile/mfu or batch/pad/latency
+    for rec in snap.get("records", ()):
+        kind = rec.get("kind")
+        ts = float(rec.get("ts", 0.0))
+        if kind == "step":
+            dur = max(float(rec.get("step_time_s", 0.0)), 0.0)
+            events.append({
+                "name": f"step {rec.get('step', '?')}", "ph": "X",
+                "cat": "train", "ts": (ts - dur) * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": TRAIN_TID, "args": _sanitize(rec)})
+        elif kind == "scan":
+            dur = max(float(rec.get("dispatch_s", 0.0)), 0.0)
+            events.append({
+                "name": f"run_steps x{rec.get('steps', '?')}", "ph": "X",
+                "cat": "train", "ts": (ts - dur) * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": TRAIN_TID, "args": _sanitize(rec)})
+        elif kind == "serve":
+            dur = max(float(rec.get("latency_s", 0.0)), 0.0)
+            events.append({
+                "name": f"{rec.get('engine', 'serve')} "
+                        f"batch={rec.get('batch_size', '?')}",
+                "ph": "X", "cat": "serve", "ts": (ts - dur) * 1e6,
+                "dur": dur * 1e6, "pid": pid, "tid": SERVE_TID,
+                "args": _sanitize(rec)})
+        elif kind == "health":
+            for key in ("grad_norm", "param_norm", "update_ratio",
+                        "loss"):
+                v = rec.get(key)
+                if isinstance(v, (int, float)):
+                    events.append({
+                        "name": f"health.{key}", "ph": "C",
+                        "cat": "health", "ts": ts * 1e6, "pid": pid,
+                        "tid": 0, "args": {"value": _sanitize(v)}})
+    # structured anomalies: the events ring is their ONE home —
+    # record_event rings them here and exports the JSONL line itself
+    # (monitor.export_step _ring=False), so the records ring never
+    # duplicates them
+    for ev in snap.get("events", ()):
+        events.append({
+            "name": ev.get("event", "event"), "ph": "i", "s": "p",
+            "cat": "event", "ts": float(ev.get("ts", 0.0)) * 1e6,
+            "pid": pid, "tid": EVENT_TID, "args": _sanitize(ev)})
+
+    # name real thread tracks (after the span loop knows the tids)
+    tids = sorted({e["tid"] for e in events if e.get("cat") == "host_span"})
+    for tid, name in _thread_names(tids).items():
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "ts": 0, "args": {"name": name}})
+
+    events.sort(key=lambda e: e["ts"])  # sorted ts per track, globally
+    return meta + events
+
+
+def write_chrome_trace(path, snap=None, rank=None, extra=None):
+    """Write the trace JSON to `path` and return it. Chrome trace JSON
+    object format: {"traceEvents": [...], "displayTimeUnit": "ms"}."""
+    payload = {"traceEvents": chrome_trace_events(snap=snap, rank=rank),
+               "displayTimeUnit": "ms",
+               "otherData": dict(extra or {},
+                                 exporter="paddle_tpu.profiler",
+                                 rank=monitor.rank()
+                                 if rank is None else rank)}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        # default=str: a ringed record can carry values export_step's
+        # own json.dumps would have rejected (the ring append runs
+        # first) — a stringified arg beats a crashed export
+        json.dump(payload, f, default=str)
+    return path

@@ -289,6 +289,11 @@ def test_scheduler_crash_fails_queued_requests_loudly():
     with pytest.raises(ServingError, match="scheduler thread crashed"):
         fut.result(timeout=10)
     assert isinstance(fut.exception().__cause__, RuntimeError)
+    # set_exception wakes result() BEFORE invoking done callbacks (they
+    # run next in the scheduler thread) — give the callback its turn
+    deadline = time.time() + 5
+    while not reentered and time.time() < deadline:
+        time.sleep(0.01)
     assert reentered == ["EngineStopped"]
     with pytest.raises(EngineStopped):
         eng.submit(_x())

@@ -38,9 +38,32 @@ Schema (documented in docs/OBSERVABILITY.md):
                   engine       str     emitting engine's name (non-empty;
                                        the per-engine key that keeps
                                        multi-engine JSONL attributable)
+  kind == "health" (one record per resolved health vector —
+                  TrainStep/HybridTrainStep monitor_health=True)
+                  additionally requires:
+                  step          int            optimizer step (>= 1)
+                  loss          number|str     non-finite values export
+                  grad_norm     number|str     as their repr strings
+                  param_norm    number|str     ("nan", "inf") because
+                  update_ratio  number|str     bare NaN is not JSON;
+                  found_inf     number|str     numeric values must be
+                                               >= 0 (found_inf: 0 or 1)
+  kind == "event" (structured anomaly/lifecycle events —
+                  profiler/flight_recorder.record_event) additionally
+                  requires:
+                  event        str     non-empty event name
+                                       (nan_detected, loss_spike,
+                                       watchdog_expired, ...)
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
+
+A FILE whose content is a Chrome trace JSON (an object with a
+"traceEvents" array — e.g. `Profiler.export_chrome_tracing(path)` or a
+`tools/merge_traces.py` output) is validated as a trace instead:
+strictly-parsing JSON (no bare NaN/Infinity tokens), every event a dict
+with a `ph`, numeric `ts` (and `dur` for complete "X" events),
+non-decreasing ts per (pid, tid) track, and matched B/E begin/end pairs.
 
 Usage: python tools/check_metrics_schema.py FILE [FILE...]
 Exit 0 when every line of every file validates, 1 otherwise.
@@ -56,6 +79,14 @@ STEP_REQUIRED = {"step": int, "step_time_s": (int, float),
 SERVE_REQUIRED = {"requests": int, "batch_size": int, "bucket_batch": int,
                   "queue_depth": int, "pad_tokens": int,
                   "latency_s": (int, float)}
+HEALTH_REQUIRED = {"step": int, "loss": (int, float, str),
+                   "grad_norm": (int, float, str),
+                   "param_norm": (int, float, str),
+                   "update_ratio": (int, float, str),
+                   "found_inf": (int, float, str)}
+EVENT_REQUIRED = {"event": str}
+# repr strings a non-finite health scalar may export as
+_NONFINITE_STRS = {"nan", "inf", "-inf"}
 
 
 def _check_types(rec, required, where, errors):
@@ -120,14 +151,122 @@ def validate_line(line, where="<line>"):
                 f"{where}: bucket_batch {rec['bucket_batch']} < "
                 f"batch_size {rec['batch_size']} — the bucket must fit "
                 "the rows it padded")
+    elif rec.get("kind") == "health":
+        _check_types(rec, HEALTH_REQUIRED, where, errors)
+        if isinstance(rec.get("step"), int) and \
+                not isinstance(rec.get("step"), bool) and rec["step"] < 1:
+            errors.append(f"{where}: step must be >= 1, got {rec['step']}")
+        for key in ("grad_norm", "param_norm", "update_ratio",
+                    "found_inf"):
+            v = rec.get(key)
+            if isinstance(v, str):
+                if v.lower() not in _NONFINITE_STRS:
+                    errors.append(
+                        f"{where}: {key} string must be a non-finite "
+                        f"repr ({sorted(_NONFINITE_STRS)}), got {v!r}")
+            elif isinstance(v, (int, float)) and \
+                    not isinstance(v, bool) and v < 0:
+                errors.append(
+                    f"{where}: {key} must be >= 0, got {v}")
+        fi = rec.get("found_inf")
+        if isinstance(fi, (int, float)) and not isinstance(fi, bool) \
+                and fi not in (0, 1):
+            errors.append(
+                f"{where}: found_inf must be 0 or 1, got {fi}")
+    elif rec.get("kind") == "event":
+        _check_types(rec, EVENT_REQUIRED, where, errors)
+        if isinstance(rec.get("event"), str) and not rec["event"]:
+            errors.append(f"{where}: event name must be non-empty")
+    return errors
+
+
+def _strict_loads(text):
+    """json.loads that REJECTS bare NaN/Infinity tokens — Perfetto's
+    JSON parser does, so the lint must too."""
+    def bad_constant(name):
+        raise ValueError(f"non-JSON constant {name}")
+    return json.loads(text, parse_constant=bad_constant)
+
+
+def validate_trace(path, text=None):
+    """Violations for one Chrome-trace-event JSON file (the object
+    format {"traceEvents": [...]} or the bare array format)."""
+    errors = []
+    if text is None:
+        with open(path) as f:
+            text = f.read()
+    try:
+        payload = _strict_loads(text)
+    except ValueError as e:
+        return [f"{path}: not strict JSON ({e})"]
+    events = payload.get("traceEvents") if isinstance(payload, dict) \
+        else payload
+    if not isinstance(events, list):
+        return [f"{path}: no traceEvents array"]
+    if not events:
+        return [f"{path}: empty trace (no events)"]
+    last_ts = {}     # (pid, tid) -> last non-meta ts
+    open_b = {}      # (pid, tid) -> count of unmatched B events
+    for i, e in enumerate(events):
+        where = f"{path}: event {i}"
+        if not isinstance(e, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = e.get("ph")
+        if not isinstance(ph, str) or not ph:
+            errors.append(f"{where}: missing ph")
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+            errors.append(f"{where}: ph={ph} missing numeric ts")
+            continue
+        key = (e.get("pid", 0), e.get("tid", 0))
+        if ph == "M":
+            continue  # metadata carries ts 0, outside the track order
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                    or dur < 0:
+                errors.append(f"{where}: X event needs dur >= 0, "
+                              f"got {dur!r}")
+        elif ph == "B":
+            open_b[key] = open_b.get(key, 0) + 1
+        elif ph == "E":
+            if open_b.get(key, 0) <= 0:
+                errors.append(f"{where}: E without matching B on "
+                              f"track {key}")
+            else:
+                open_b[key] -= 1
+        if key in last_ts and ts < last_ts[key]:
+            errors.append(
+                f"{where}: ts {ts} < previous {last_ts[key]} on track "
+                f"{key} — events must be sorted per track")
+        last_ts[key] = ts
+    for key, n in open_b.items():
+        if n:
+            errors.append(f"{path}: {n} unmatched B event(s) on track "
+                          f"{key}")
     return errors
 
 
 def validate_file(path):
-    """All violations in one file; ["<path>: empty file"] when empty."""
+    """All violations in one file; ["<path>: empty file"] when empty.
+    A file whose whole content is a JSON object with a traceEvents
+    array (or a bare event array) validates as a Chrome trace; anything
+    else validates line-by-line as metrics JSONL."""
     errors = []
     with open(path) as f:
-        lines = f.read().splitlines()
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith(("{", "[")):
+        try:
+            payload = _strict_loads(text)
+        except ValueError:
+            payload = None
+        if isinstance(payload, list) or (
+                isinstance(payload, dict) and "traceEvents" in payload):
+            return validate_trace(path, text=text)
+    lines = text.splitlines()
     if not any(line.strip() for line in lines):
         return [f"{path}: empty file (no records emitted)"]
     for lineno, line in enumerate(lines, 1):

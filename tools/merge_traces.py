@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Merge per-rank Chrome trace files into ONE timeline.
+
+A `paddle_tpu.distributed.launch` run yields one trace file per rank
+(each rank calls `Profiler.export_chrome_tracing(...)`, or the operator
+pulls them from per-rank debug bundles). Every file's events are
+pid-tagged with that rank, and timestamps are unix-epoch microseconds
+(same host ⇒ same clock), so merging is: concatenate, de-conflict pids,
+sort. The merged file opens in Perfetto with one process group per rank
+— the standard way to see a multi-process stall: which rank's step track
+stretched while the others waited at the collective.
+
+Usage:
+    python tools/merge_traces.py -o merged.json rank0.json rank1.json ...
+    python tools/merge_traces.py -o merged.json trace_dir/   # *.json in dir
+
+Exit 0 on success; 2 on unreadable/invalid inputs.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_events(path):
+    """A trace file's event list (object format {"traceEvents": [...]}
+    or the bare-array format chrome also accepts)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, dict):
+        events = payload.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError(f"{path}: no traceEvents array")
+        return events
+    if isinstance(payload, list):
+        return payload
+    raise ValueError(f"{path}: not a Chrome trace (object or array)")
+
+
+def merge(event_lists, labels=None):
+    """One sorted event list; colliding pids across files are remapped
+    (two single-process traces both claim pid 0 = rank 0) and every
+    process keeps/gains a process_name so tracks stay attributable."""
+    used_pids = set()
+    merged = []
+    for i, events in enumerate(event_lists):
+        pids = {e.get("pid", 0) for e in events}
+        remap = {}
+        for p in sorted(pids, key=lambda x: str(x)):
+            q = p
+            while q in used_pids:
+                q = (q if isinstance(q, int) else 0) + 1000 + i
+            remap[p] = q
+            used_pids.add(q)
+        named = set()
+        for e in events:
+            e = dict(e)
+            e["pid"] = remap.get(e.get("pid", 0), e.get("pid", 0))
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                named.add(e["pid"])
+            merged.append(e)
+        for p in sorted(remap.values(), key=str):
+            if p not in named:
+                label = labels[i] if labels and i < len(labels) else \
+                    f"trace {i}"
+                merged.append({"ph": "M", "name": "process_name",
+                               "pid": p, "tid": 0, "ts": 0,
+                               "args": {"name": label}})
+    # metadata (ph M) leads; everything else in timestamp order — the
+    # "sorted ts per track" property tools/check_metrics_schema.py lints
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               float(e.get("ts", 0))))
+    return merged
+
+
+def expand_inputs(inputs):
+    paths = []
+    for p in inputs:
+        if os.path.isdir(p):
+            paths.extend(sorted(glob.glob(os.path.join(p, "*.json"))))
+        else:
+            paths.append(p)
+    return paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        "merge_traces", description="merge per-rank Chrome trace files")
+    ap.add_argument("-o", "--output", required=True)
+    ap.add_argument("inputs", nargs="+",
+                    help="trace files, or directories of *.json")
+    args = ap.parse_args(argv)
+    paths = expand_inputs(args.inputs)
+    if not paths:
+        print("merge_traces: no input trace files", file=sys.stderr)
+        return 2
+    lists = []
+    for p in paths:
+        try:
+            lists.append(load_events(p))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"merge_traces: {e}", file=sys.stderr)
+            return 2
+    merged = merge(lists, labels=[os.path.basename(p) for p in paths])
+    out = os.path.abspath(args.output)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms",
+                   "otherData": {"merged_from": paths}}, f)
+    print(f"merged {len(paths)} trace(s), {len(merged)} events -> "
+          f"{args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
