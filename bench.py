@@ -34,6 +34,12 @@ Robustness contract (round-6; round-5 history in git):
     timeout alike (phases stream over stderr as "bench-phase:" lines,
     so the parent keeps the last one even when it must SIGKILL the
     child). A failed run diagnoses itself; see docs/OBSERVABILITY.md;
+  * per-executable compile attribution (round-6): every AOT compile
+    streams start/finish over the same bench-phase channel (`compiling`
+    cursor + `compiles` table), and the headline carries a
+    `compile_ledger` key (tag -> lower_s/compile_s/cache_hit from the
+    compilation observatory) — a timed-out round names the executable
+    that ate the budget instead of a bare "stage": "compile";
   * the steady phase measures the real async pipeline: batches arrive
     through the device prefetch ring and the loss resolves once at the
     end — `host_blocked_s` in the breakdown separates dispatch-bound
@@ -132,6 +138,46 @@ def _mark_compiled(tag):
         pass
 
 
+def _stream_compiles():
+    """Wire the compilation observatory's listener into the bench-phase
+    stderr stream: every AOT compile announces itself when it STARTS
+    (`compiling: <tag>`) and lands its lower/compile split when it
+    finishes, so a child killed at a 300 s timeout still says — in its
+    last bench-phase line — WHICH executable ate the budget and which
+    ones were already done. Call after paddle_tpu has imported."""
+    from paddle_tpu.profiler import compile_observatory as _cobs
+
+    def _on_compile(ev):
+        if ev.get("phase") == "start":
+            _phase(_PHASES["stage"], compiling=ev.get("tag"))
+        else:
+            rec = ev.get("record") or {}
+            done = list(_PHASES.get("compiles") or [])
+            done.append({
+                "tag": rec.get("tag"),
+                "lower_s": round(float(rec.get("lower_s", 0.0)), 2),
+                "compile_s": round(float(rec.get("compile_s", 0.0)), 2),
+                "cache_hit": bool(rec.get("cache_hit", False))})
+            _phase(_PHASES["stage"], compiling=None, compiles=done[-8:])
+    _cobs.add_listener(_on_compile)
+
+
+def _compile_ledger_table():
+    """The headline's per-executable compile table: tag -> lower_s /
+    compile_s / cache_hit (+ signature count and fusion count), rolled
+    up from the compilation observatory's ledger."""
+    try:
+        from paddle_tpu.profiler import compile_observatory as _cobs
+        return {tag: {"lower_s": round(a["lower_s"], 3),
+                      "compile_s": round(a["compile_s"], 3),
+                      "cache_hit": a["cache_hit"],
+                      "signatures": a["signatures"],
+                      "fusion_count": a["fusion_count"]}
+                for tag, a in sorted(_cobs.aggregate().items())}
+    except Exception:
+        return {}
+
+
 def _peak_flops(jax_mod):
     """bf16 peak for the attached chip generation (MFU denominator) —
     the framework's single table (paddle_tpu/profiler/cost.py), with
@@ -173,6 +219,7 @@ def _run():
     from paddle_tpu import optimizer as opt
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    _stream_compiles()  # per-executable compile progress -> bench-phase
     _phase("build", import_s=time.perf_counter() - t_phase)
     t_phase = time.perf_counter()
 
@@ -348,6 +395,10 @@ def _run():
         # unified Chrome-trace export (open in Perfetto; merge per-rank
         # files with tools/merge_traces.py)
         "trace_file": trace_file,
+        # the compilation observatory's per-executable ledger: where the
+        # compile seconds went, per tag, with cache-hit attribution —
+        # the compile-time wall (ROADMAP item 3) finally itemized
+        "compile_ledger": _compile_ledger_table(),
         "phases": dict(_PHASES),
     }
     print(json.dumps(headline), flush=True)
@@ -407,6 +458,7 @@ def _run_1p3b():
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt_1p3b
     from paddle_tpu.optimizer import Momentum
+    _stream_compiles()  # per-executable compile progress -> bench-phase
     _phase("build")
 
     cfg13 = gpt_1p3b()
@@ -478,6 +530,7 @@ def _run_serve():
     from paddle_tpu import inference
     from paddle_tpu.jit import save as jit_save, InputSpec
     from paddle_tpu.profiler import monitor as _pmon
+    _stream_compiles()  # bucket compiles -> bench-phase, like training
 
     clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
     per_client = int(os.environ.get("BENCH_SERVE_REQS", "40"))
@@ -593,6 +646,7 @@ def _run_serve():
         "retraces_after_warm": engine.retraces - warmed,
         "on_tpu": jax.default_backend() == "tpu",
         "errors": errors[:3],
+        "compile_ledger": _compile_ledger_table(),
         "phases": dict(_PHASES),
     }
     cfg.disable_serving()

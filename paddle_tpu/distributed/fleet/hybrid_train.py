@@ -25,7 +25,7 @@ from ...framework.core import Tensor, no_grad, _Slot
 from ...framework.random import split_key
 from ...jit.api import (functional_call, state_arrays, aot_compile,
                         count_train_use, export_step_metrics,
-                        HealthMonitorMixin)
+                        HealthMonitorMixin, _step_arg_names)
 from ...jit.deferred import DeferredLoss
 from ...profiler import statistic as _stat
 from ...profiler import monitor as _monitor
@@ -322,7 +322,8 @@ class HybridTrainStep(HealthMonitorMixin):
             compiled_now = entry is None
             if compiled_now:
                 entry = self._exec[sig] = aot_compile(
-                    self._jitted, args, tag="fleet.hybrid_step")
+                    self._jitted, args, tag="fleet.hybrid_step",
+                    arg_names=_step_arg_names(len(batch)))
             compiled, info = entry
             count_train_use(self, info)
             try:
@@ -379,7 +380,8 @@ class HybridTrainStep(HealthMonitorMixin):
         entry = self._exec.get(sig)
         if entry is None:
             entry = self._exec[sig] = aot_compile(
-                self._jitted, args, tag="fleet.hybrid_step")
+                self._jitted, args, tag="fleet.hybrid_step",
+                arg_names=_step_arg_names(len(batch)))
         return entry[0]
 
     def sync_to_model(self):

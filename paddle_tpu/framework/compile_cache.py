@@ -99,11 +99,21 @@ def disable_compile_cache():
 
 def cache_entry_count():
     """Number of entries currently on disk (0 when disabled/empty)."""
+    return len(cache_entry_names())
+
+
+def cache_entry_names():
+    """The on-disk entry names as a frozenset (empty when disabled).
+    Hit/miss attribution diffs the set around a compile instead of
+    comparing counts: the names say WHICH entry a compile added (the
+    compilation observatory records it), and a concurrent compile
+    adding an unrelated entry can't alias with a removal into a
+    spuriously unchanged count."""
     d = _state["dir"]
     if not d or not os.path.isdir(d):
-        return 0
+        return frozenset()
     try:
-        return sum(1 for n in os.listdir(d)
-                   if not n.startswith("."))
+        return frozenset(n for n in os.listdir(d)
+                         if not n.startswith("."))
     except OSError:
-        return 0
+        return frozenset()

@@ -480,7 +480,10 @@ class InferenceEngine(_SchedulerLifecycle):
             # (flight recorder executable registry)
             bucket = specs[0].shape[0] if specs else 0
             entry = aot_compile(self._jitted, tuple(specs),
-                                tag=f"serve.{self.name}.batch{bucket}")
+                                tag=f"serve.{self.name}.batch{bucket}",
+                                arg_names=tuple(
+                                    f"input{i}"
+                                    for i in range(len(specs))))
             self._exec[sig] = entry
             self.retraces += 1
             _monitor.counter("serve.retraces").inc()

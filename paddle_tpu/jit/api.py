@@ -26,6 +26,7 @@ from ..profiler import statistic as _stat
 from ..profiler import monitor as _monitor
 from ..profiler import cost as _cost
 from ..profiler import flight_recorder as _flight
+from ..profiler import compile_observatory as _observatory
 from .deferred import DeferredLoss
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
@@ -33,7 +34,7 @@ __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
            "DeferredLoss", "HealthMonitorMixin"]
 
 
-def aot_compile(jitted, args, tag=None):
+def aot_compile(jitted, args, tag=None, static=None, arg_names=None):
     """Explicitly lower + compile a jax.jit function for `args` — the
     AOT dispatch path TrainStep/HybridTrainStep use instead of jax.jit's
     implicit first-call compile. This is the telemetry keystone: the
@@ -45,7 +46,18 @@ def aot_compile(jitted, args, tag=None):
 
     `tag` names the executable in the flight recorder's registry, so a
     crash/hang debug bundle (profiler/flight_recorder.py) carries its
-    HLO text + cost analysis.
+    HLO text + cost analysis. It is also the compilation observatory's
+    key (profiler/compile_observatory.py): every call lands one
+    `kind:"compile"` ledger record (lower/compile split, cache hit, HLO
+    instruction/fusion counts, bytes/flops, peak-memory estimate), and
+    a tag recompiling under a NEW abstract signature emits a structured
+    retrace event naming the argument that changed — BEFORE the
+    recompile runs, so even a hung compile leaves the diagnosis.
+
+    `static` declares values baked into the traced program rather than
+    passed as arrays (run_steps' `n`, accumulate's `k`): they are part
+    of the observatory signature so a static-value retrace is named as
+    such. `arg_names` labels positional args in forensics output.
 
     Returns (compiled, info) where info carries lower_s / compile_s /
     cache_hit / flops / bytes. The global jit.* metrics count every
@@ -55,6 +67,10 @@ def aot_compile(jitted, args, tag=None):
     untrained signature) can't fake shape instability.
     """
     from ..framework import compile_cache as _cc
+    obs_tag = tag or "aot"
+    sig = _observatory.abstract_signature(args, static=static)
+    sig_key, _ = _observatory.compile_started(obs_tag, sig,
+                                              arg_names=arg_names)
     t0 = time.perf_counter()
     _stat.begin_span("jit.trace_lower")
     try:
@@ -62,13 +78,15 @@ def aot_compile(jitted, args, tag=None):
     finally:
         lower_s = _stat.end_span()
     cache_on = _cc.cache_dir() is not None
-    entries_before = _cc.cache_entry_count() if cache_on else 0
+    entries_before = _cc.cache_entry_names() if cache_on else frozenset()
     _stat.begin_span("jit.compile")
     try:
         compiled = lowered.compile()
     finally:
         compile_s = _stat.end_span()
-    cache_hit = cache_on and _cc.cache_entry_count() == entries_before
+    added = (_cc.cache_entry_names() - entries_before) if cache_on \
+        else frozenset()
+    cache_hit = cache_on and not added
     total = time.perf_counter() - t0
     _monitor.counter("jit.retraces").inc()
     _monitor.counter("jit.cache_hit" if cache_hit
@@ -81,7 +99,19 @@ def aot_compile(jitted, args, tag=None):
             "bytes": float(ca.get("bytes accessed", 0.0))}
     if tag:  # debug bundles dump this executable's HLO + cost analysis
         _flight.register_executable(tag, compiled)
+    _observatory.record_compile(
+        obs_tag, sig, sig_key, lower_s, compile_s, cache_hit, compiled,
+        cost=ca, arg_names=arg_names, cache_entries_added=len(added))
     return compiled, info
+
+
+def _step_arg_names(n_batch):
+    """Forensics labels for the train-step call signature every
+    TrainStep/HybridTrainStep program flavor shares (`_prep` builds the
+    matching arg tuple): a retrace event says "batch1: dtype ..."
+    instead of "arg8"."""
+    return ("params", "opt_state", "scaler_state", "buffers", "key",
+            "lr", "step") + tuple(f"batch{i}" for i in range(n_batch))
 
 
 def count_train_use(owner, info):
@@ -642,12 +672,13 @@ class TrainStep(HealthMonitorMixin):
         return loss, new_params, new_state, new_scaler_state
 
     def _dispatch(self, cache, sig, make_jitted, args, span,
-                  max_entries=None):
+                  max_entries=None, static=None, arg_names=None):
         """The ONE dispatch path every TrainStep program flavor
         (per-step / scanned steps / scanned accumulation) goes through:
         executable-cache lookup with optional LRU bound, AOT compile on
-        miss, retrace accounting, timed dispatch. Returns
-        (outputs, info, compiled_now, dispatch_s)."""
+        miss, retrace accounting, timed dispatch. `static`/`arg_names`
+        feed the compilation observatory's signature + forensics.
+        Returns (outputs, info, compiled_now, dispatch_s)."""
         _flight.heartbeat(self._step_i)  # watchdog liveness pulse
         _stat.begin_span(span)
         try:
@@ -657,7 +688,8 @@ class TrainStep(HealthMonitorMixin):
                 if max_entries and len(cache) >= max_entries:
                     cache.pop(next(iter(cache)))  # bound compile growth
                 entry = cache[sig] = aot_compile(make_jitted(), args,
-                                                 tag=span)
+                                                 tag=span, static=static,
+                                                 arg_names=arg_names)
             else:  # LRU: re-insert so cycling signatures don't thrash
                 cache[sig] = cache.pop(sig)
             compiled, info = entry
@@ -761,7 +793,9 @@ class TrainStep(HealthMonitorMixin):
                 self.buffers, key, lr, base, *arrays)
         out, info, compiled_now, dt = self._dispatch(
             self._scan_jit, sig, make_jitted, args, "train.run_steps",
-            max_entries=8)
+            max_entries=8,
+            static={"n": n, "data_per_step": bool(data_per_step)},
+            arg_names=_step_arg_names(len(arrays)))
         losses, self.params, self.opt_state, self.scaler_state = out
         # telemetry keeps dispatch-only time: the first call's span also
         # covered the compile
@@ -843,7 +877,8 @@ class TrainStep(HealthMonitorMixin):
 
         out, info, compiled_now, dispatch_s = self._dispatch(
             self._acc_jit, sig, make_jitted, args, "train.accumulate",
-            max_entries=8)
+            max_entries=8, static={"k": k},
+            arg_names=_step_arg_names(len(arrays)))
         if self.monitor_health:
             loss, health, self.params, self.opt_state, \
                 self.scaler_state = out
@@ -879,7 +914,8 @@ class TrainStep(HealthMonitorMixin):
         self._step_i += 1
         sig, args = self._prep(batch, self._step_i)
         out, info, compiled_now, dispatch_s = self._dispatch(
-            self._exec, sig, lambda: self._jitted, args, "train.step")
+            self._exec, sig, lambda: self._jitted, args, "train.step",
+            arg_names=_step_arg_names(len(batch)))
         if self.monitor_health:
             loss, health, self.params, self.opt_state, \
                 self.scaler_state = out
@@ -907,8 +943,9 @@ class TrainStep(HealthMonitorMixin):
         sig, args = self._prep(batch, self._step_i + 1)
         entry = self._exec.get(sig)
         if entry is None:
-            entry = self._exec[sig] = aot_compile(self._jitted, args,
-                                                  tag="train.step")
+            entry = self._exec[sig] = aot_compile(
+                self._jitted, args, tag="train.step",
+                arg_names=_step_arg_names(len(batch)))
         return entry[0]
 
     def sync_to_model(self):

@@ -26,6 +26,7 @@ from . import monitor
 from . import cost
 from . import trace_export
 from . import health
+from . import compile_observatory
 from .statistic import SortedKeys
 from .health import AnomalyDetector
 
@@ -38,7 +39,8 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing",
            "load_profiler_result", "ProfilerResult", "SortedKeys",
            "statistic", "monitor", "cost", "flight_recorder",
-           "trace_export", "health", "AnomalyDetector"]
+           "trace_export", "health", "compile_observatory",
+           "AnomalyDetector"]
 
 
 class ProfilerTarget:
@@ -151,7 +153,8 @@ class Profiler:
                    "rank": monitor.rank(),
                    "step_times_s": list(self._step_times),
                    "spans": statistic.snapshot(),
-                   "metrics": monitor.metrics_snapshot()}
+                   "metrics": monitor.metrics_snapshot(),
+                   "compiles": compile_observatory.ledger()}
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with open(path, "w") as f:
@@ -260,16 +263,19 @@ class RecordEvent:
 
 class ProfilerResult:
     """Queryable view over exported telemetry: host-span aggregates
-    (`spans`, `get`, `total_s`), per-step metric records (`steps`), and
-    the metrics registry snapshot (`metrics`)."""
+    (`spans`, `get`, `total_s`), per-step metric records (`steps`), the
+    metrics registry snapshot (`metrics`), and the compilation ledger
+    (`compiles` — the raw `kind:"compile"` records; `compile_ledger()`
+    rolls them up per executable tag)."""
 
     def __init__(self, spans=None, metrics=None, steps=None,
-                 step_times_s=None, source=None):
+                 step_times_s=None, source=None, compiles=None):
         self.span_tree = spans or []
         self.spans = statistic.flatten(self.span_tree)
         self.metrics = metrics or {}
         self.steps = steps or []
         self.step_times_s = step_times_s or []
+        self.compiles = compiles or []
         self.source = source
 
     def get(self, name):
@@ -279,12 +285,20 @@ class ProfilerResult:
     def total_s(self, name):
         return sum(s["total_s"] for s in self.get(name))
 
+    def compile_ledger(self):
+        """{tag: {lower_s, compile_s, cache_hit, signatures,
+        fusion_count, bytes_accessed, instructions, ...}} — the
+        per-executable rollup of the loaded `kind:"compile"` records
+        (compile_observatory.aggregate)."""
+        return compile_observatory.aggregate(self.compiles)
+
     def summary(self):
         names = sorted({s["name"] for s in self.spans})
         return (f"ProfilerResult({self.source}): {len(self.spans)} span "
                 f"rows ({', '.join(names[:8])}"
                 f"{'...' if len(names) > 8 else ''}), "
                 f"{len(self.steps)} step records, "
+                f"{len(self.compiles)} compile records, "
                 f"{len(self.metrics)} metrics")
 
     def __repr__(self):
@@ -297,7 +311,7 @@ def load_profiler_result(filename):
     Accepts: a profiler directory (reads its host_stats.json), the
     host_stats.json itself, or a metrics JSONL file written via
     PADDLE_TPU_METRICS_FILE (one JSON object per line; `kind == "step"`
-    records land in `.steps`)."""
+    records land in `.steps`, `kind == "compile"` in `.compiles`)."""
     path = filename
     if os.path.isdir(path):
         path = os.path.join(path, "host_stats.json")
@@ -311,9 +325,10 @@ def load_profiler_result(filename):
         return ProfilerResult(spans=payload.get("spans"),
                               metrics=payload.get("metrics"),
                               step_times_s=payload.get("step_times_s"),
+                              compiles=payload.get("compiles"),
                               source=path)
     # JSONL metrics export: one object per line
-    steps, other = [], []
+    steps, compiles, other = [], [], []
     for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line:
@@ -324,7 +339,13 @@ def load_profiler_result(filename):
             raise ValueError(
                 f"{path}:{lineno}: not a host_stats.json export and not "
                 f"valid JSONL ({e})") from None
-        (steps if rec.get("kind") == "step" else other).append(rec)
-    result = ProfilerResult(steps=steps, source=path)
-    result.records = steps + other
+        kind = rec.get("kind")
+        if kind == "step":
+            steps.append(rec)
+        elif kind == "compile":
+            compiles.append(rec)
+        else:
+            other.append(rec)
+    result = ProfilerResult(steps=steps, compiles=compiles, source=path)
+    result.records = steps + compiles + other
     return result

@@ -278,6 +278,21 @@ def dump(reason="manual", exc=None, base_dir=None):
                 pass
         manifest["hlo"] = hlo_tags
 
+        # the compilation ledger: every compile this process ran, with
+        # per-tag rollups — WHERE the compile seconds went, which
+        # executables were cache hits, and the fusion/bytes-accessed
+        # numbers the ratchet gates compare (compile_observatory.py)
+        try:
+            from . import compile_observatory as _obs
+            recs = _obs.ledger()
+            if recs:
+                _write_json(os.path.join(d, "compile_ledger.json"),
+                            {"records": recs,
+                             "by_tag": _obs.aggregate(recs)})
+                manifest["compile_records"] = len(recs)
+        except Exception:
+            pass
+
         # env / versions / argv
         envkeys = ("PADDLE", "JAX", "XLA", "TPU", "BENCH", "FLAGS_")
         env = {k: v for k, v in os.environ.items()

@@ -36,13 +36,14 @@ from . import flight_recorder
 from . import monitor
 
 __all__ = ["chrome_trace_events", "write_chrome_trace",
-           "TRAIN_TID", "SERVE_TID", "EVENT_TID"]
+           "TRAIN_TID", "SERVE_TID", "EVENT_TID", "COMPILE_TID"]
 
 # synthetic track ids for record-derived events; real thread idents are
 # pointer-sized on linux, so single digits can never collide with them
 TRAIN_TID = 1
 SERVE_TID = 2
 EVENT_TID = 3
+COMPILE_TID = 4
 
 
 def _sanitize(obj):
@@ -82,6 +83,8 @@ def chrome_trace_events(snap=None, rank=None):
          "ts": 0, "args": {"name": "serve batches"}},
         {"ph": "M", "name": "thread_name", "pid": pid, "tid": EVENT_TID,
          "ts": 0, "args": {"name": "events"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": COMPILE_TID,
+         "ts": 0, "args": {"name": "compilation"}},
     ]
     events = []
 
@@ -127,6 +130,24 @@ def chrome_trace_events(snap=None, rank=None):
                 "ph": "X", "cat": "serve", "ts": (ts - dur) * 1e6,
                 "dur": dur * 1e6, "pid": pid, "tid": SERVE_TID,
                 "args": _sanitize(rec)})
+        elif kind == "compile":
+            # the compilation observatory's ledger records: one slice
+            # per lower and one per XLA compile on the named
+            # "compilation" track, so Perfetto shows where compile time
+            # went right next to the train steps it delayed. The record
+            # stamp lands just after the compile returns, so the slices
+            # are reconstructed backwards from it.
+            lower = max(float(rec.get("lower_s", 0.0)), 0.0)
+            comp = max(float(rec.get("compile_s", 0.0)), 0.0)
+            tag = rec.get("tag", "?")
+            events.append({
+                "name": f"lower {tag}", "ph": "X", "cat": "compile",
+                "ts": (ts - comp - lower) * 1e6, "dur": lower * 1e6,
+                "pid": pid, "tid": COMPILE_TID, "args": _sanitize(rec)})
+            events.append({
+                "name": f"compile {tag}", "ph": "X", "cat": "compile",
+                "ts": (ts - comp) * 1e6, "dur": comp * 1e6,
+                "pid": pid, "tid": COMPILE_TID, "args": _sanitize(rec)})
         elif kind == "health":
             for key in ("grad_norm", "param_norm", "update_ratio",
                         "loss"):

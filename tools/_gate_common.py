@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Shared plumbing for the compile-observatory ratchet gates
+(tools/check_compile_budget.py, tools/check_fusion.py) — and the
+canonical workload that produces their ledger.
+
+The gates compare per-executable `kind:"compile"` records (the
+compilation observatory's ledger, profiler/compile_observatory.py)
+against the checked-in BASELINE_HLO.json. The ledger can come from any
+metrics JSONL (`--ledger file.jsonl`), but the apples-to-apples source
+is the CANONICAL WORKLOAD here: a fixed tiny GPT train step (per-step,
+scanned run_steps, scanned accumulate) plus a two-bucket serving engine,
+compiled cold (persistent cache off) on the single-device CPU backend —
+same model, same shapes, same flags every run, so fusion counts and
+bytes-accessed are deterministic and compile seconds are comparable.
+
+    python tools/_gate_common.py --emit OUT.jsonl   # run the workload
+                                                    # (in a clean child
+                                                    # env — the gates
+                                                    # spawn this)
+
+BASELINE_HLO.json schema (v1):
+
+    {"schema": "paddle_tpu.hlo_baseline.v1",
+     "executables": {"<tag>": {"lower_s": .., "compile_s": ..,
+                               "total_s": .., "fusion_count": N,
+                               "bytes_accessed": B, "instructions": M,
+                               "flops": F}, ...}}
+
+Ratcheting: the gates never loosen the baseline; `--update` rewrites an
+entry only when the current run is BETTER (lower seconds / fewer
+fusions / fewer bytes), so the checked-in numbers always record the
+best this container has done — regressions compare against that.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_DEFAULT = os.path.join(REPO, "BASELINE_HLO.json")
+BASELINE_SCHEMA = "paddle_tpu.hlo_baseline.v1"
+
+
+class GateError(Exception):
+    """A gate could not even produce numbers (workload crash, bad
+    baseline) — distinct from a regression verdict."""
+
+
+def load_baseline(path):
+    with open(path) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict) or \
+            not isinstance(payload.get("executables"), dict):
+        raise GateError(f"{path}: not a {BASELINE_SCHEMA} baseline "
+                        "(no 'executables' table)")
+    return payload
+
+
+def save_baseline(path, payload):
+    import time
+    payload["schema"] = BASELINE_SCHEMA
+    payload["recorded_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime())
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_compile_records(path):
+    """The `kind:"compile"` records of one metrics JSONL file."""
+    recs = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise GateError(f"{path}:{lineno}: not JSONL ({e})")
+            if isinstance(rec, dict) and rec.get("kind") == "compile":
+                recs.append(rec)
+    return recs
+
+
+def aggregate(records):
+    """Per-tag rollup for the gates (plain JSON math, no framework
+    import: a gate given --ledger must stay a milliseconds-fast diff).
+    Unlike profiler/compile_observatory.aggregate (which SUMS seconds
+    for attribution), the gate comparand is the tag's single SLOWEST
+    compile — `lower_s`/`compile_s`/`total_s` are the components of
+    that one record. A real run's ledger legitimately carries several
+    signatures per tag (tail batch, eval dtype); N ordinary compiles
+    must not add up to a fake budget regression, while one genuinely
+    slow compile still trips it. Max fusion/bytes/instructions across
+    signatures, cache_hit only when every compile hit."""
+    out = {}
+    for r in records:
+        t = out.setdefault(r.get("tag", "?"), {
+            "lower_s": 0.0, "compile_s": 0.0, "total_s": 0.0,
+            "cache_hit": True, "signatures": 0, "fusion_count": 0,
+            "bytes_accessed": 0.0, "instructions": 0, "flops": 0.0})
+        lower = float(r.get("lower_s", 0.0))
+        comp = float(r.get("compile_s", 0.0))
+        if lower + comp >= t["total_s"]:
+            t["lower_s"], t["compile_s"] = lower, comp
+            t["total_s"] = lower + comp
+        t["cache_hit"] = t["cache_hit"] and bool(r.get("cache_hit"))
+        t["signatures"] += 1
+        t["fusion_count"] = max(t["fusion_count"],
+                                int(r.get("fusion_count", 0)))
+        t["bytes_accessed"] = max(t["bytes_accessed"],
+                                  float(r.get("bytes_accessed", 0.0)))
+        t["instructions"] = max(t["instructions"],
+                                int(r.get("instructions", 0)))
+        t["flops"] = max(t["flops"], float(r.get("flops", 0.0)))
+    return out
+
+
+def run_workload(out_path, timeout=300):
+    """Run the canonical workload in a CLEAN subprocess (CPU backend,
+    single device, persistent cache off, metrics JSONL -> out_path) and
+    return its aggregated per-tag ledger."""
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_COMPILE_CACHE": "0",
+        "PADDLE_TPU_METRICS_FILE": str(out_path),
+        "PYTHONUNBUFFERED": "1",
+        # the child is `python tools/_gate_common.py`, whose sys.path[0]
+        # is tools/ — the repo root must be importable for paddle_tpu
+        "PYTHONPATH": REPO + (os.pathsep + env["PYTHONPATH"]
+                              if env.get("PYTHONPATH") else ""),
+    })
+    env.pop("PADDLE_TPU_DEBUG_DUMP", None)
+    # determinism: one host device, whatever the parent (e.g. the
+    # 8-device test harness) had configured
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "--xla_force_host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=1"]).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--emit",
+             str(out_path)],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # infrastructure failure (exit 2), NOT a budget verdict (exit
+        # 1): a wedged workload must not read as a named regression
+        raise GateError(
+            f"canonical workload hung past {timeout}s "
+            f"(stderr tail: {(e.stderr or b'')[-500:]!r})") from None
+    if proc.returncode != 0:
+        raise GateError("canonical workload failed "
+                        f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}")
+    return aggregate(load_compile_records(out_path))
+
+
+def emit_workload():
+    """The canonical workload body (runs in the child run_workload
+    spawns; expects the env above to be set already)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=16, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        V = logits.shape[-1]
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, V]), labels.reshape([-1]))
+
+    step = TrainStep(model, loss_fn, o)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32))
+    float(step(ids, ids).item())          # train.step
+    step.run_steps(2, ids, ids)           # train.run_steps
+    stacked = paddle.to_tensor(
+        np.stack([ids.numpy(), ids.numpy()]))
+    float(step.accumulate(2, stacked, stacked).item())  # train.accumulate
+
+    # serving buckets: one AOT executable per batch bucket
+    from paddle_tpu.inference import InferenceEngine
+    paddle.seed(0)
+    eng = InferenceEngine(nn.Linear(8, 8), batch_sizes=(1, 2),
+                          name="canonical")
+    eng.warm(np.zeros((1, 8), np.float32))
+    eng.shutdown()
+
+
+def format_row(tag, parts):
+    return f"  {tag:<28} " + "  ".join(parts)
+
+
+def main(argv):
+    if argv[:1] == ["--emit"]:
+        out = argv[1] if len(argv) > 1 else None
+        if out and not os.environ.get("PADDLE_TPU_METRICS_FILE"):
+            os.environ["PADDLE_TPU_METRICS_FILE"] = out
+        emit_workload()
+        n = len(load_compile_records(
+            os.environ["PADDLE_TPU_METRICS_FILE"]))
+        print(f"canonical workload: {n} compile records -> "
+              f"{os.environ['PADDLE_TPU_METRICS_FILE']}", file=sys.stderr)
+        return 0
+    print(__doc__)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

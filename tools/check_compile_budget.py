@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Compile-budget gate: lower+compile seconds per executable, ratcheted
+against the checked-in BASELINE_HLO.json.
+
+Why: the headline bench has died five rounds in a row inside "stage:
+compile" with no per-executable attribution (ROADMAP open item 3). The
+compilation observatory (profiler/compile_observatory.py) now records
+where every compile second goes; this gate turns those records into a
+CI fence — a change that makes an executable meaningfully slower to
+lower+compile fails loudly, named, before it ever reaches a 300 s TPU
+timeout.
+
+Comparison: per baseline tag, FAIL when the tag's SLOWEST single
+compile (a real ledger legitimately carries several signatures per tag
+— tail batch, eval dtype — and N ordinary compiles must not sum into a
+fake regression) exceeds its budget:
+
+    max over signatures (lower_s+compile_s)  >  base total_s * FACTOR
+                                                + SLACK
+
+FACTOR (default 2.5) and SLACK (default 2.0 s) absorb host-load noise
+on the 2-CPU container — compile WALL time is load-sensitive, so the
+budget is deliberately generous; a real regression (a new unrolled
+layer body, a lost scan) blows through multiples, not percents.
+
+Sources (first match wins):
+  --ledger FILE.jsonl   kind:"compile" records from any metrics JSONL
+                        (e.g. a bench run's PADDLE_TPU_METRICS_FILE)
+  (default)             run the canonical workload (tools/_gate_common
+                        --emit) in a clean subprocess and gate that
+
+Ratchet: `--update` rewrites a baseline entry only when the current run
+is FASTER (and records new, unbudgeted tags); the gate itself never
+loosens the baseline. tests/test_compile_observatory.py runs this gate
+from tier-1: green on the checked-in baseline, nonzero (naming the
+executable) on an injected regression.
+
+Usage:
+  python tools/check_compile_budget.py [--baseline BASELINE_HLO.json]
+         [--ledger FILE.jsonl] [--factor 2.5] [--slack 2.0]
+         [--require-all] [--update]
+Exit 0 within budget, 1 on regression, 2 on gate failure.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _gate_common as gc  # noqa: E402
+
+
+def compare(baseline, current, factor, slack, require_all):
+    """(violations, notes, ratchet) — ratchet maps tag -> better entry."""
+    violations, notes, ratchet = [], [], {}
+    base_tags = baseline["executables"]
+    for tag in sorted(base_tags):
+        base = base_tags[tag]
+        cur = current.get(tag)
+        if cur is None:
+            msg = (f"{tag}: in baseline but not in the ledger (renamed "
+                   "executable? partial ledger?)")
+            (violations if require_all else notes).append(msg)
+            continue
+        base_total = float(base.get("total_s",
+                                    base.get("lower_s", 0.0)
+                                    + base.get("compile_s", 0.0)))
+        budget = base_total * factor + slack
+        cur_total = cur["total_s"]
+        if cur_total > budget:
+            violations.append(
+                f"{tag}: slowest lower+compile {cur_total:.2f}s exceeds "
+                f"budget {budget:.2f}s (baseline {base_total:.2f}s "
+                f"x{factor} + {slack}s slack) — attack the compile, "
+                "don't raise the budget")
+        elif cur_total < base_total:
+            ratchet[tag] = cur
+            notes.append(f"{tag}: {cur_total:.2f}s beats baseline "
+                         f"{base_total:.2f}s (ratchet with --update)")
+    for tag in sorted(set(current) - set(base_tags)):
+        notes.append(f"{tag}: new executable with no budget "
+                     f"({current[tag]['total_s']:.2f}s) — add it with "
+                     "--update")
+        ratchet[tag] = current[tag]
+    return violations, notes, ratchet
+
+
+def _entry(cur, base=None):
+    """Ratchet entry: rewrite ONLY this gate's comparands (the
+    seconds). fusion/bytes/instructions stay whatever check_fusion last
+    ratcheted — a faster compile must not launder a concurrent fusion
+    regression into the shared baseline. A NEW tag (no base) records
+    the full row so both gates have something to compare next run."""
+    entry = dict(base or {})
+    entry.update({"lower_s": round(cur["lower_s"], 3),
+                  "compile_s": round(cur["compile_s"], 3),
+                  "total_s": round(cur["total_s"], 3)})
+    if base is None:
+        entry.update({"fusion_count": int(cur["fusion_count"]),
+                      "bytes_accessed": float(cur["bytes_accessed"]),
+                      "instructions": int(cur["instructions"]),
+                      "flops": float(cur["flops"])})
+    return entry
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        "check_compile_budget",
+        description="per-executable lower+compile seconds vs "
+                    "BASELINE_HLO.json")
+    ap.add_argument("--baseline", default=gc.BASELINE_DEFAULT)
+    ap.add_argument("--ledger", default=None,
+                    help="metrics JSONL with kind:'compile' records; "
+                         "default: run the canonical workload")
+    ap.add_argument("--factor", type=float, default=float(
+        os.environ.get("PADDLE_TPU_COMPILE_BUDGET_FACTOR", "2.5")))
+    ap.add_argument("--slack", type=float, default=float(
+        os.environ.get("PADDLE_TPU_COMPILE_BUDGET_SLACK", "2.0")))
+    ap.add_argument("--require-all", action="store_true",
+                    help="every baseline executable must appear in the "
+                         "ledger (canonical-workload ledgers)")
+    ap.add_argument("--update", action="store_true",
+                    help="ratchet: rewrite baseline entries the current "
+                         "run beats; add unbudgeted tags")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = gc.load_baseline(args.baseline)
+        if args.ledger:
+            current = gc.aggregate(
+                gc.load_compile_records(args.ledger))
+        else:
+            with tempfile.TemporaryDirectory() as td:
+                current = gc.run_workload(
+                    os.path.join(td, "ledger.jsonl"))
+    except (gc.GateError, OSError) as e:
+        print(f"check_compile_budget: {e}", file=sys.stderr)
+        return 2
+
+    violations, notes, ratchet = compare(
+        baseline, current, args.factor, args.slack, args.require_all)
+
+    print("compile budget (lower+compile seconds per executable):")
+    for tag in sorted(current):
+        cur = current[tag]
+        base = baseline["executables"].get(tag, {})
+        base_s = base.get("total_s")
+        print(gc.format_row(tag, [
+            f"now {cur['total_s']:7.2f}s",
+            f"base {base_s:7.2f}s" if base_s is not None
+            else "base    none",
+            "hit" if cur["cache_hit"] else "cold"]))
+    for n in notes:
+        print(f"note: {n}")
+    if args.update and ratchet:
+        for tag, cur in ratchet.items():
+            baseline["executables"][tag] = _entry(
+                cur, baseline["executables"].get(tag))
+        gc.save_baseline(args.baseline, baseline)
+        print(f"ratcheted {len(ratchet)} entr(y/ies) -> {args.baseline}")
+    for v in violations:
+        print(f"FAIL: {v}")
+    if violations:
+        print(f"FAIL: {len(violations)} compile-budget regression(s)")
+        return 1
+    print(f"OK: {len(current)} executable(s) within compile budget")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

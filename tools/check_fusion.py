@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Fusion-regression gate: per-executable fusion counts and
+bytes-accessed vs the checked-in BASELINE_HLO.json.
+
+Why (ROADMAP open item 4; *Operator Fusion in XLA*, arxiv 2301.13062):
+XLA's fusion decisions are the difference between one fused region and
+a memory-bound chain of materialized intermediates — and they silently
+change when a model edit, a new op, or a sharding constraint breaks a
+fusion boundary. XLA's own `cost_analysis()` bytes-accessed and the
+optimized HLO's fusion count (recorded per executable by
+profiler/compile_observatory.py) are the regression signals; like
+tools/check_no_hot_sync.py, this gate fails loudly and names the
+executable instead of letting a fusion break land as a vague slowdown.
+
+Comparison: per baseline tag, FAIL when
+
+    fusion_count   >  baseline + FUSION_SLACK   (default 0: same
+                      container, same flags — the HLO is deterministic;
+                      MORE fusion regions means a region broke apart)
+    bytes_accessed >  baseline * (1 + BYTES_TOL) (default 10%)
+
+Sources and ratcheting: identical to tools/check_compile_budget.py
+(--ledger JSONL or the canonical workload; `--update` only ever
+tightens). tests/test_compile_observatory.py runs this gate from
+tier-1: green on the checked-in baseline, nonzero (naming the
+executable) on an injected fusion/bytes regression.
+
+Usage:
+  python tools/check_fusion.py [--baseline BASELINE_HLO.json]
+         [--ledger FILE.jsonl] [--fusion-slack 0] [--bytes-tol 0.10]
+         [--require-all] [--update]
+Exit 0 clean, 1 on regression, 2 on gate failure.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _gate_common as gc  # noqa: E402
+
+
+def compare(baseline, current, fusion_slack, bytes_tol, require_all):
+    """(violations, notes, ratchet) — ratchet maps tag -> better entry."""
+    violations, notes, ratchet = [], [], {}
+    base_tags = baseline["executables"]
+    for tag in sorted(base_tags):
+        base = base_tags[tag]
+        cur = current.get(tag)
+        if cur is None:
+            msg = (f"{tag}: in baseline but not in the ledger (renamed "
+                   "executable? partial ledger?)")
+            (violations if require_all else notes).append(msg)
+            continue
+        base_fusion = int(base.get("fusion_count", 0))
+        base_bytes = float(base.get("bytes_accessed", 0.0))
+        if cur["fusion_count"] > base_fusion + fusion_slack:
+            violations.append(
+                f"{tag}: fusion_count {cur['fusion_count']} > baseline "
+                f"{base_fusion} (+{fusion_slack} slack) — a fused "
+                "region broke apart; diff the HLO in the debug bundle "
+                "or compiled_text()")
+        if base_bytes and cur["bytes_accessed"] > \
+                base_bytes * (1.0 + bytes_tol):
+            violations.append(
+                f"{tag}: bytes_accessed {cur['bytes_accessed']:.3e} > "
+                f"baseline {base_bytes:.3e} * {1.0 + bytes_tol:.2f} — "
+                "the executable moves more HBM bytes per run")
+        strictly_better = (cur["fusion_count"] < base_fusion or
+                           cur["bytes_accessed"] < base_bytes)
+        no_worse = (cur["fusion_count"] <= base_fusion and
+                    cur["bytes_accessed"] <= base_bytes)
+        if strictly_better and no_worse:
+            ratchet[tag] = cur
+            notes.append(
+                f"{tag}: fusion {cur['fusion_count']} / bytes "
+                f"{cur['bytes_accessed']:.3e} beats baseline "
+                f"{base_fusion} / {base_bytes:.3e} (ratchet with "
+                "--update)")
+    for tag in sorted(set(current) - set(base_tags)):
+        notes.append(f"{tag}: new executable with no fusion baseline — "
+                     "add it with --update")
+        ratchet[tag] = current[tag]
+    return violations, notes, ratchet
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        "check_fusion",
+        description="per-executable fusion count + bytes-accessed vs "
+                    "BASELINE_HLO.json")
+    ap.add_argument("--baseline", default=gc.BASELINE_DEFAULT)
+    ap.add_argument("--ledger", default=None,
+                    help="metrics JSONL with kind:'compile' records; "
+                         "default: run the canonical workload")
+    ap.add_argument("--fusion-slack", type=int, default=int(
+        os.environ.get("PADDLE_TPU_FUSION_SLACK", "0")))
+    ap.add_argument("--bytes-tol", type=float, default=float(
+        os.environ.get("PADDLE_TPU_BYTES_TOL", "0.10")))
+    ap.add_argument("--require-all", action="store_true",
+                    help="every baseline executable must appear in the "
+                         "ledger (canonical-workload ledgers)")
+    ap.add_argument("--update", action="store_true",
+                    help="ratchet: rewrite baseline entries the current "
+                         "run beats; add unbudgeted tags")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = gc.load_baseline(args.baseline)
+        if args.ledger:
+            current = gc.aggregate(
+                gc.load_compile_records(args.ledger))
+        else:
+            with tempfile.TemporaryDirectory() as td:
+                current = gc.run_workload(
+                    os.path.join(td, "ledger.jsonl"))
+    except (gc.GateError, OSError) as e:
+        print(f"check_fusion: {e}", file=sys.stderr)
+        return 2
+
+    violations, notes, ratchet = compare(
+        baseline, current, args.fusion_slack, args.bytes_tol,
+        args.require_all)
+
+    print("fusion accounting (per executable):")
+    for tag in sorted(current):
+        cur = current[tag]
+        base = baseline["executables"].get(tag, {})
+        print(gc.format_row(tag, [
+            f"fusions {cur['fusion_count']:4d}"
+            f" (base {base.get('fusion_count', '-')})",
+            f"bytes {cur['bytes_accessed']:.3e}"
+            f" (base {float(base.get('bytes_accessed', 0.0)):.3e})"]))
+    for n in notes:
+        print(f"note: {n}")
+    if args.update and ratchet:
+        for tag, cur in ratchet.items():
+            # rewrite ONLY this gate's comparands (HLO shape: fusions /
+            # bytes / instructions / flops); the compile seconds stay
+            # whatever check_compile_budget last ratcheted — fewer
+            # fusions must not launder a slower compile into the shared
+            # baseline. A NEW tag records the full row.
+            existing = baseline["executables"].get(tag)
+            entry = dict(existing or {})
+            entry.update({
+                "fusion_count": int(cur["fusion_count"]),
+                "bytes_accessed": float(cur["bytes_accessed"]),
+                "instructions": int(cur["instructions"]),
+                "flops": float(cur["flops"])})
+            if existing is None:
+                entry.update({
+                    "lower_s": round(cur["lower_s"], 3),
+                    "compile_s": round(cur["compile_s"], 3),
+                    "total_s": round(cur["total_s"], 3)})
+            baseline["executables"][tag] = entry
+        gc.save_baseline(args.baseline, baseline)
+        print(f"ratcheted {len(ratchet)} entr(y/ies) -> {args.baseline}")
+    for v in violations:
+        print(f"FAIL: {v}")
+    if violations:
+        print(f"FAIL: {len(violations)} fusion regression(s)")
+        return 1
+    print(f"OK: {len(current)} executable(s) match the fusion baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -53,7 +53,27 @@ Schema (documented in docs/OBSERVABILITY.md):
                   requires:
                   event        str     non-empty event name
                                        (nan_detected, loss_spike,
-                                       watchdog_expired, ...)
+                                       watchdog_expired, retrace, ...)
+  kind == "compile" (one record per AOT-compiled executable signature —
+                  profiler/compile_observatory.py, fed by
+                  jit/api.aot_compile) additionally requires:
+                  tag          str     non-empty executable tag
+                                       (train.step, fleet.hybrid_step,
+                                       serve.<engine>.batch<b>, ...)
+                  signature    str     non-empty abstract-signature key
+                  lower_s      number  trace+lower seconds (>= 0)
+                  compile_s    number  XLA compile seconds (>= 0); a
+                                       cache_hit record must be near
+                                       zero (<= 10 s: a hit is a cache
+                                       LOAD, never a real compile)
+                  cache_hit    bool    persistent compile cache hit
+                  instructions int     HLO instruction count (>= 0)
+                  fusion_count int     HLO fusion ops (>= 0)
+                  bytes_accessed number  XLA cost analysis (>= 0)
+                  flops        number  XLA cost analysis (>= 0)
+                  peak_memory_bytes number  memory-analysis peak (>= 0)
+                  and optionally:
+                  op_counts    dict    {op kind: count >= 0}
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
@@ -85,6 +105,15 @@ HEALTH_REQUIRED = {"step": int, "loss": (int, float, str),
                    "update_ratio": (int, float, str),
                    "found_inf": (int, float, str)}
 EVENT_REQUIRED = {"event": str}
+COMPILE_REQUIRED = {"tag": str, "signature": str,
+                    "lower_s": (int, float), "compile_s": (int, float),
+                    "cache_hit": bool, "instructions": int,
+                    "fusion_count": int, "bytes_accessed": (int, float),
+                    "flops": (int, float),
+                    "peak_memory_bytes": (int, float)}
+# a persistent-cache HIT deserializes an artifact instead of compiling;
+# spending more than this on one is a mislabeled cold compile
+CACHE_HIT_COMPILE_S_MAX = 10.0
 # repr strings a non-finite health scalar may export as
 _NONFINITE_STRS = {"nan", "inf", "-inf"}
 
@@ -177,6 +206,42 @@ def validate_line(line, where="<line>"):
         _check_types(rec, EVENT_REQUIRED, where, errors)
         if isinstance(rec.get("event"), str) and not rec["event"]:
             errors.append(f"{where}: event name must be non-empty")
+    elif rec.get("kind") == "compile":
+        _check_types(rec, COMPILE_REQUIRED, where, errors)
+        for key in ("tag", "signature"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+
+        def _num(key):
+            v = rec.get(key)
+            return v if isinstance(v, (int, float)) and \
+                not isinstance(v, bool) else None
+
+        for key in ("lower_s", "compile_s", "bytes_accessed", "flops",
+                    "peak_memory_bytes", "instructions", "fusion_count"):
+            v = _num(key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        comp = _num("compile_s")
+        if rec.get("cache_hit") is True and comp is not None and \
+                comp > CACHE_HIT_COMPILE_S_MAX:
+            errors.append(
+                f"{where}: cache_hit record spent {comp}s in compile_s "
+                f"(> {CACHE_HIT_COMPILE_S_MAX}s) — a hit loads an "
+                "artifact, it does not compile")
+        ops = rec.get("op_counts")
+        if ops is not None:
+            if not isinstance(ops, dict):
+                errors.append(f"{where}: op_counts must be a dict, got "
+                              f"{type(ops).__name__}")
+            else:
+                for k, v in ops.items():
+                    if not isinstance(k, str) or not isinstance(v, int) \
+                            or isinstance(v, bool) or v < 0:
+                        errors.append(
+                            f"{where}: op_counts entry {k!r}: {v!r} must "
+                            "be str -> int >= 0")
+                        break
     return errors
 
 
