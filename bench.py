@@ -5,7 +5,21 @@ vs_baseline ratchets against BENCH_BASE.json (first run records the base;
 BASELINE.json carries no published numbers to compare against directly).
 On failure, prints a one-line diagnostic JSON instead of a bare traceback.
 
-Robustness contract (round-6; round-5 history in git):
+Robustness contract (round-7; earlier rounds' history in git):
+  * compile-wall attack (round-7): the FIRST attempt is scan+names —
+    scan-over-layers lowers ONE block body instead of 24, so the cold
+    compile is the short one (the unrolled record config runs second,
+    on rolled-over budget, once a headline is safe); warmup goes
+    through the background warm pipeline (paddle_tpu/jit/warm.py) so
+    the headline carries the warm-set wall-vs-sum record; BENCH_CACHE_SEED
+    names a donated cache artifact dir (tools/seed_compile_cache.py
+    pack) the parent seeds into the compile cache before any attempt —
+    a seeded round compiles nothing, and the headline says so
+    (cache_seeded / compile_cache_hits); unused seconds from a fast
+    (seeded) attempt ROLL OVER to the next attempt instead of the fixed
+    per-attempt cap, and the headline records the per-attempt compile
+    trajectory (compile_trajectory + compile_history across rounds)
+    even for attempts that timed out;
   * a persistent XLA compilation cache (repo-local .xla_cache/ by
     default; BENCH_XLA_CACHE/PADDLE_TPU_COMPILE_CACHE override — the
     same cache the framework itself enables at import, see
@@ -49,6 +63,7 @@ Robustness contract (round-6; round-5 history in git):
 import json
 import math
 import os
+import shutil
 import sys
 import tempfile
 import time
@@ -111,6 +126,17 @@ def _enable_compile_cache(jax_mod):
         jax_mod.config.update("jax_compilation_cache_dir", _CACHE_DIR)
         jax_mod.config.update("jax_persistent_cache_min_compile_time_secs", 0)
         jax_mod.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            # portable cache keys: without this, jax >= 0.4.36 hashes
+            # the absolute cache path into every key (via the GPU
+            # sub-cache debug options it plants in the dir) and a
+            # BENCH_CACHE_SEED-donated artifact can never hit — see
+            # framework/compile_cache._make_keys_portable
+            jax_mod.config.update(
+                "jax_persistent_cache_enable_xla_caches",
+                os.environ.get("PADDLE_TPU_CACHE_XLA_CACHES", "none"))
+        except Exception:
+            pass
         # keep the framework's own cache init (paddle_tpu import below)
         # pointed at the same dir
         os.environ["PADDLE_TPU_COMPILE_CACHE"] = _CACHE_DIR
@@ -124,6 +150,60 @@ def _load_state():
             return json.load(f)
     except Exception:
         return {}
+
+
+def _save_state(state):
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        with open(_STATE_PATH, "w") as f:
+            json.dump(state, f)
+    except Exception:
+        pass
+
+
+def _attempt_budget(cap, carry, remaining_s):
+    """Rollover budgeting: each attempt gets the fixed per-attempt cap
+    PLUS whatever earlier attempts left unused (a cache-seeded first
+    attempt finishing in seconds hands its whole window to the next
+    config), fenced so the parent always keeps 30 s to merge and
+    print."""
+    return min(cap + carry, remaining_s - 30)
+
+
+def _seed_cache():
+    """BENCH_CACHE_SEED: pre-populate the bench compile cache from a
+    donated artifact dir (a tools/seed_compile_cache.py pack, or any
+    raw cache dir) BEFORE any attempt launches, so a machine that has
+    never compiled this config loads someone else's compiles instead.
+    Pure file copies — the parent stays jax-free (children import the
+    framework; the parent only budgets and merges). Returns the seed
+    summary dict, or None when the env var is unset."""
+    src = os.environ.get("BENCH_CACHE_SEED")
+    if not src:
+        return None
+    info = {"source": src, "entries_seeded": 0, "entries_skipped": 0}
+    try:
+        if not os.path.isdir(src):
+            raise OSError(f"not a directory: {src}")
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        for n in sorted(os.listdir(src)):
+            if n.startswith(".") or n in ("MANIFEST.json",
+                                          "bench_state.json"):
+                continue
+            sp = os.path.join(src, n)
+            if not os.path.isfile(sp):
+                continue
+            dp = os.path.join(_CACHE_DIR, n)
+            if os.path.exists(dp):
+                info["entries_skipped"] += 1
+                continue
+            shutil.copy2(sp, dp)
+            info["entries_seeded"] += 1
+    except OSError as e:
+        # a bad seed degrades to a cold round, never a dead one
+        info["error"] = str(e)[:200]
+    print(f"bench: cache seed {info}", file=sys.stderr, flush=True)
+    return info
 
 
 def _mark_compiled(tag):
@@ -225,19 +305,22 @@ def _run():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        # Fastest measured config: unrolled blocks (scan_layers=False),
-        # no remat — 193 ms/step vs 249 ms for scan+"names" remat and
-        # 262 ms for scan+full remat. The lax.scan path OOMed without
-        # remat because it stacks residuals as [24, ...] buffers
-        # (BENCH_r02.json); unrolled, XLA schedules/frees them per layer
-        # and everything fits. ~60 s compile cold; seconds from the
-        # persistent cache. The parent orders attempts by cache state.
+        # Compile-bound default (round-7): scan_layers=True + "names"
+        # remat — XLA lowers ONE block body instead of 24, so the cold
+        # compile is minutes shorter; this is what finally gets a
+        # headline past the 300 s compile wall (five rounds of timeouts
+        # with the old unrolled-first order). The unrolled config
+        # (scan=0, remat=false) stays the runtime record holder —
+        # 193 ms/step vs 249 ms measured in r3 — but its cold compile
+        # is the longest, so the parent runs it SECOND, on rolled-over
+        # budget, once a scan headline is already in hand (seconds from
+        # the persistent cache once it has ever compiled).
         batch, seq = 8, 1024
-        remat = os.environ.get("BENCH_REMAT", "false")
+        remat = os.environ.get("BENCH_REMAT", "names")
         if remat not in ("true", "false", "names", "dots"):
             raise ValueError(f"BENCH_REMAT={remat!r}: expected "
                              "true|false|names|dots")
-        scan = os.environ.get("BENCH_SCAN", "0") == "1"
+        scan = os.environ.get("BENCH_SCAN", "1") == "1"
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=seq,
                         dropout=0.0, scan_layers=scan,
@@ -276,10 +359,17 @@ def _run():
     _phase("compile", build_s=time.perf_counter() - t_phase,
            cache_warm=cache_entries_before > 0)
 
-    # warmup (compile); sync via a data fetch — through the axon tunnel
+    # warmup through the BACKGROUND warm pipeline (jit/warm.py): the
+    # compile runs on a worker thread with the exact steady-state
+    # signature (same _prep, same donation — warming adds zero
+    # executables), jit.warm.join records the warm-set wall-vs-sum
+    # evidence, and the first real step below joins the already-warm
+    # executable. Sync via a data fetch — through the axon tunnel
     # block_until_ready returns before execution finishes, so only a
     # fetch (.item()) is a true barrier
+    from paddle_tpu.jit import warm as jwarm
     t_compile = time.perf_counter()
+    warm_summary = jwarm.join([step.warm(ids, ids)])
     for _ in range(3):
         loss = step(ids, ids)
     float(loss.item())
@@ -376,6 +466,14 @@ def _run():
         # perf provenance: warm-start + in-place-update evidence
         "compile_cache_warm": cache_entries_before > 0,
         "compile_cache_entries": _cache_entries(),
+        # entries-hit: how many executables loaded from the persistent
+        # cache (a seeded round reports all of them here) + the warm
+        # pipeline's wall-vs-sum record for this attempt's warm set
+        "compile_cache_hits": sum(
+            1 for a in _compile_ledger_table().values()
+            if a.get("cache_hit")),
+        "warm_wall_s": warm_summary["wall_s"],
+        "warm_sum_s": warm_summary["sum_s"],
         "retraces": step.retraces,
         "donated": step._donate,
         "peak_mem_bytes": int(paddle.device.max_memory_allocated()),
@@ -789,22 +887,23 @@ def main():
     def remaining():
         return total_budget - (time.perf_counter() - t_start)
 
-    # Attempt order is cache-aware: the unrolled config is the fastest at
-    # runtime (r3 record) but its cold compile is the longest; the scan
-    # config compiles one block. With a warm cache the unrolled config
-    # loads in seconds, so it goes first. On a cold cache, scan+names
-    # goes first to get A headline safely, then unrolled runs with the
-    # remaining budget and the parent reports the best.
-    state = _load_state()
-    unrolled = {}  # default env: scan=0 remat=false
-    scan_cfg = {"BENCH_REMAT": "names", "BENCH_SCAN": "1"}
+    # BENCH_CACHE_SEED: a donated compile-cache artifact pre-populates
+    # the cache before any attempt — a seeded round's compiles are
+    # loads, so the first attempt finishes fast and its unused budget
+    # rolls over to the runtime-record config below
+    seed_info = _seed_cache()
+
+    # Attempt order (round-7): scan+names FIRST, always — one lowered
+    # block body is the compile-bound default that gets A headline past
+    # the compile wall; the unrolled config (fastest at runtime, r3
+    # record, but the longest cold compile) runs second on whatever
+    # budget the first attempt left over (rollover below). With a
+    # warm/seeded cache both load in seconds and the parent reports the
+    # best.
+    scan_cfg = {}  # child defaults: scan=1 remat=names
+    unrolled = {"BENCH_SCAN": "0", "BENCH_REMAT": "false"}
     pinned = "BENCH_REMAT" in os.environ or "BENCH_SCAN" in os.environ
-    if pinned:
-        attempts = [{}]
-    elif "headline scan=False remat=false" in state:
-        attempts = [unrolled, scan_cfg]
-    else:
-        attempts = [scan_cfg, unrolled]
+    attempts = [{}] if pinned else [scan_cfg, unrolled]
 
     def _last_json(lines, pred):
         got = None
@@ -824,6 +923,9 @@ def main():
 
     best = None
     failures = []
+    trajectory = []
+    attempt_cap = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "300"))
+    carry = 0.0  # unused seconds roll over to the next attempt
     for extra in attempts:
         if best is not None and remaining() < 90:
             break  # keep what we have rather than risk the budget
@@ -831,10 +933,12 @@ def main():
             break  # off-TPU the configs are identical smoke runs
         env_view = dict(os.environ)
         env_view.update(extra)
-        tag = f"scan={env_view.get('BENCH_SCAN', '0')}" \
-              f",remat={env_view.get('BENCH_REMAT', 'false')}"
-        budget = min(int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "300")),
-                     remaining() - 30)
+        tag = f"scan={env_view.get('BENCH_SCAN', '1')}" \
+              f",remat={env_view.get('BENCH_REMAT', 'names')}"
+        # rollover budgeting: a fast (cache-seeded) first attempt's
+        # unused seconds fund the next attempt instead of evaporating
+        # into the old fixed per-attempt cap
+        budget = _attempt_budget(attempt_cap, carry, remaining())
         if budget < 60:
             # budget floor: launching an attempt the driver will kill
             # anyway would overrun BENCH_TOTAL_BUDGET — record why and
@@ -845,10 +949,37 @@ def main():
                 "evidence": [f"total budget exhausted "
                              f"({round(remaining())}s remaining)"]})
             break
+        t_attempt = time.perf_counter()
         rc, json_lines, err_tail, last_phase = _stream_child(extra, budget)
+        carry = max(0.0, budget - (time.perf_counter() - t_attempt))
         result = _last_json(
             json_lines,
             lambda c: c.get("metric") and c.get("value", 0) > 0)
+        # phase breakdown even for a timed-out child (streamed over
+        # stderr) or a crashed one (embedded in its diagnostic JSON)
+        diag = _last_json(json_lines, lambda c: "phases" in c)
+        phases = (result or diag or {}).get("phases") or last_phase or {}
+        # per-attempt compile trajectory — recorded success, crash, and
+        # timeout alike: the per-executable compiles that finished, the
+        # one still compiling when the attempt died (the bench-phase
+        # stream keeps both through SIGKILL), and the attempt's compile
+        # seconds (the full warmup when it got that far, else the sum
+        # of the finished compiles)
+        compiles = phases.get("compiles") or []
+        compile_s = phases.get("compile_warmup_s")
+        if compile_s is None:
+            compile_s = round(sum(c.get("lower_s", 0.0)
+                                  + c.get("compile_s", 0.0)
+                                  for c in compiles), 2)
+        trajectory.append({
+            "attempt": tag,
+            "rc": "ok" if result else rc,
+            "budget_s": round(budget),
+            "compile_s": compile_s,
+            "cache_hit": bool(phases.get("compile_cache_hit", False)),
+            "compiling": phases.get("compiling"),
+            "compiles": compiles[-8:],
+        })
         if result:
             if best is None or result["value"] > best["value"]:
                 best = result
@@ -859,20 +990,42 @@ def main():
                     # tail, HLO, thread stacks) landed — if it got far
                     # enough to write one
                     "debug_bundle": os.environ["PADDLE_TPU_DEBUG_DUMP"]}
-            # phase breakdown even for a timed-out child (streamed over
-            # stderr) or a crashed one (embedded in its diagnostic JSON)
-            diag = _last_json(json_lines, lambda c: "phases" in c)
-            phases = (diag or {}).get("phases") or last_phase
             if phases:
                 fail["phases"] = phases
             failures.append(fail)
+
+    # compile-seconds trajectory ACROSS rounds: append this round's
+    # attempts to the state file's bounded history, so round N+1's
+    # headline (and a human reading bench_state.json) sees the compile
+    # wall shrinking — or not — over time
+    state = _load_state()
+    history = state.get("compile_history", [])
+    history.append({
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "cache_seeded": bool(seed_info
+                             and seed_info.get("entries_seeded")),
+        "attempts": [{k: t[k] for k in
+                      ("attempt", "rc", "compile_s", "cache_hit")}
+                     for t in trajectory]})
+    state["compile_history"] = history[-10:]
+    _save_state(state)
+
     if best is None:
         print(json.dumps({
             "metric": "gpt_medium_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
             "error": "all attempts failed (compile congestion?)",
-            "attempts": failures}), flush=True)
+            "attempts": failures,
+            "cache_seed": seed_info,
+            "compile_trajectory": trajectory,
+            "compile_history": state["compile_history"]}), flush=True)
         raise SystemExit(1)
+    best["cache_seeded"] = bool(seed_info
+                                and seed_info.get("entries_seeded"))
+    if seed_info:
+        best["cache_seed"] = seed_info
+    best["compile_trajectory"] = trajectory
+    best["compile_history"] = state["compile_history"]
 
     # flagship side metric, strictly after the headline is safe and only
     # with budget to spare; its JSON goes to stderr so a kill mid-run

@@ -26,6 +26,7 @@ from ...framework.random import split_key
 from ...jit.api import (functional_call, state_arrays, aot_compile,
                         count_train_use, export_step_metrics,
                         HealthMonitorMixin, _step_arg_names)
+from ...jit import warm as _warm
 from ...jit.deferred import DeferredLoss
 from ...profiler import statistic as _stat
 from ...profiler import monitor as _monitor
@@ -312,6 +313,28 @@ class HybridTrainStep(HealthMonitorMixin):
                 step_i, *arrays)
         return sig, args
 
+    def _warm_submit(self, sig, args, n_batch, inline=False):
+        """Single-flight compile of this signature's SPMD executable
+        (jit/warm.py submit_cached) — shared by `warm()` (background)
+        and the dispatch/inspection paths (`inline=True`: compile on
+        the calling thread rather than queue behind background warms),
+        so a warm in flight is always joined, never duplicated."""
+        return _warm.submit_cached(
+            self._exec, sig, "fleet.hybrid_step",
+            lambda: aot_compile(self._jitted, args,
+                                tag="fleet.hybrid_step",
+                                arg_names=_step_arg_names(n_batch)),
+            inline=inline)
+
+    def warm(self, *batch):
+        """Start a BACKGROUND AOT compile of the hybrid SPMD executable
+        for exactly this batch signature (same `_prep`, same shardings
+        and donation as dispatch — warming adds zero executables beyond
+        steady state) and return a `jit.warm.WarmHandle`. The first
+        `__call__` with this signature joins the in-flight compile."""
+        sig, args = self._prep(batch, self._step_i + 1)
+        return self._warm_submit(sig, args, len(batch))
+
     def __call__(self, *batch):
         self._step_i += 1
         sig, args = self._prep(batch, self._step_i)
@@ -321,9 +344,8 @@ class HybridTrainStep(HealthMonitorMixin):
             entry = self._exec.get(sig)
             compiled_now = entry is None
             if compiled_now:
-                entry = self._exec[sig] = aot_compile(
-                    self._jitted, args, tag="fleet.hybrid_step",
-                    arg_names=_step_arg_names(len(batch)))
+                entry = self._warm_submit(sig, args, len(batch),
+                                          inline=True).result()
             compiled, info = entry
             count_train_use(self, info)
             try:
@@ -379,9 +401,8 @@ class HybridTrainStep(HealthMonitorMixin):
         sig, args = self._prep(batch, self._step_i + 1)
         entry = self._exec.get(sig)
         if entry is None:
-            entry = self._exec[sig] = aot_compile(
-                self._jitted, args, tag="fleet.hybrid_step",
-                arg_names=_step_arg_names(len(batch)))
+            entry = self._warm_submit(sig, args, len(batch),
+                                      inline=True).result()
         return entry[0]
 
     def sync_to_model(self):

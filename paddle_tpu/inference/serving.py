@@ -435,17 +435,65 @@ class InferenceEngine(_SchedulerLifecycle):
     def warm(self, *example):
         """AOT-compile one executable per batch bucket for this
         example's signature (trailing shape/dtype after seq bucketing;
-        the example's own leading dim is ignored). Returns the number of
-        executables compiled NOW — already-warm buckets are free, and
-        with the persistent compile cache (PR 1) even a fresh process
-        reloads instead of recompiling. Call once per distinct input
-        signature before serving; steady state then never retraces."""
+        the example's own leading dim is ignored) — CONCURRENTLY, on the
+        background compile executor (jit/warm.py): the ladder's buckets
+        are independent programs, so the warm set's wall clock is
+        roughly the slowest single compile, not the sum (one
+        `kind:"warm"` metrics record carries the wall-vs-sum evidence).
+        Blocks until every bucket is ready; `warm_async` is the
+        non-blocking variant. Returns the number of executables
+        compiled NOW — already-warm buckets are free, and with the
+        persistent compile cache (PR 1) even a fresh process reloads
+        instead of recompiling. Call once per distinct input signature
+        before serving; steady state then never retraces."""
+        from ..jit import warm as _warm
+        handles = self.warm_async(*example)
+        _warm.join(handles)
+        return sum(1 for h in handles if h.fresh)
+
+    def warm_async(self, *example):
+        """Submit one background AOT compile per batch bucket and
+        return the list of `jit.warm.WarmHandle`s WITHOUT blocking —
+        serving can start immediately (a request for a still-compiling
+        bucket joins its flight), and the caller can overlap its own
+        startup work with the compiles. Join with
+        `jit.warm.join(handles)` for the warm-set overlap record."""
         arrays = [_to_ndarray(a) for a in example]
-        compiled_now = 0
-        for b in self.ladder.batch_sizes:
-            if self._ensure_compiled(self._bucket_specs(arrays, b))[1]:
-                compiled_now += 1
-        return compiled_now
+        return [self._submit_bucket(self._bucket_specs(arrays, b))
+                for b in self.ladder.batch_sizes]
+
+    def _submit_bucket(self, specs, inline=False):
+        """Single-flight compile of one bucket's executable
+        (jit/warm.py submit_cached); an already-compiled bucket returns
+        an instantly-done handle. `inline=True` is the lazy-dispatch
+        path: compile on the calling thread rather than queue behind
+        the other buckets' background warms."""
+        from ..jit import warm as _warm
+        from ..jit.api import aot_compile
+        sig = self._sig(specs)
+        # tag: debug bundles dump this bucket's HLO + cost analysis
+        # (flight recorder executable registry)
+        bucket = specs[0].shape[0] if specs else 0
+        tag = f"serve.{self.name}.batch{bucket}"
+
+        def thunk():
+            return aot_compile(self._jitted, tuple(specs), tag=tag,
+                               arg_names=tuple(
+                                   f"input{i}"
+                                   for i in range(len(specs))))
+
+        def install(entry):
+            # runs before the flight closes: the bookkeeping must count
+            # each bucket exactly once even when warm() raced a lazy
+            # dispatch
+            with self._compile_lock:
+                if sig not in self._exec:
+                    self._exec[sig] = entry
+                    self.retraces += 1
+                    _monitor.counter("serve.retraces").inc()
+
+        return _warm.submit_cached(self._exec, sig, tag, thunk,
+                                   install=install, inline=inline)
 
     def _bucket_specs(self, arrays, b):
         """ShapeDtypeStructs of the padded batch for bucket b."""
@@ -464,30 +512,17 @@ class InferenceEngine(_SchedulerLifecycle):
         return tuple((tuple(s.shape), str(s.dtype)) for s in specs)
 
     def _ensure_compiled(self, specs):
-        """(executable entry, compiled_now). Serialized against the
-        concurrent warm()-vs-lazy-dispatch race: without the lock both
-        threads could compile (and count) the same bucket twice."""
+        """(executable entry, compiled_now). The warm pipeline's
+        single-flight table replaces the old big compile lock: a lazy
+        dispatch racing warm() (or another dispatch) JOINS the one
+        in-flight compile — blocking only on the bucket it needs while
+        other buckets keep compiling concurrently."""
         sig = self._sig(specs)
         entry = self._exec.get(sig)
         if entry is not None:
             return entry, False
-        from ..jit.api import aot_compile
-        with self._compile_lock:
-            entry = self._exec.get(sig)
-            if entry is not None:
-                return entry, False
-            # tag: debug bundles dump this bucket's HLO + cost analysis
-            # (flight recorder executable registry)
-            bucket = specs[0].shape[0] if specs else 0
-            entry = aot_compile(self._jitted, tuple(specs),
-                                tag=f"serve.{self.name}.batch{bucket}",
-                                arg_names=tuple(
-                                    f"input{i}"
-                                    for i in range(len(specs))))
-            self._exec[sig] = entry
-            self.retraces += 1
-            _monitor.counter("serve.retraces").inc()
-            return entry, True
+        handle = self._submit_bucket(specs, inline=True)
+        return handle.result(), handle.fresh
 
     # -- scheduler core --------------------------------------------------
     def _key_of(self, arrays):

@@ -1,6 +1,8 @@
 """paddle.jit namespace. Parity: python/paddle/jit/__init__.py."""
 from .api import to_static, not_to_static, TrainStep, functional_call, \
     StaticFunction, DeferredLoss
+from . import warm
+from .warm import WarmHandle
 from .save_load import save, load, TranslatedLayer, InputSpec
 from .debug import TracedLayer, ProgramTranslator, set_code_level, \
     set_verbosity, get_code_level, get_verbosity

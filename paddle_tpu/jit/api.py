@@ -14,6 +14,7 @@ differentiation on this path).
 """
 import collections
 import functools
+import threading
 import time
 
 import numpy as np
@@ -28,10 +29,22 @@ from ..profiler import cost as _cost
 from ..profiler import flight_recorder as _flight
 from ..profiler import compile_observatory as _observatory
 from .deferred import DeferredLoss
+from . import warm as _warm
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
            "aot_compile", "count_train_use", "export_step_metrics",
            "DeferredLoss", "HealthMonitorMixin"]
+
+# Tracing binds tracer values into SHARED layer state (_bind swaps
+# Parameter slots, dy2static swaps layer.forward, aux-loss records live
+# on sublayers) — so two programs over one model must not LOWER
+# concurrently, or each trace would read the other's tracers. The warm
+# pipeline (jit/warm.py) therefore serializes the trace/lower phase
+# under this lock; it costs almost nothing (lowering is GIL-bound
+# Python anyway) while the expensive XLA compiles overlap freely on the
+# background workers. RLock: a traced forward may re-enter
+# functional_call (nested functional layers).
+_trace_lock = threading.RLock()
 
 
 def aot_compile(jitted, args, tag=None, static=None, arg_names=None):
@@ -74,19 +87,25 @@ def aot_compile(jitted, args, tag=None, static=None, arg_names=None):
     t0 = time.perf_counter()
     _stat.begin_span("jit.trace_lower")
     try:
-        lowered = jitted.lower(*args)
+        # tracing mutates shared layer state — serialize the lower
+        # phase across the warm executor's workers; the XLA compile
+        # below runs unlocked (GIL-released C++) and overlaps freely
+        with _trace_lock:
+            lowered = jitted.lower(*args)
     finally:
         lower_s = _stat.end_span()
-    cache_on = _cc.cache_dir() is not None
-    entries_before = _cc.cache_entry_names() if cache_on else frozenset()
     _stat.begin_span("jit.compile")
     try:
-        compiled = lowered.compile()
+        # hit/miss attributed per compile via jax's own per-thread
+        # cache events — exact even with concurrent compiles, where a
+        # bare entry-set diff would blame one compile's new on-disk
+        # entry on another's window
+        with _cc.observe_compile() as obs:
+            compiled = lowered.compile()
     finally:
         compile_s = _stat.end_span()
-    added = (_cc.cache_entry_names() - entries_before) if cache_on \
-        else frozenset()
-    cache_hit = cache_on and not added
+    cache_hit = obs.cache_on and obs.cache_hit
+    added = obs.entries_added
     total = time.perf_counter() - t0
     _monitor.counter("jit.retraces").inc()
     _monitor.counter("jit.cache_hit" if cache_hit
@@ -678,7 +697,14 @@ class TrainStep(HealthMonitorMixin):
         executable-cache lookup with optional LRU bound, AOT compile on
         miss, retrace accounting, timed dispatch. `static`/`arg_names`
         feed the compilation observatory's signature + forensics.
-        Returns (outputs, info, compiled_now, dispatch_s)."""
+
+        A miss goes through the warm pipeline's single-flight table
+        (jit/warm.py): if `warm()`/`warm_run_steps()`/`warm_accumulate()`
+        already has this executable compiling in the background, the
+        dispatch JOINS that compile — blocking only on the one
+        executable it actually needs, never duplicating the work or the
+        ledger record. Returns (outputs, info, compiled_now,
+        dispatch_s)."""
         _flight.heartbeat(self._step_i)  # watchdog liveness pulse
         _stat.begin_span(span)
         try:
@@ -687,9 +713,13 @@ class TrainStep(HealthMonitorMixin):
             if compiled_now:
                 if max_entries and len(cache) >= max_entries:
                     cache.pop(next(iter(cache)))  # bound compile growth
-                entry = cache[sig] = aot_compile(make_jitted(), args,
-                                                 tag=span, static=static,
-                                                 arg_names=arg_names)
+                # inline=True: a dispatch miss compiles on THIS thread
+                # when it wins the single-flight race — never queued
+                # behind unrelated background warms; if a warm already
+                # has this executable in flight, join it instead
+                entry = self._warm_submit(
+                    cache, sig, make_jitted, span, args, static=static,
+                    arg_names=arg_names, inline=True).result()
             else:  # LRU: re-insert so cycling signatures don't thrash
                 cache[sig] = cache.pop(sig)
             compiled, info = entry
@@ -727,24 +757,11 @@ class TrainStep(HealthMonitorMixin):
             dispatch_s = _stat.end_span()
         return out, info, compiled_now, dispatch_s
 
-    def run_steps(self, n, *batch, data_per_step=False):
-        """Run `n` optimizer steps in ONE XLA dispatch (lax.scan over the
-        step body) and return the per-step losses as a Tensor of shape [n].
-
-        The TPU-native analogue of the reference executor running many
-        iterations per `Executor.run` call (ref python/paddle/fluid/
-        executor.py): the whole loop lives on device, so per-step host
-        dispatch (and, under a remote/tunneled TPU, per-step round-trip
-        latency) disappears. Best for small/host-bound models. For models
-        whose params+optimizer state dominate HBM, per-step `__call__`
-        with buffer donation can be faster: XLA double-buffers a while-
-        loop carry, where donated per-dispatch buffers update in place
-        (measured 3.3x on the 355M-param bench config). With `data_per_step=True` every batch array
-        carries a leading `n` dimension holding one micro-batch per step;
-        otherwise the same batch is reused each step (benchmarking/
-        overfit-sanity loops). The learning rate is frozen at its current
-        scheduler value for the scanned segment; call `scheduler.step()`
-        between segments for piecewise schedules."""
+    def _prep_run_steps(self, n, batch, data_per_step):
+        """(sig, make_jitted, static, arrays) for one scanned-steps
+        program — the ONE place run_steps' signature and program factory
+        are built, shared by `run_steps` and `warm_run_steps` so a
+        warmed executable is exactly the one dispatch will use."""
         arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         if data_per_step:
@@ -757,12 +774,9 @@ class TrainStep(HealthMonitorMixin):
                         f"on every batch array, got shape {a.shape} — a "
                         "traced gather would silently clamp short arrays "
                         "to their last micro-batch")
-        key = split_key()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        base = jnp.asarray(self._step_i + 1, jnp.int32)
         # NOTE: n (and the batch shapes) are static — each distinct
         # signature compiles its own scanned program, kept in a small
-        # cache below; prefer a fixed segment length plus a per-step tail
+        # cache; prefer a fixed segment length plus a per-step tail
         sig = (n, bool(data_per_step),
                tuple((a.shape, str(a.dtype)) for a in arrays))
 
@@ -789,12 +803,40 @@ class TrainStep(HealthMonitorMixin):
             return jax.jit(
                 multi, donate_argnums=(0, 1, 2) if self._donate else ())
 
-        args = (self.params, self.opt_state, self.scaler_state,
+        static = {"n": n, "data_per_step": bool(data_per_step)}
+        return sig, make_jitted, static, arrays
+
+    def _run_steps_args(self, arrays):
+        key = split_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        base = jnp.asarray(self._step_i + 1, jnp.int32)
+        return (self.params, self.opt_state, self.scaler_state,
                 self.buffers, key, lr, base, *arrays)
+
+    def run_steps(self, n, *batch, data_per_step=False):
+        """Run `n` optimizer steps in ONE XLA dispatch (lax.scan over the
+        step body) and return the per-step losses as a Tensor of shape [n].
+
+        The TPU-native analogue of the reference executor running many
+        iterations per `Executor.run` call (ref python/paddle/fluid/
+        executor.py): the whole loop lives on device, so per-step host
+        dispatch (and, under a remote/tunneled TPU, per-step round-trip
+        latency) disappears. Best for small/host-bound models. For models
+        whose params+optimizer state dominate HBM, per-step `__call__`
+        with buffer donation can be faster: XLA double-buffers a while-
+        loop carry, where donated per-dispatch buffers update in place
+        (measured 3.3x on the 355M-param bench config). With `data_per_step=True` every batch array
+        carries a leading `n` dimension holding one micro-batch per step;
+        otherwise the same batch is reused each step (benchmarking/
+        overfit-sanity loops). The learning rate is frozen at its current
+        scheduler value for the scanned segment; call `scheduler.step()`
+        between segments for piecewise schedules."""
+        sig, make_jitted, static, arrays = self._prep_run_steps(
+            n, batch, data_per_step)
+        args = self._run_steps_args(arrays)
         out, info, compiled_now, dt = self._dispatch(
             self._scan_jit, sig, make_jitted, args, "train.run_steps",
-            max_entries=8,
-            static={"n": n, "data_per_step": bool(data_per_step)},
+            max_entries=8, static=static,
             arg_names=_step_arg_names(len(arrays)))
         losses, self.params, self.opt_state, self.scaler_state = out
         # telemetry keeps dispatch-only time: the first call's span also
@@ -845,6 +887,26 @@ class TrainStep(HealthMonitorMixin):
             return out_loss, new_params, new_state, new_scaler
         return acc_fn
 
+    def _prep_accumulate(self, k, batch):
+        """(sig, make_jitted, arrays) for one scanned-accumulation
+        program — shared by `accumulate` and `warm_accumulate` so the
+        warmed executable is exactly the one dispatch will use."""
+        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        for a in arrays:
+            if a.ndim == 0 or a.shape[0] != k:
+                raise ValueError(
+                    f"accumulate(k={k}) needs a leading microbatch dim of "
+                    f"{k} on every batch array, got shape {a.shape}")
+        sig = (k, tuple((a.shape, str(a.dtype)) for a in arrays))
+
+        def make_jitted():
+            return jax.jit(
+                self._make_acc_fn(k),
+                donate_argnums=(0, 1, 2) if self._donate else ())
+
+        return sig, make_jitted, arrays
+
     def accumulate(self, k, *batch):
         """ONE optimizer update from `k` scanned microbatches in ONE XLA
         dispatch. Every batch array carries a leading dim of `k` (one
@@ -854,13 +916,7 @@ class TrainStep(HealthMonitorMixin):
         for mean-reduced losses, with only one microbatch's activations
         live at a time. Params/opt/scaler state stay donated. This is
         what `hapi.Model.fit(accumulate_grad_batches=k)` dispatches."""
-        arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
-                  for b in batch]
-        for a in arrays:
-            if a.ndim == 0 or a.shape[0] != k:
-                raise ValueError(
-                    f"accumulate(k={k}) needs a leading microbatch dim of "
-                    f"{k} on every batch array, got shape {a.shape}")
+        sig, make_jitted, arrays = self._prep_accumulate(k, batch)
         if k == 1:
             return self(*[a[0] for a in arrays])
         self._step_i += 1
@@ -868,12 +924,6 @@ class TrainStep(HealthMonitorMixin):
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         args = (self.params, self.opt_state, self.scaler_state,
                 self.buffers, key, lr, self._step_i, *arrays)
-        sig = (k, tuple((a.shape, str(a.dtype)) for a in arrays))
-
-        def make_jitted():
-            return jax.jit(
-                self._make_acc_fn(k),
-                donate_argnums=(0, 1, 2) if self._donate else ())
 
         out, info, compiled_now, dispatch_s = self._dispatch(
             self._acc_jit, sig, make_jitted, args, "train.accumulate",
@@ -910,6 +960,64 @@ class TrainStep(HealthMonitorMixin):
                 step_i, *arrays)
         return sig, args
 
+    # -- background warmup (the compile pipeline, jit/warm.py) -----------
+    def _warm_submit(self, cache, sig, make_jitted, tag, args,
+                     static=None, arg_names=None, inline=False):
+        """Single-flight compile of one executable (warm.submit_cached):
+        background for warm() calls, `inline=True` for dispatch-path
+        misses (the caller needs this executable NOW and must not queue
+        behind unrelated background warms); either way a racer joins
+        the one flight, and the entry installs into `cache` before the
+        flight closes."""
+        return _warm.submit_cached(
+            cache, sig, tag,
+            lambda: aot_compile(make_jitted(), args, tag=tag,
+                                static=static, arg_names=arg_names),
+            inline=inline)
+
+    def warm(self, *batch):
+        """Start a BACKGROUND AOT compile of the per-step executable for
+        exactly this batch signature and return a `jit.warm.WarmHandle`
+        — the host keeps doing useful work (building data pipelines,
+        warming OTHER executables) while XLA compiles on a worker
+        thread; the first `__call__` with this signature joins the
+        in-flight compile instead of recompiling. Because the signature
+        comes from the same `_prep` as dispatch (same shapes, dtypes,
+        shardings, donation), warming adds ZERO executables beyond the
+        steady-state set — provable from the compilation observatory's
+        ledger. Join a whole warm set with `jit.warm.join(handles)`,
+        which also records the wall-vs-sum overlap evidence."""
+        sig, args = self._prep(batch, self._step_i + 1)
+        return self._warm_submit(self._exec, sig, lambda: self._jitted,
+                                 "train.step", args,
+                                 arg_names=_step_arg_names(len(batch)))
+
+    def warm_run_steps(self, n, *batch, data_per_step=False):
+        """Background-compile the `run_steps(n, ...)` scanned program
+        for this signature (see `warm`)."""
+        sig, make_jitted, static, arrays = self._prep_run_steps(
+            n, batch, data_per_step)
+        args = self._run_steps_args(arrays)
+        return self._warm_submit(self._scan_jit, sig, make_jitted,
+                                 "train.run_steps", args, static=static,
+                                 arg_names=_step_arg_names(len(arrays)))
+
+    def warm_accumulate(self, k, *batch):
+        """Background-compile the `accumulate(k, ...)` scanned program
+        for this signature (see `warm`). k == 1 warms the per-step
+        executable, mirroring the dispatch path."""
+        sig, make_jitted, arrays = self._prep_accumulate(k, batch)
+        if k == 1:
+            return self.warm(*[a[0] for a in arrays])
+        args = (self.params, self.opt_state, self.scaler_state,
+                self.buffers, split_key(),
+                jnp.asarray(self.optimizer.get_lr(), jnp.float32),
+                self._step_i + 1, *arrays)
+        return self._warm_submit(self._acc_jit, sig, make_jitted,
+                                 "train.accumulate", args,
+                                 static={"k": k},
+                                 arg_names=_step_arg_names(len(arrays)))
+
     def __call__(self, *batch):
         self._step_i += 1
         sig, args = self._prep(batch, self._step_i)
@@ -943,9 +1051,11 @@ class TrainStep(HealthMonitorMixin):
         sig, args = self._prep(batch, self._step_i + 1)
         entry = self._exec.get(sig)
         if entry is None:
-            entry = self._exec[sig] = aot_compile(
-                self._jitted, args, tag="train.step",
-                arg_names=_step_arg_names(len(batch)))
+            # single-flight with any in-flight warm of this signature
+            entry = self._warm_submit(
+                self._exec, sig, lambda: self._jitted, "train.step",
+                args, arg_names=_step_arg_names(len(batch)),
+                inline=True).result()
         return entry[0]
 
     def sync_to_model(self):

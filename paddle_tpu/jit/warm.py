@@ -1,0 +1,269 @@
+"""The warm pipeline: background, deduplicated AOT compilation.
+
+Why this exists: the headline bench died five rounds in a row inside a
+serial XLA compile (ROADMAP open item 3) — every executable the process
+needs (train step, scanned run_steps/accumulate flavors, one serving
+executable per bucket) compiled one after another, on the critical
+path, before the first step could run. Compilation is embarrassingly
+parallel across *distinct* executables (XLA releases the GIL; only the
+Python trace/lower phase is interpreter-bound), so this module turns
+the compile wall into an overlapped background activity:
+
+- **a bounded compile executor** — `submit()` runs compile thunks on
+  background threads (`PADDLE_TPU_COMPILE_WORKERS`, default
+  min(4, cpu_count)); `TrainStep.warm*()`, `HybridTrainStep.warm()`,
+  and `InferenceEngine.warm()/warm_async()` all feed it.
+
+- **single-flight dedup** — in-flight compiles are keyed by
+  (owner, signature): a second request for the same executable —
+  another warm() call, or the train loop dispatching before the warm
+  landed — JOINS the in-flight compile instead of starting a duplicate,
+  so the compilation observatory's ledger records exactly one
+  `kind:"compile"` record per executable and dispatch blocks only on
+  the one executable it actually needs.
+
+- **provable overlap** — `join(handles)` resolves a warm set and
+  exports one `kind:"warm"` metrics record with the set's wall-clock
+  (first submit -> last done) next to the sum of per-executable
+  lower+compile seconds; wall ≈ max(single compile) rather than the sum
+  is the overlap proof, and tools/check_compile_budget.py ratchets the
+  canonical workload's warm-set wall seconds against BASELINE_HLO.json.
+
+Metrics: `warm.submitted` / `warm.joined` (dedup hits) counters,
+`warm.inflight` gauge, `warm.wall_s` histogram, and the
+`warm.seeded_entries` counter from compile-cache seeding
+(framework/compile_cache.seed_from). docs/PERFORMANCE.md "Killing the
+compile wall" is the operator guide.
+"""
+import concurrent.futures
+import os
+import threading
+import time
+
+__all__ = ["WarmHandle", "submit", "submit_cached", "done_handle",
+           "join", "workers", "inflight_count", "shutdown"]
+
+_lock = threading.Lock()
+_inflight = {}          # (owner-key, sig) -> WarmHandle, while compiling
+_executor_holder = []
+
+
+def workers():
+    """Background compile threads (>= 1). Overridden by
+    PADDLE_TPU_COMPILE_WORKERS; the default saturates the host's cores
+    up to 4 — compile throughput is XLA-bound (GIL released), so more
+    workers than cores only adds contention."""
+    env = os.environ.get("PADDLE_TPU_COMPILE_WORKERS", "")
+    try:
+        n = int(env) if env else min(4, os.cpu_count() or 1)
+    except ValueError:
+        n = min(4, os.cpu_count() or 1)
+    return max(1, n)
+
+
+def _executor():
+    with _lock:
+        if not _executor_holder:
+            _executor_holder.append(
+                concurrent.futures.ThreadPoolExecutor(
+                    max_workers=workers(),
+                    thread_name_prefix="aot-warm"))
+        return _executor_holder[0]
+
+
+class WarmHandle:
+    """One background (or already-finished) compile: `result()` blocks
+    until the executable is ready and returns the (compiled, info)
+    entry `jit.api.aot_compile` built. `fresh` says whether THIS handle
+    ran a compile (False: the executable was already in its owner's
+    cache when warm was requested — it contributes zero seconds to a
+    warm set's sums)."""
+
+    def __init__(self, tag, fresh=True):
+        self.tag = tag
+        self.fresh = fresh
+        self.submit_ts = time.perf_counter()
+        self.done_ts = None
+        self._done = threading.Event()
+        self._entry = None
+        self._error = None
+
+    def _finish(self, entry, error):
+        self._entry, self._error = entry, error
+        self.done_ts = time.perf_counter()
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """The (compiled, info) entry; re-raises the compile's error.
+        This is the ONLY blocking point a warmed dispatch pays — and
+        only for as long as its own executable is still compiling."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"warm compile of {self.tag!r} still running after "
+                f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._entry
+
+    @property
+    def info(self):
+        """The compile's info dict (lower_s/compile_s/cache_hit/...) —
+        None until done or failed."""
+        return self._entry[1] if self._entry is not None else None
+
+
+def done_handle(tag, entry):
+    """An already-resolved handle for an executable that was warm before
+    the request (fresh=False): joins uniformly with in-flight handles,
+    contributes zero cost to the warm-set record."""
+    h = WarmHandle(tag, fresh=False)
+    h._finish(entry, None)
+    return h
+
+
+def submit(key, tag, thunk, install=None, inline=False):
+    """Run `thunk` (an `aot_compile` closure returning (compiled, info))
+    on the compile executor, single-flight per `key`: while a compile
+    for `key` is in flight every further submit returns the SAME handle
+    (`warm.joined` counts those), so two threads requesting one
+    (tag, signature) produce one compile and one ledger record.
+
+    `install` runs with the finished entry BEFORE the key leaves the
+    single-flight table — the owner's executable cache is populated
+    first, so a concurrent dispatcher either joins the flight or finds
+    the cached entry, never a gap in between. `key` must embed the
+    owner (e.g. `id` of the owner's executable cache): tags alone
+    collide across instances sharing a tag (two TrainSteps are two
+    different programs both tagged "train.step").
+
+    `inline=True` (the DISPATCH-path miss) runs the thunk on the
+    calling thread when this submit wins the single-flight race — the
+    caller needs this executable NOW and must not queue behind
+    unrelated background warms on a saturated executor; racers still
+    join the registered handle either way. When the race is lost, the
+    caller simply joins the existing flight (its own executable is
+    already compiling — there is nothing faster to do).
+
+    Returns (handle, submitted_now)."""
+    from ..profiler import monitor as _monitor
+    with _lock:
+        h = _inflight.get(key)
+        if h is not None:
+            _monitor.counter("warm.joined").inc()
+            return h, False
+        h = WarmHandle(tag)
+        _inflight[key] = h
+        _monitor.counter("warm.submitted").inc()
+        _monitor.gauge("warm.inflight").set(len(_inflight))
+
+    def run():
+        entry, error = None, None
+        try:
+            entry = thunk()
+            if install is not None:
+                install(entry)
+        except BaseException as e:  # joiners must see the real error
+            error = e
+        finally:
+            h._finish(entry, error)
+            with _lock:
+                # the handle memoizes only while in flight: afterwards
+                # the owner's cache serves, and a dead owner's id can
+                # be reused without aliasing into a stale executable
+                _inflight.pop(key, None)
+                _monitor.gauge("warm.inflight").set(len(_inflight))
+
+    if inline:
+        run()
+    else:
+        _executor().submit(run)
+    return h, True
+
+
+def submit_cached(cache, sig, tag, thunk, install=None, inline=False):
+    """Single-flight submit keyed to an owner's executable cache — the
+    ONE miss path TrainStep / HybridTrainStep / InferenceEngine share:
+    an entry already in `cache` returns an instantly-done handle
+    (fresh=False, zero warm-set cost); otherwise the compile runs
+    single-flight under `(id(cache), sig)` and installs into
+    `cache[sig]` before the flight closes. `install` overrides the
+    default `cache.setdefault(sig, entry)` when the owner has extra
+    bookkeeping (serving counts bucket retraces under its lock);
+    `inline` is the dispatch-path flag (see `submit`)."""
+    entry = cache.get(sig)
+    if entry is not None:
+        return done_handle(tag, entry)
+    if install is None:
+        def install(entry):
+            cache.setdefault(sig, entry)
+    handle, _ = submit((id(cache), sig), tag, thunk, install=install,
+                       inline=inline)
+    return handle
+
+
+def inflight_count():
+    with _lock:
+        return len(_inflight)
+
+
+def join(handles, timeout=None, record=True, tags_limit=16):
+    """Resolve a warm set: block until every handle is done and return
+    the summary {n_executables, compiled_now, cache_hits, wall_s,
+    sum_s, tags}. wall_s spans first submit -> last done across the
+    set; sum_s is the Σ of each FRESH handle's lower_s + compile_s —
+    wall_s well under sum_s is the overlap proof the compile-budget
+    gate ratchets. With `record` (default) the summary is exported as
+    one `kind:"warm"` metrics record (schema:
+    tools/check_metrics_schema.py) and observed on `warm.wall_s`."""
+    from ..profiler import monitor as _monitor
+    seen, uniq = set(), []
+    for h in handles:
+        if id(h) not in seen:
+            seen.add(id(h))
+            uniq.append(h)
+    deadline = None if timeout is None else time.perf_counter() + timeout
+    errors = []
+    for h in uniq:
+        left = None if deadline is None \
+            else max(deadline - time.perf_counter(), 0.0)
+        try:
+            h.result(left)
+        except Exception as e:
+            errors.append((h.tag, e))
+    if errors:
+        tag, err = errors[0]
+        raise RuntimeError(
+            f"{len(errors)} warm compile(s) failed; first: {tag}: "
+            f"{err}") from err
+    fresh = [h for h in uniq if h.fresh]
+    wall = (max(h.done_ts for h in fresh)
+            - min(h.submit_ts for h in fresh)) if fresh else 0.0
+    # .get defaults: a handle may carry a non-aot_compile entry (tests,
+    # custom thunks) — join must still summarize the set
+    sum_s = sum(h.info.get("lower_s", 0.0) + h.info.get("compile_s", 0.0)
+                for h in fresh)
+    summary = {
+        "n_executables": len(uniq),
+        "compiled_now": len(fresh),
+        "cache_hits": sum(1 for h in fresh
+                          if h.info.get("cache_hit", False)),
+        "wall_s": round(wall, 6),
+        "sum_s": round(sum_s, 6),
+        "tags": sorted({h.tag for h in uniq})[:tags_limit],
+    }
+    if record:
+        _monitor.histogram("warm.wall_s").observe(wall)
+        _monitor.export_step(dict(summary), kind="warm")
+    return summary
+
+
+def shutdown(wait=True):
+    """Tear down the executor (tests / interpreter exit). A later
+    submit() lazily builds a fresh one."""
+    with _lock:
+        ex = _executor_holder.pop() if _executor_holder else None
+    if ex is not None:
+        ex.shutdown(wait=wait)

@@ -48,8 +48,8 @@ import threading
 __all__ = ["abstract_signature", "signature_key", "signature_str",
            "diff_signatures", "compile_started", "record_compile",
            "hlo_stats", "peak_memory_bytes", "ledger", "ledger_by_tag",
-           "aggregate", "add_listener", "remove_listener", "reset",
-           "LEDGER_RING"]
+           "ledger_signatures", "aggregate", "add_listener",
+           "remove_listener", "reset", "LEDGER_RING"]
 
 LEDGER_RING = 256   # compile records kept in process (a debug bundle
                     # carries them all; steady jobs compile a handful)
@@ -342,6 +342,18 @@ def ledger_by_tag():
     for r in ledger():
         out.setdefault(r["tag"], []).append(r)
     return out
+
+
+def ledger_signatures():
+    """The set of (tag, signature-key) pairs compiled so far — the
+    executable-sharing warmup contract's comparand: snapshot after
+    `warm()`/`jit.warm.join`, snapshot again after steady-state traffic,
+    and an EQUAL set proves warming added zero executables beyond the
+    steady-state set (tests/test_warm_pipeline.py asserts exactly
+    this; tools/_gate_common.py enforces it on the canonical
+    workload)."""
+    with _lock:
+        return {(r["tag"], r["signature"]) for r in _ledger}
 
 
 def aggregate(records=None):

@@ -18,13 +18,23 @@ bytes-accessed are deterministic and compile seconds are comparable.
                                                     # env — the gates
                                                     # spawn this)
 
+The workload WARMS its executables through the background compile
+pipeline (jit/warm.py: train.step / run_steps / accumulate and both
+serving buckets lower+compile concurrently), then runs the steady-state
+calls — which must add ZERO executables beyond the warmed set (the
+executable-sharing warmup contract; the emit fails loudly otherwise).
+The warm set's `kind:"warm"` record carries wall_s next to the sum of
+per-executable seconds — the overlap evidence check_compile_budget.py
+ratchets as the `warm_set` comparand.
+
 BASELINE_HLO.json schema (v1):
 
     {"schema": "paddle_tpu.hlo_baseline.v1",
      "executables": {"<tag>": {"lower_s": .., "compile_s": ..,
                                "total_s": .., "fusion_count": N,
                                "bytes_accessed": B, "instructions": M,
-                               "flops": F}, ...}}
+                               "flops": F}, ...},
+     "warm_set": {"wall_s": .., "sum_s": .., "n_executables": N}}
 
 Ratcheting: the gates never loosen the baseline; `--update` rewrites an
 entry only when the current run is BETTER (lower seconds / fewer
@@ -66,8 +76,7 @@ def save_baseline(path, payload):
         f.write("\n")
 
 
-def load_compile_records(path):
-    """The `kind:"compile"` records of one metrics JSONL file."""
+def _load_kind(path, kind):
     recs = []
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
@@ -78,9 +87,23 @@ def load_compile_records(path):
                 rec = json.loads(line)
             except ValueError as e:
                 raise GateError(f"{path}:{lineno}: not JSONL ({e})")
-            if isinstance(rec, dict) and rec.get("kind") == "compile":
+            if isinstance(rec, dict) and rec.get("kind") == kind:
                 recs.append(rec)
     return recs
+
+
+def load_compile_records(path):
+    """The `kind:"compile"` records of one metrics JSONL file."""
+    return _load_kind(path, "compile")
+
+
+def load_warm_record(path):
+    """The LAST `kind:"warm"` record of one metrics JSONL file (the
+    warm-set wall-vs-sum evidence jit/warm.join exports), or None when
+    the ledger carries none — a pre-warm-pipeline ledger stays a valid
+    gate source for the per-executable comparisons."""
+    recs = _load_kind(path, "warm")
+    return recs[-1] if recs else None
 
 
 def aggregate(records):
@@ -160,17 +183,29 @@ def run_workload(out_path, timeout=300):
 
 def emit_workload():
     """The canonical workload body (runs in the child run_workload
-    spawns; expects the env above to be set already)."""
+    spawns; expects the env above to be set already).
+
+    The full warm set — the three TrainStep program flavors plus both
+    serving buckets — compiles OVERLAPPED through the background
+    compile pipeline (jit/warm.py), exactly as a production startup
+    would; `jit.warm.join` exports the `kind:"warm"` wall-vs-sum
+    record the compile-budget gate ratchets. The steady-state calls
+    then run against the warmed executables and must add ZERO compile
+    records (the executable-sharing warmup contract) — violating that
+    fails the emit, and therefore both gates, loudly."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu import optimizer as opt
-    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.jit import TrainStep, warm as jwarm
     from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    from paddle_tpu.profiler import compile_observatory as cobs
 
     paddle.seed(0)
+    # scan_layers=True (the GPTConfig default) is deliberate: compile-
+    # bound paths lower ONE block body, not num_layers of them
     cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
                     num_heads=2, max_position_embeddings=16, dropout=0.0)
     model = GPTForCausalLM(cfg)
@@ -185,19 +220,34 @@ def emit_workload():
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(2, 16)).astype(np.int32))
-    float(step(ids, ids).item())          # train.step
-    step.run_steps(2, ids, ids)           # train.run_steps
     stacked = paddle.to_tensor(
         np.stack([ids.numpy(), ids.numpy()]))
-    float(step.accumulate(2, stacked, stacked).item())  # train.accumulate
 
-    # serving buckets: one AOT executable per batch bucket
     from paddle_tpu.inference import InferenceEngine
     paddle.seed(0)
     eng = InferenceEngine(nn.Linear(8, 8), batch_sizes=(1, 2),
                           name="canonical")
-    eng.warm(np.zeros((1, 8), np.float32))
+    x_serve = np.zeros((1, 8), np.float32)
+    handles = [
+        step.warm(ids, ids),                       # train.step
+        step.warm_run_steps(2, ids, ids),          # train.run_steps
+        step.warm_accumulate(2, stacked, stacked),  # train.accumulate
+    ] + eng.warm_async(x_serve)                    # serve.*.batch{1,2}
+    summary = jwarm.join(handles)                  # kind:"warm" record
+    warmed = cobs.ledger_signatures()
+
+    # steady state over the warmed executables
+    float(step(ids, ids).item())
+    step.run_steps(2, ids, ids)
+    float(step.accumulate(2, stacked, stacked).item())
+    eng(x_serve)
     eng.shutdown()
+    steady = cobs.ledger_signatures()
+    if steady != warmed:
+        raise AssertionError(
+            "executable-sharing warmup contract violated: steady state "
+            f"compiled {sorted(steady - warmed)} beyond the warmed set "
+            f"(warm summary: {summary})")
 
 
 def format_row(tag, parts):

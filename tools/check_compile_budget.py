@@ -18,6 +18,17 @@ fake regression) exceeds its budget:
     max over signatures (lower_s+compile_s)  >  base total_s * FACTOR
                                                 + SLACK
 
+Warm-set wall clock: when the ledger carries a `kind:"warm"` record
+(jit/warm.join — the canonical workload always emits one), its wall_s
+— the wall-clock of compiling the WHOLE warm set through the
+background compile pipeline — is compared against the baseline's
+`warm_set` entry under the same FACTOR/SLACK budget. This is the
+overlap fence: per-executable seconds can all stay green while a
+serialization bug (a lost worker pool, a global lock around the XLA
+compile) quietly turns the warm set's wall back into the sum; the
+wall comparand catches exactly that. `--update` ratchets it like any
+other entry (only ever faster).
+
 FACTOR (default 2.5) and SLACK (default 2.0 s) absorb host-load noise
 on the 2-CPU container — compile WALL time is load-sensitive, so the
 budget is deliberately generous; a real regression (a new unrolled
@@ -85,6 +96,43 @@ def compare(baseline, current, factor, slack, require_all):
     return violations, notes, ratchet
 
 
+def compare_warm(baseline, warm_rec, factor, slack, require_all):
+    """(violations, notes, ratchet_entry_or_None) for the warm-set
+    wall-clock comparand."""
+    violations, notes = [], []
+    base = baseline.get("warm_set")
+    if warm_rec is None:
+        msg = ("warm_set: in baseline but the ledger has no "
+               "kind:'warm' record (pre-warm-pipeline ledger?)")
+        if base is not None:
+            (violations if require_all else notes).append(msg)
+        return violations, notes, None
+    wall = float(warm_rec.get("wall_s", 0.0))
+    entry = {"wall_s": round(wall, 3),
+             "sum_s": round(float(warm_rec.get("sum_s", 0.0)), 3),
+             "n_executables": int(warm_rec.get("n_executables", 0))}
+    if base is None:
+        notes.append(f"warm_set: no baseline (wall {wall:.2f}s) — add "
+                     "it with --update")
+        return violations, notes, entry
+    base_wall = float(base.get("wall_s", 0.0))
+    budget = base_wall * factor + slack
+    if wall > budget:
+        violations.append(
+            f"warm_set: wall-clock {wall:.2f}s for "
+            f"{entry['n_executables']} executables exceeds budget "
+            f"{budget:.2f}s (baseline {base_wall:.2f}s x{factor} + "
+            f"{slack}s slack) — the background compile overlap broke "
+            "(serialized compiles?); restore the overlap, don't raise "
+            "the budget")
+        return violations, notes, None
+    if wall < base_wall:
+        notes.append(f"warm_set: wall {wall:.2f}s beats baseline "
+                     f"{base_wall:.2f}s (ratchet with --update)")
+        return violations, notes, entry
+    return violations, notes, None
+
+
 def _entry(cur, base=None):
     """Ratchet entry: rewrite ONLY this gate's comparands (the
     seconds). fusion/bytes/instructions stay whatever check_fusion last
@@ -129,16 +177,22 @@ def main(argv=None):
         if args.ledger:
             current = gc.aggregate(
                 gc.load_compile_records(args.ledger))
+            warm_rec = gc.load_warm_record(args.ledger)
         else:
             with tempfile.TemporaryDirectory() as td:
-                current = gc.run_workload(
-                    os.path.join(td, "ledger.jsonl"))
+                ledger_path = os.path.join(td, "ledger.jsonl")
+                current = gc.run_workload(ledger_path)
+                warm_rec = gc.load_warm_record(ledger_path)
     except (gc.GateError, OSError) as e:
         print(f"check_compile_budget: {e}", file=sys.stderr)
         return 2
 
     violations, notes, ratchet = compare(
         baseline, current, args.factor, args.slack, args.require_all)
+    w_viol, w_notes, w_entry = compare_warm(
+        baseline, warm_rec, args.factor, args.slack, args.require_all)
+    violations += w_viol
+    notes += w_notes
 
     print("compile budget (lower+compile seconds per executable):")
     for tag in sorted(current):
@@ -150,14 +204,24 @@ def main(argv=None):
             f"base {base_s:7.2f}s" if base_s is not None
             else "base    none",
             "hit" if cur["cache_hit"] else "cold"]))
+    if warm_rec is not None:
+        base_w = (baseline.get("warm_set") or {}).get("wall_s")
+        print(gc.format_row("warm_set (wall-clock)", [
+            f"now {float(warm_rec.get('wall_s', 0.0)):7.2f}s",
+            f"base {base_w:7.2f}s" if base_w is not None
+            else "base    none",
+            f"sum {float(warm_rec.get('sum_s', 0.0)):.2f}s"]))
     for n in notes:
         print(f"note: {n}")
-    if args.update and ratchet:
+    if args.update and (ratchet or w_entry):
         for tag, cur in ratchet.items():
             baseline["executables"][tag] = _entry(
                 cur, baseline["executables"].get(tag))
+        if w_entry:
+            baseline["warm_set"] = w_entry
         gc.save_baseline(args.baseline, baseline)
-        print(f"ratcheted {len(ratchet)} entr(y/ies) -> {args.baseline}")
+        print(f"ratcheted {len(ratchet) + bool(w_entry)} entr(y/ies) "
+              f"-> {args.baseline}")
     for v in violations:
         print(f"FAIL: {v}")
     if violations:

@@ -74,6 +74,27 @@ Schema (documented in docs/OBSERVABILITY.md):
                   peak_memory_bytes number  memory-analysis peak (>= 0)
                   and optionally:
                   op_counts    dict    {op kind: count >= 0}
+  kind == "warm" (one record per resolved warm set —
+                  paddle_tpu/jit/warm.py join) additionally requires:
+                  n_executables int    handles in the set (>= 0)
+                  compiled_now int     handles that ran a compile, in
+                                       [0, n_executables]
+                  cache_hits   int     of compiled_now, how many were
+                                       persistent-cache loads, in
+                                       [0, compiled_now]
+                  wall_s       number  first submit -> last done (>= 0)
+                  sum_s        number  Σ per-executable lower+compile
+                                       seconds (>= 0); wall_s well
+                                       under sum_s is the overlap proof
+                  and optionally:
+                  tags         list    executable tags (non-empty strs)
+  kind == "seed" (one record per compile-cache seeding —
+                  framework/compile_cache.seed_from) additionally
+                  requires:
+                  source          str  donated artifact dir (non-empty)
+                  cache_dir       str  seeded cache dir (non-empty)
+                  entries_seeded  int  entries copied in (>= 0)
+                  entries_skipped int  already present (>= 0)
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
@@ -111,6 +132,11 @@ COMPILE_REQUIRED = {"tag": str, "signature": str,
                     "fusion_count": int, "bytes_accessed": (int, float),
                     "flops": (int, float),
                     "peak_memory_bytes": (int, float)}
+WARM_REQUIRED = {"n_executables": int, "compiled_now": int,
+                 "cache_hits": int, "wall_s": (int, float),
+                 "sum_s": (int, float)}
+SEED_REQUIRED = {"source": str, "cache_dir": str, "entries_seeded": int,
+                 "entries_skipped": int}
 # a persistent-cache HIT deserializes an artifact instead of compiling;
 # spending more than this on one is a mislabeled cold compile
 CACHE_HIT_COMPILE_S_MAX = 10.0
@@ -242,6 +268,48 @@ def validate_line(line, where="<line>"):
                             f"{where}: op_counts entry {k!r}: {v!r} must "
                             "be str -> int >= 0")
                         break
+    elif rec.get("kind") == "warm":
+        _check_types(rec, WARM_REQUIRED, where, errors)
+
+        def _int(key):
+            v = rec.get(key)
+            return v if isinstance(v, int) and not isinstance(v, bool) \
+                else None
+
+        for key in ("n_executables", "compiled_now", "cache_hits"):
+            v = _int(key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        for key in ("wall_s", "sum_s"):
+            v = rec.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        n, c, h = _int("n_executables"), _int("compiled_now"), \
+            _int("cache_hits")
+        if n is not None and c is not None and c > n:
+            errors.append(
+                f"{where}: compiled_now {c} > n_executables {n} — a "
+                "warm set cannot compile more than it holds")
+        if c is not None and h is not None and h > c:
+            errors.append(
+                f"{where}: cache_hits {h} > compiled_now {c} — only a "
+                "compile that ran can be a cache load")
+        tags = rec.get("tags")
+        if tags is not None:
+            if not isinstance(tags, list) or any(
+                    not isinstance(t, str) or not t for t in tags):
+                errors.append(f"{where}: tags must be a list of "
+                              f"non-empty strings, got {tags!r}")
+    elif rec.get("kind") == "seed":
+        _check_types(rec, SEED_REQUIRED, where, errors)
+        for key in ("source", "cache_dir"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+        for key in ("entries_seeded", "entries_skipped"):
+            v = rec.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
     return errors
 
 
