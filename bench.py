@@ -606,6 +606,118 @@ def _run_1p3b():
           flush=True)
 
 
+def _serve_gen_workload():
+    """The mixed long/short-prompt GENERATION workload behind
+    `bench.py --serve` (docs/SERVING.md "Ragged serving"): the same
+    prompt set — short chats and long documents behind one shared
+    system prefix — runs through the BUCKETED GenerationEngine
+    (ragged=False: fixed-shape decode, pad rows pay full attention)
+    and then the RAGGED engine (Pallas mixed prefill+decode kernel,
+    chunked prefill, refcounted prefix caching). Returns the headline
+    dict: per-path pad-token fraction (same counter-delta formula for
+    both), prefix hit rate, client-side TTFT p50/p99, and the
+    token-for-token equality verdict."""
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    from paddle_tpu.inference import GenerationEngine
+    from paddle_tpu.profiler import monitor as _pmon
+
+    n_long = int(os.environ.get("BENCH_SERVE_GEN_LONG", "2"))
+    n_short = int(os.environ.get("BENCH_SERVE_GEN_SHORT", "6"))
+    max_new = int(os.environ.get("BENCH_SERVE_GEN_NEW", "6"))
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, 256, (16,))  # the shared system prompt
+    # long documents generate 3x the tokens of short chats: finish
+    # times stagger, so the bucketed path's decode batch regularly
+    # sits between power-of-two buckets — the pad rows whose full-
+    # width attention cost the ragged kernel skips
+    prompts = [np.concatenate([system, rng.randint(0, 256, (n,))])
+               for n in [40] * n_long + [4] * n_short]
+    new_toks = [3 * max_new] * n_long + \
+        [max_new + i % 3 for i in range(n_short)]
+    total_prompt_toks = sum(p.size for p in prompts)
+
+    def run(ragged):
+        c0 = {k: _pmon.get_metric(f"serve.{k}")
+              for k in ("pad_tokens", "prefix_hits",
+                        "chunked_prefill_tokens")}
+        base = {k: (int(m.value) if m else 0) for k, m in c0.items()}
+        eng = GenerationEngine(model, n_pages=128, page_size=8,
+                               max_batch=4, max_new_tokens=max_new,
+                               ragged=ragged, prefill_chunk=16,
+                               name=f"bench_{'ragged' if ragged else 'bucketed'}")
+        outs, ttfts = [None] * len(prompts), [None] * len(prompts)
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new_tokens=n)
+                   for p, n in zip(prompts, new_toks)]
+
+        def drain(i, h):
+            toks = []
+            for tok in h.tokens():
+                if not toks:
+                    ttfts[i] = time.perf_counter() - t0
+                toks.append(tok)
+            outs[i] = toks
+
+        threads = [threading.Thread(target=drain, args=(i, h))
+                   for i, h in enumerate(handles)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        frac = eng.pad_token_fraction()
+        eng.shutdown()
+        delta = {k: (int(m2.value) if (m2 := _pmon.get_metric(
+            f"serve.{k}")) else 0) - v for k, v in base.items()}
+        ttfts_ms = sorted(1e3 * t for t in ttfts if t is not None)
+        return {
+            "outs": outs, "wall_s": round(wall, 3),
+            "gen_tokens_per_sec": round(
+                sum(len(o or []) for o in outs) / wall, 1),
+            # MEASURED attention-slot waste (engine accounting, same
+            # formula both paths): slots computed outside any causal
+            # bound / slots computed — bucketed decode pays pad rows +
+            # the pow2 table width, the ragged kernel only intra-page
+            # remainders
+            "pad_token_fraction": round(frac, 4),
+            "pad_row_tokens": delta["pad_tokens"],
+            "prefix_hit_rate": round(
+                delta["prefix_hits"] / max(total_prompt_toks, 1), 4),
+            "chunked_prefill_tokens": delta["chunked_prefill_tokens"],
+            "ttft_p50_ms": round(
+                ttfts_ms[len(ttfts_ms) // 2], 1) if ttfts_ms else 0.0,
+            "ttft_p99_ms": round(
+                ttfts_ms[min(len(ttfts_ms) - 1,
+                             int(0.99 * len(ttfts_ms)))], 1)
+            if ttfts_ms else 0.0,
+        }
+
+    bucketed = run(ragged=False)
+    ragged = run(ragged=True)
+    equal = bucketed.pop("outs") == ragged.pop("outs")
+    return {
+        "prompts": {"long": n_long, "short": n_short,
+                    "shared_prefix": int(system.size),
+                    "max_new_tokens": max_new},
+        "ragged": ragged, "bucketed": bucketed,
+        "ragged_equals_bucketed": equal,
+        # the acceptance comparison, measured in the same run
+        "pad_token_fraction_ragged": ragged["pad_token_fraction"],
+        "pad_token_fraction_bucketed": bucketed["pad_token_fraction"],
+        "prefix_hit_rate": ragged["prefix_hit_rate"],
+        "ttft_p50_ms": ragged["ttft_p50_ms"],
+        "ttft_p99_ms": ragged["ttft_p99_ms"],
+    }
+
+
 def _run_serve():
     """`bench.py --serve`: continuous-batching serving micro-benchmark
     (docs/SERVING.md). N concurrent closed-loop client threads drive one
@@ -707,6 +819,17 @@ def _run_serve():
     for t in threads:
         t.join()
     serve_s = time.perf_counter() - t0
+
+    # mixed long/short GENERATION workload: ragged vs bucketed pad
+    # fractions, prefix hit rate, TTFT percentiles (BENCH_SERVE_GEN=0
+    # skips; a failure degrades to an error key, never a dead bench)
+    gen = None
+    if os.environ.get("BENCH_SERVE_GEN", "1") != "0":
+        _phase("generate")
+        try:
+            gen = _serve_gen_workload()
+        except Exception as e:
+            gen = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     _phase("done", serve_s=serve_s)
 
     lat.sort()
@@ -747,6 +870,27 @@ def _run_serve():
         "compile_ledger": _compile_ledger_table(),
         "phases": dict(_PHASES),
     }
+    if gen is not None:
+        headline["generate"] = gen
+        # serve trajectory ACROSS rounds (the compile_history twin):
+        # bench_state.json keeps the last 10 rounds of the headline
+        # serving numbers so a regression in pad fraction / prefix hit
+        # rate / TTFT is visible without digging through driver logs
+        state = _load_state()
+        history = state.get("serve_history", [])
+        entry = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+                 "req_per_sec": headline["value"]}
+        for k in ("pad_token_fraction_ragged",
+                  "pad_token_fraction_bucketed", "prefix_hit_rate",
+                  "ttft_p50_ms", "ttft_p99_ms",
+                  "ragged_equals_bucketed"):
+            if k in gen:
+                entry[k] = gen[k]
+        history.append(entry)
+        state["serve_history"] = history[-10:]
+        _save_state(state)
+        headline["serve_history"] = state["serve_history"]
     cfg.disable_serving()
     print(json.dumps(headline), flush=True)
 

@@ -38,13 +38,22 @@ Both report into `profiler/monitor`:
     serve.requests      counter    accepted requests
     serve.rejected      counter    fast-fail queue-full rejections
     serve.expired       counter    deadline expiries
-    serve.pad_tokens    counter    padding elements dispatched
+    serve.pad_tokens    counter    COMPUTE-BEARING padding dispatched
+                                   (the ragged path's skipped pad
+                                   slots count 0 by construction)
     serve.retraces      counter    bucket executables compiled
     serve.errors        counter    batches/steps failed onto futures
+    serve.prefix_hits   counter    prompt tokens served from the
+                                   refcounted prefix cache
+    serve.shared_pages  gauge      KV pages with more than one holder
+    serve.chunked_prefill_tokens counter  prompt tokens admitted via
+                                   chunked prefill (ragged steps)
 
 The dispatcher and decode loops are fenced by tools/check_no_hot_sync.py:
 the ONLY host blocks are the scheduler's queue wait and the one
-deliberate device read per batch (marked `# hot-sync-ok:`).
+deliberate device read per batch (marked `# hot-sync-ok:`); sampling is
+an on-device argmax collected through an async copy — int32s cross to
+the host, never [vocab]-sized logits.
 """
 import itertools
 import threading
@@ -833,14 +842,17 @@ class GenerationHandle:
 
 
 class _ActiveSeq:
-    __slots__ = ("sid", "handle", "generated", "last", "reserve")
+    __slots__ = ("sid", "handle", "generated", "last", "reserve",
+                 "cached", "filled")
 
-    def __init__(self, sid, handle, reserve):
+    def __init__(self, sid, handle, reserve, cached=0):
         self.sid = sid
         self.handle = handle
         self.generated = []
         self.last = None
         self.reserve = reserve  # worst-case pages this request may draw
+        self.cached = cached    # prompt tokens served by the prefix cache
+        self.filled = cached    # prompt tokens whose KV is in the pool
 
 
 class GenerationEngine(_SchedulerLifecycle):
@@ -853,21 +865,39 @@ class GenerationEngine(_SchedulerLifecycle):
         for tok in h.tokens(): ...      # streamed as decoded
         full = h.result()               # np.int64 [n_generated]
 
-    The decode loop alternates two phases without ever stalling
-    in-flight work: (1) ADMIT — while a slot and enough free pages for
-    the worst case (prompt + max_new_tokens; conservative reservation =
-    no mid-decode preemption) exist, prefill the next queued prompt
-    into the shared page pool and stream its first token; (2) DECODE —
-    one fixed-shape jitted step advances every active sequence by one
-    token (batch padded to a power-of-two bucket with rows targeting
-    the reserved pad page, so admits/evicts never change the compiled
-    shape). Finished sequences free their pages immediately. Greedy
-    (argmax) decoding — deterministic, token-for-token equal to a
-    single-sequence paged decode of the same prompt."""
+    With `ragged=True` (the default whenever the model implements
+    `paged_ragged_step`, e.g. GPTForCausalLM) every scheduler iteration
+    runs ONE jitted step over the Pallas ragged kernel
+    (ops/pallas/paged_attention.py) carrying mixed rows: each active
+    sequence's decode token AND up to `prefill_chunk` tokens of queued
+    prompts — so a long prompt admits incrementally (CHUNKED PREFILL)
+    instead of monopolizing the loop, and pad slots cost zero attention
+    work (per-token causal bounds skip them in-kernel). Admission
+    consults the REFCOUNTED PREFIX CACHE first: a prompt matching a
+    registered chain shares those KV pages (`PagedKVCache.
+    acquire_prefix`, copy-on-write on divergence) and only prefills
+    the rest — N users behind one system prompt pay for its KV once,
+    and the page reservation is credited accordingly.
+
+    With `ragged=False` the legacy loop alternates two phases: (1)
+    ADMIT — while a slot and enough free pages for the worst case
+    (prompt + max_new_tokens; conservative reservation = no mid-decode
+    preemption) exist, prefill the next queued prompt whole and stream
+    its first token; (2) DECODE — one fixed-shape jitted step advances
+    every active sequence by one token (batch padded to a power-of-two
+    bucket with rows targeting the reserved pad page — pad rows pay
+    FULL attention work, which is what the ragged path eliminates).
+
+    Either way sequences free their pages on finish without stalling
+    neighbors, and decoding is greedy (argmax, computed ON DEVICE so
+    only int32 tokens cross to the host) — deterministic,
+    token-for-token equal to a single-sequence paged decode of the
+    same prompt."""
 
     def __init__(self, model, n_pages=256, page_size=16, max_batch=8,
                  max_queue=64, max_new_tokens=64, eos_token_id=None,
-                 cache=None, name=None):
+                 cache=None, name=None, ragged=None, prefill_chunk=32,
+                 prefix_cache=True):
         self.name = name or f"gen{next(_ENGINE_IDS)}"
         for need in ("paged_decode_step", "make_paged_cache"):
             if not hasattr(model, need):
@@ -881,11 +911,27 @@ class GenerationEngine(_SchedulerLifecycle):
         self.max_queue = int(max_queue)
         self.default_max_new = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.ragged = bool(hasattr(model, "paged_ragged_step")
+                           if ragged is None else ragged)
+        if self.ragged and not hasattr(model, "paged_ragged_step"):
+            raise TypeError("ragged=True needs model.paged_ragged_step()")
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.prefix_cache = bool(prefix_cache) and self.ragged
+        # attention-slot accounting: how many kv score slots each step
+        # COMPUTES vs how many were USEFUL (inside some row's causal
+        # bound). The bucketed path computes pad_rows x full table
+        # width; the ragged kernel computes only each token's own
+        # ceil(bound/page) blocks — pad_token_fraction() is the
+        # measured difference, not an estimate
+        self._attn_computed = 0
+        self._attn_useful = 0
         self.retraces = 0  # decode executables compiled in THIS engine
-        self._synced_traces = getattr(model, "_paged_decode_traces", 0)
+        self._synced_traces = self._model_traces()
         self._pending = deque()
         self._active = []        # list of _ActiveSeq, decode-batch order
+        self._prefilling = []    # admitted, prompt KV still chunking in
         self._admitting = 0      # popped from pending, prefill in flight
+        self._step_prefix_hits = 0  # prefix tokens since last record
         self._cv = threading.Condition()
         self._stopping = False
         self._abort = False      # no-wait shutdown: fail active too
@@ -939,16 +985,24 @@ class GenerationEngine(_SchedulerLifecycle):
         return handle
 
     # -- the scheduler/decode loop --------------------------------------
+    def _model_traces(self):
+        """The model's trace-time compile counters (legacy decode +
+        ragged step), folded into serve.retraces by _sync_retraces."""
+        return getattr(self.model, "_paged_decode_traces", 0) \
+            + getattr(self.model, "_ragged_traces", 0)
+
     def _loop_once(self):
-        """One admit+decode iteration (False = thread exits). The
+        """One admit+step iteration (False = thread exits). The
         runner (_run_scheduler) re-calls while we return True, holding
         no strong engine ref in between."""
         with self._cv:
-            if not self._pending and not self._active:
+            if not self._pending and not self._active \
+                    and not self._prefilling:
                 if self._stopping:
                     return False
                 self._cv.wait(0.05)  # idle: wait for work
-                if not self._pending and not self._active:
+                if not self._pending and not self._active \
+                        and not self._prefilling:
                     return True  # still idle: let the runner drop its ref
         if self._abort:
             # shutdown(wait=False): a long in-flight generation must
@@ -957,6 +1011,15 @@ class GenerationEngine(_SchedulerLifecycle):
             self._fail_all(EngineStopped("engine shut down"))
             return False
         try:
+            if self.ragged:
+                self._admit_ragged()
+                if self._active or self._prefilling:
+                    self._ragged_step()
+                else:
+                    with self._cv:
+                        if self._pending and not self._stopping:
+                            self._cv.wait(0.01)
+                return True
             self._admit()
             if self._active:
                 self._decode_step()
@@ -996,7 +1059,7 @@ class GenerationEngine(_SchedulerLifecycle):
                 # on pages they haven't drawn yet — admit only against
                 # what's free AFTER every outstanding reservation
                 outstanding = sum(
-                    max(s.reserve - self.cache.pages_held(s.sid), 0)
+                    max(s.reserve - self.cache.pages_drawn(s.sid), 0)
                     for s in self._active)
                 if not self.cache.can_allocate(
                         handle.prompt.size + handle.max_new_tokens,
@@ -1014,11 +1077,14 @@ class GenerationEngine(_SchedulerLifecycle):
                     logits = self.model.paged_decode_step(
                         self.cache, [sid],
                         Tensor(jnp.asarray(handle.prompt[None, :])))
-                    # .value, not the Tensor: Tensor has no __array__,
-                    # so np.asarray on it builds a dtype=object array
-                    # element-by-element — minutes per step at real
-                    # vocab sizes
-                    tok = int(np.asarray(logits.value)[0].argmax())  # hot-sync-ok: sampling is the prefill's sync point
+                    # sampling ON DEVICE: the argmax runs in XLA and
+                    # one int32 crosses to the host via an async copy —
+                    # the decode loop never blocks on a [vocab]-sized
+                    # D2H (the old np.asarray(...).argmax() hot-sync);
+                    # int() collects the already-in-flight copy
+                    tok_dev = jnp.argmax(logits.value[0])
+                    tok_dev.copy_to_host_async()
+                    tok = int(tok_dev)
                 except Exception as e:
                     self.cache.free_sequence(sid)
                     _reject_future(handle.future, e)
@@ -1042,15 +1108,30 @@ class GenerationEngine(_SchedulerLifecycle):
         sids = [s.sid for s in self._active]
         toks = np.asarray([[s.last] for s in self._active], np.int64)  # hot-sync-ok: host int list, not a device read
         b = len(sids)
+        lens = [self.cache.length(s) for s in sids]  # pre-advance
         pad_to = min(1 << (b - 1).bit_length(),
                      1 << (self.max_batch - 1).bit_length())
         pad_to = max(pad_to, b)
         logits = self.model.paged_decode_step(
             self.cache, sids, Tensor(jnp.asarray(toks)), pad_to=pad_to)
-        # .value, not the Tensor (no __array__ -> dtype=object), see _admit
-        nxt = np.asarray(logits.value).argmax(-1)  # hot-sync-ok: sampling is the step's sync point
+        # argmax ON DEVICE, async copy launched at dispatch: the step's
+        # one deliberate sync below reads B int32s, never [B, vocab]
+        nxt_dev = jnp.argmax(logits.value, axis=-1)
+        nxt_dev.copy_to_host_async()
+        nxt = np.asarray(nxt_dev)  # hot-sync-ok: sampling sync point — B int32s, argmax already ran on device
         self._sync_retraces()
         now = time.perf_counter()
+        # slot-accurate pad accounting: the fixed-shape kernel computes
+        # pad_to rows x the POW2-BUCKETED table width x page_size
+        # score slots, of which only each real row's (len+1) lie inside
+        # a causal bound — shorter rows pay for the longest row's table
+        # and pad rows pay for everything (the waste the ragged kernel
+        # skips per-token)
+        width = self._pow2(max(self.cache.pages_held(s) for s in sids))
+        computed = int(pad_to) * width * self.cache.page_size
+        useful = sum(l + 1 for l in lens)
+        self._attn_computed += computed
+        self._attn_useful += useful
         _monitor.histogram("serve.batch_size").observe(b)
         _monitor.counter("serve.pad_tokens").inc(int(pad_to - b))
         _monitor.export_step(
@@ -1058,12 +1139,249 @@ class GenerationEngine(_SchedulerLifecycle):
              "bucket_batch": int(pad_to),
              "queue_depth": len(self._pending),
              "pad_tokens": int(pad_to - b),
+             "pad_token_fraction": max(0.0, 1.0 - useful / computed),
+             "prefix_hits": 0, "shared_pages": 0,
+             "chunked_prefill_tokens": 0,
              # for decode batches latency_s is the mean IN-FLIGHT age of
              # the step's requests (they are not finished yet)
              "latency_s": sum(now - s.handle.t_submit
                               for s in self._active) / b}, kind="serve")
         for seq, tok in zip(list(self._active), nxt):
             self._emit(seq, int(tok))
+
+    def pad_token_fraction(self):
+        """Measured fraction of this engine's attention score slots
+        spent OUTSIDE any row's causal bound — pad rows, bucketed
+        table width, intra-page remainders. The bucketed decode path
+        pays all three; the ragged kernel pays only the last (bench.py
+        --serve compares the two in one run)."""
+        if not self._attn_computed:
+            return 0.0
+        return max(0.0, 1.0 - self._attn_useful / self._attn_computed)
+
+    # -- the ragged loop: chunked prefill + prefix caching --------------
+    @staticmethod
+    def _pow2(n):
+        return 1 << (max(int(n), 1) - 1).bit_length()
+
+    def _admit_ragged(self):
+        """Move queued prompts into the prefilling set — NO compute
+        here, the mixed step does the prefill in chunks. Admission
+        reserves the worst case (prompt + max_new pages) CREDITED with
+        the prefix cache's fully-matched pages, against the free list
+        plus the registry's evictable retention."""
+        while True:
+            with self._cv:
+                in_flight = len(self._active) + len(self._prefilling)
+                if not self._pending or in_flight >= self.max_batch:
+                    return
+                handle = self._pending[0]
+                if handle.future.cancelled():
+                    # cancelled while queued: drop BEFORE reserving
+                    # pages or paying any prefill chunks
+                    self._pending.popleft()
+                    _monitor.gauge("serve.queue_depth").set(
+                        len(self._pending))
+                    handle._close()
+                    continue
+                matched_full = pinned = 0
+                if self.prefix_cache:
+                    # at most prompt-1 cached tokens: the final prompt
+                    # token must run through the model to produce the
+                    # first sampled token's logits
+                    _, matched_full, pinned = \
+                        self.cache.match_prefix_credit(
+                            handle.prompt,
+                            max_tokens=handle.prompt.size - 1)
+                need = self.cache.pages_needed(
+                    handle.prompt.size + handle.max_new_tokens) \
+                    - matched_full
+                # claims compare against pages DRAWN, not held: an
+                # acquired shared prefix inflates pages_held without
+                # consuming the pool, and its copy-on-write + tail
+                # pages are still owed from this reservation
+                outstanding = sum(
+                    max(s.reserve - self.cache.pages_drawn(s.sid), 0)
+                    for s in self._active + self._prefilling)
+                # supply subtracts `pinned`: matched registry-only
+                # pages count as evictable TODAY but acquire_prefix
+                # pins them — crediting need AND counting them as
+                # supply would admit against phantom capacity
+                if need + outstanding > self.cache.n_free_pages() \
+                        + self.cache.n_evictable_pages() - pinned:
+                    return  # wait for evictions to free pages
+                self._pending.popleft()
+                self._admitting += 1  # drain() must see the handoff
+                _monitor.gauge("serve.queue_depth").set(len(self._pending))
+            try:
+                sid = f"g{self._next_sid}"
+                self._next_sid += 1
+                self.cache.add_sequence(sid)
+                cached = 0
+                if self.prefix_cache:
+                    cached = self.cache.acquire_prefix(
+                        sid, handle.prompt,
+                        max_tokens=handle.prompt.size - 1)
+                if cached:
+                    _monitor.counter("serve.prefix_hits").inc(cached)
+                    self._step_prefix_hits += cached
+                self._prefilling.append(
+                    _ActiveSeq(sid, handle, need, cached=cached))
+            finally:
+                with self._cv:
+                    self._admitting -= 1
+                    self._cv.notify_all()
+
+    def _ragged_step(self):
+        """ONE jitted mixed step over the Pallas ragged kernel: every
+        active sequence's decode token plus up to `prefill_chunk`
+        prompt tokens of the prefilling set, token/row counts padded to
+        power-of-two buckets whose pad slots the kernel SKIPS (bound
+        0) — fixed compiled shapes with zero attention work on
+        padding. Sampling is an on-device argmax; the host reads back
+        one int32 per row through a copy launched at dispatch."""
+        for s in list(self._prefilling):  # cancelled mid-prefill: evict
+            if s.handle.future.cancelled():
+                self.cache.free_sequence(s.sid)
+                self._prefilling.remove(s)
+                s.handle._close()
+        rows, metas = [], []
+        for s in self._active:
+            rows.append((s.sid, [s.last]))
+            metas.append(("decode", s, 1))
+        budget = self.prefill_chunk
+        # shortest-remaining-first: a short chat's 4 tokens must not
+        # queue behind a long document's 15 chunks — the short one
+        # finishes its prefill (and streams its first token) within a
+        # step or two while the long one keeps absorbing the leftover
+        # budget each step
+        order = sorted(self._prefilling,
+                       key=lambda s: s.handle.prompt.size - s.filled)
+        for s in order:
+            if budget <= 0:
+                break
+            n = min(budget, s.handle.prompt.size - s.filled)
+            rows.append((s.sid, s.handle.prompt[s.filled:s.filled + n]))
+            metas.append(("prefill", s, n))
+            budget -= n
+        if not rows:
+            return
+        t_real = sum(n for _, _, n in metas)
+        b_real = len(rows)
+        pad_t = self._pow2(t_real)
+        pad_b = min(self._pow2(b_real), self._pow2(self.max_batch))
+        # slot-accurate accounting (pre-dispatch: lengths advance in
+        # the step): each token computes exactly ceil(bound/page)
+        # pages of score slots — pad slots compute NOTHING (kernel
+        # predicate), so the only waste is the intra-page remainder.
+        # ragged_work_plan is the kernel's own work formula: the
+        # metric and the in-kernel counter cannot diverge
+        from ..ops.pallas.paged_attention import ragged_work_plan
+        P = self.cache.page_size
+        bounds = np.concatenate(
+            [self.cache.length(sid) + np.arange(1, len(toks) + 1)
+             for sid, toks in rows])
+        computed = int(ragged_work_plan(bounds, P).sum()) * P
+        useful = int(bounds.sum())
+        self._attn_computed += computed
+        self._attn_useful += useful
+        _, nxt = self.model.paged_ragged_step(
+            self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b)
+        nxt.copy_to_host_async()  # overlap with the bookkeeping below
+        self._sync_retraces()
+        now = time.perf_counter()
+        prefill_toks = sum(n for k, _, n in metas if k == "prefill")
+        _monitor.histogram("serve.batch_size").observe(b_real)
+        if prefill_toks:
+            _monitor.counter("serve.chunked_prefill_tokens").inc(
+                prefill_toks)
+        shared = self.cache.shared_page_count()
+        _monitor.gauge("serve.shared_pages").set(shared)
+        hits, self._step_prefix_hits = self._step_prefix_hits, 0
+        _monitor.export_step(
+            {"engine": self.name, "requests": b_real,
+             "batch_size": b_real, "bucket_batch": int(pad_b),
+             "queue_depth": len(self._pending),
+             # pad SLOTS exist (pad_t - t_real) but carry bound 0: the
+             # kernel computes zero attention blocks for them, so the
+             # compute-bearing pad count — what serve.pad_tokens has
+             # always measured — is 0 by construction on this path,
+             # and the slot fraction is only the intra-page remainder
+             "pad_tokens": 0,
+             "pad_token_fraction": max(0.0, 1.0 - useful / computed)
+             if computed else 0.0,
+             "pad_slots": int(pad_t - t_real),
+             "prefix_hits": hits, "shared_pages": shared,
+             "chunked_prefill_tokens": prefill_toks,
+             "latency_s": sum(now - s.handle.t_submit
+                              for _, s, _ in metas) / b_real},
+            kind="serve")
+        toks = jax.device_get(nxt)  # hot-sync-ok: the step's one sync — b_real int32s, copy launched at dispatch
+        i = 0
+        for kind, s, n in metas:
+            tok = int(toks[i])
+            i += 1
+            if kind == "decode":
+                self._emit(s, tok)
+                continue
+            s.filled += n
+            if s.filled < s.handle.prompt.size:
+                continue  # mid-prompt chunk: sampled token is not real
+            # prompt complete: stream the first token, join the decode
+            # batch (prefix registration waits for EVICTION — a
+            # still-generating sequence registering its partial tail
+            # page would copy-on-write its own next decode token, an
+            # extra page draw its admission reservation never counted)
+            self._prefilling.remove(s)
+            _monitor.histogram("serve.ttft_s").observe(
+                now - s.handle.t_submit)
+            self._active.append(s)
+            self._emit(s, tok)
+
+    def warm(self, prompt_len, max_new_tokens=None):
+        """Blocking warm_async: AOT-compile every ragged signature one
+        request of `prompt_len` touches. Returns the count compiled
+        NOW (cache hits and already-warm signatures are free)."""
+        from ..jit import warm as _warm
+        handles = self.warm_async(prompt_len, max_new_tokens)
+        _warm.join(handles)
+        return sum(1 for h in handles if h.fresh)
+
+    def warm_async(self, prompt_len, max_new_tokens=None):
+        """Submit background AOT compiles for the (tokens, rows, table
+        width) signatures a single request of `prompt_len` +
+        max_new_tokens will dispatch — chunked prefill steps, every
+        decode-step table-width bucket, AND the sub-chunk token
+        buckets at each of those widths (a prefix-cache hit leaves a
+        short prefill REMAINDER — e.g. one token of a 128-token prompt
+        — which must not compile inline in the scheduler loop on
+        exactly the traffic prefix caching optimizes). Steady-state
+        single-request traffic, prefix-hit remainders at these widths
+        included, then adds ZERO executables (the executable-sharing
+        warmup contract; the canonical gate workload asserts it).
+        Returns jit.warm.WarmHandles; join with jit.warm.join."""
+        if not self.ragged:
+            return []
+        max_new = self.default_max_new if max_new_tokens is None \
+            else int(max_new_tokens)
+        P = self.cache.page_size
+
+        def width(tokens):  # table width bucket once `tokens` are held
+            return self._pow2(-(-tokens // P))
+
+        sigs, filled, total = [], 0, int(prompt_len)
+        while filled < total:
+            n = min(self.prefill_chunk, total - filled)
+            filled += n
+            t_bucket = self._pow2(n)
+            w = width(filled)
+            while t_bucket >= 1:  # sub-chunk remainders at this width
+                sigs.append((t_bucket, 1, w))
+                t_bucket //= 2
+        for k in range(max_new - 1):  # decode k writes token total+k
+            sigs.append((1, 1, width(total + k + 1)))
+        return [self.model.warm_ragged(self.cache, *sig)
+                for sig in dict.fromkeys(sigs)]
 
     def _emit(self, seq, tok):
         """Record one decoded token; stream it; evict on finish — or on
@@ -1082,6 +1400,13 @@ class GenerationEngine(_SchedulerLifecycle):
         seq.handle._push(tok)
         if (h.eos_token_id is not None and tok == h.eos_token_id) \
                 or len(seq.generated) >= h.max_new_tokens:
+            # register the finished prompt's pages for future sharers
+            # BEFORE freeing: the sequence is done writing, so nobody
+            # (itself included) will ever copy-on-write a registered
+            # tail mid-reservation, and the registry hold keeps the
+            # pages alive past free_sequence
+            if self.prefix_cache and seq.filled >= h.prompt.size:
+                self.cache.register_prefix(seq.sid, h.prompt)
             self.cache.free_sequence(seq.sid)
             self._active.remove(seq)
             _monitor.histogram("serve.latency_s").observe(
@@ -1098,7 +1423,7 @@ class GenerationEngine(_SchedulerLifecycle):
         since the last sync. The steady-state health signal: a growing
         count means admit/evict is changing the compiled shapes —
         exactly what plan_decode(pad_to=) exists to prevent."""
-        n = getattr(self.model, "_paged_decode_traces", 0)
+        n = self._model_traces()
         if n > self._synced_traces:
             d = n - self._synced_traces
             self._synced_traces = n
@@ -1109,7 +1434,8 @@ class GenerationEngine(_SchedulerLifecycle):
         """A decode-step failure poisons shared state (donated pools):
         fail every in-flight request loudly rather than hang them."""
         with self._cv:
-            seqs, self._active = list(self._active), []
+            seqs = list(self._active) + list(self._prefilling)
+            self._active, self._prefilling = [], []
             pend, self._pending = list(self._pending), deque()
         for seq in seqs:
             try:
@@ -1124,7 +1450,8 @@ class GenerationEngine(_SchedulerLifecycle):
 
     # -- lifecycle (drain/shutdown via _SchedulerLifecycle) --------------
     def _outstanding(self):
-        return bool(self._pending or self._active or self._admitting)
+        return bool(self._pending or self._active or self._prefilling
+                    or self._admitting)
 
     def _take_pending(self):
         self._abort = True  # the loop thread fails _active itself
@@ -1137,8 +1464,9 @@ class GenerationEngine(_SchedulerLifecycle):
         # _abort flag set by _take_pending has no reader — detach the
         # active set too or their handles hang forever
         out = self._take_pending()
-        out += [(s.handle, s.sid) for s in self._active]
-        self._active = []
+        out += [(s.handle, s.sid)
+                for s in self._active + self._prefilling]
+        self._active, self._prefilling = [], []
         return out
 
     def _reject_detached(self, items, exc):

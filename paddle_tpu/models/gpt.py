@@ -106,6 +106,27 @@ class PagedJitSlot:
         self.lens = lens
 
 
+class RaggedJitSlot:
+    """One layer's state for the fully-jitted RAGGED step (the mixed
+    prefill+decode program over the Pallas kernel in
+    ops/pallas/paged_attention.py): traced/donated k/v pools plus the
+    host plan from PagedKVCache.plan_ragged — per-token scatter
+    coordinates and causal bounds, per-row page tables."""
+
+    __slots__ = ("k", "v", "tok_pages", "tok_in_pages", "page_table",
+                 "token_seq", "bounds")
+
+    def __init__(self, k, v, tok_pages, tok_in_pages, page_table,
+                 token_seq, bounds):
+        self.k = k
+        self.v = v
+        self.tok_pages = tok_pages
+        self.tok_in_pages = tok_in_pages
+        self.page_table = page_table
+        self.token_seq = token_seq
+        self.bounds = bounds
+
+
 def _remat_policy(scan_remat):
     """Map cfg.scan_remat to a jax.checkpoint policy. True → full
     recompute (policy None). "dots" → save non-batch matmul outputs.
@@ -154,6 +175,8 @@ class GPTAttention(nn.Layer):
         q, k, v = qkv.unbind(axis=2)
         if isinstance(cache, StaticCacheSlot):
             return self._forward_static_cache(x, q, k, v, cache)
+        if isinstance(cache, RaggedJitSlot):
+            return self._forward_paged_ragged(x, q, k, v, cache)
         if isinstance(cache, PagedJitSlot):
             return self._forward_paged_jit(x, q, k, v, cache)
         if isinstance(cache, PagedCacheSlot):
@@ -215,6 +238,26 @@ class GPTAttention(nn.Layer):
         out = paged_attention(q.value[:, 0], slot.k, slot.v, slot.pt,
                               slot.lens + 1)
         out = self.out_proj(Tensor(out.reshape(B, 1, H).astype(
+            x.value.dtype)))
+        return out, slot
+
+    def _forward_paged_ragged(self, x, q, k, v, slot):
+        """Traced RAGGED step over the paged pools: one batched scatter
+        writes every token's k/v row into its planned (page, slot), then
+        ONE Pallas ragged-paged-attention call reads each token's own
+        history under its causal bound — decode rows and prefill chunks
+        in the same program, pad tokens (bound 0) skipped outright."""
+        from ..ops.pallas.paged_attention import ragged_paged_attention
+        B, T, H = x.shape  # B == 1: the token axis carries the batch
+        kd = slot.k.dtype
+        slot.k = slot.k.at[slot.tok_pages, slot.tok_in_pages].set(
+            k.value[0].astype(kd))
+        slot.v = slot.v.at[slot.tok_pages, slot.tok_in_pages].set(
+            v.value[0].astype(kd))
+        out = ragged_paged_attention(
+            q.value[0], slot.k, slot.v, slot.page_table, slot.token_seq,
+            slot.bounds)
+        out = self.out_proj(Tensor(out.reshape(1, T, H).astype(
             x.value.dtype)))
         return out, slot
 
@@ -586,6 +629,180 @@ class GPTForCausalLM(nn.Layer):
         for sid in seq_ids:
             cache.advance(sid, 1)
         return Tensor(logits[:B])
+
+    # ---- ragged mixed prefill+decode step ---------------------------
+    RAGGED_TAG = "serve.ragged_step"
+
+    def _ragged_jitted(self):
+        """The one jax.jit wrapper every ragged signature lowers
+        through (pools donated: page writes update HBM in place)."""
+        fn = getattr(self, "_ragged_jit_fn", None)
+        if fn is not None:
+            return fn
+        import jax
+        from ..jit.api import functional_call
+
+        model = self
+        L = self.cfg.num_layers
+
+        def step(ps, kps, vps, toks, pos, tok_seq, tok_pages,
+                 tok_in_pages, bounds, pt, out_idx):
+            # trace-time side effect: exact count of ragged executables
+            # traced (one per novel (T, B, W) signature) — the serving
+            # engine folds the delta into serve.retraces
+            model._ragged_traces = getattr(
+                model, "_ragged_traces", 0) + 1
+            slots = [RaggedJitSlot(kps[l], vps[l], tok_pages,
+                                   tok_in_pages, pt, tok_seq, bounds)
+                     for l in range(L)]
+            logits, out_slots = functional_call(
+                model, ps, {}, (Tensor(toks[None, :]),),
+                kwargs={"caches": slots,
+                        "position_ids": Tensor(pos[None, :])},
+                training=False)
+            last = logits[0][out_idx]          # [B, vocab]
+            # sampling ON DEVICE: the host reads back B int32s, never
+            # the [B, vocab] logits (serving satellite: no vocab-sized
+            # D2H in the decode loop)
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return (last, nxt, [s.k for s in out_slots],
+                    [s.v for s in out_slots])
+
+        fn = self._ragged_jit_fn = jax.jit(step, donate_argnums=(1, 2))
+        return fn
+
+    def ragged_arg_specs(self, cache, n_tokens, n_rows, width):
+        """ShapeDtypeStructs of one ragged-step signature — what
+        `warm_ragged` AOT-compiles ahead of traffic."""
+        import jax
+        from ..jit.api import state_arrays
+        params = getattr(self, "_paged_params", None)
+        if params is None:
+            params = self._paged_params = state_arrays(self)[0]
+        sds = jax.ShapeDtypeStruct
+        pshape = (cache.n_pages, cache.page_size, cache.n_heads,
+                  cache.head_dim)
+        pools = [sds(pshape, cache.k[0].dtype)
+                 for _ in range(self.cfg.num_layers)]
+        i32 = jnp.int32
+        tok = lambda: sds((int(n_tokens),), i32)
+        return (jax.tree.map(lambda a: sds(a.shape, a.dtype), params),
+                pools, list(pools), tok(), tok(), tok(), tok(), tok(),
+                tok(), sds((int(n_rows), int(width)), i32),
+                sds((int(n_rows),), i32))
+
+    _RAGGED_ARG_NAMES = ("params", "k_pages", "v_pages", "tokens",
+                         "positions", "token_seq", "tok_pages",
+                         "tok_in_pages", "bounds", "page_table",
+                         "out_idx")
+
+    @staticmethod
+    def _ragged_sig(cache, n_tokens, n_rows, width):
+        return (int(n_tokens), int(n_rows), int(width),
+                int(cache.n_pages), int(cache.page_size),
+                str(cache.k[0].dtype) if cache.k else "poisoned")
+
+    def warm_ragged(self, cache, n_tokens, n_rows, width, inline=False):
+        """Single-flight AOT compile of one ragged signature through
+        the background warm pipeline (jit/warm.py). Returns the
+        WarmHandle; `handle.result()` is the (compiled, info) entry. A
+        dispatch racing this JOINS the in-flight compile."""
+        from ..jit import warm as _warm
+        from ..jit.api import aot_compile
+        exec_cache = getattr(self, "_ragged_exec", None)
+        if exec_cache is None:
+            exec_cache = self._ragged_exec = {}
+        # the pool geometry is part of the executable's signature: two
+        # engines over one model with different page pools must not
+        # collide on compiled programs
+        sig = self._ragged_sig(cache, n_tokens, n_rows, width)
+        specs = self.ragged_arg_specs(cache, n_tokens, n_rows, width)
+        jitted = self._ragged_jitted()
+
+        def thunk():
+            return aot_compile(jitted, specs, tag=self.RAGGED_TAG,
+                               arg_names=self._RAGGED_ARG_NAMES)
+
+        return _warm.submit_cached(exec_cache, sig, self.RAGGED_TAG,
+                                   thunk, inline=inline)
+
+    def paged_ragged_step(self, cache, rows, pad_to_tokens=None,
+                          pad_to_rows=None):
+        """ONE continuous-batching step over mixed rows: `rows` is a
+        list of (seq_id, token_ids) where decode rows carry one token
+        and prefill-chunk rows carry a slice of their prompt — all
+        advanced in a single jitted program over the Pallas ragged
+        kernel, each token attending only its own paged history (pad
+        tokens do zero attention work).
+
+        Returns (logits Tensor [n_rows, vocab] — each row's LAST
+        token's next-token logits — and next_tokens, a device int32
+        array of their argmax: greedy sampling without a vocab-sized
+        host read). pad_to_tokens/pad_to_rows pin the compiled shape
+        for a serving scheduler."""
+        if cache.k is None:
+            raise RuntimeError(
+                "this PagedKVCache was poisoned by an earlier failed "
+                "step — rebuild it with make_paged_cache() and "
+                "re-prefill in-flight sequences")
+        limit = self.cfg.max_position_embeddings
+        over = [s for s, t in rows
+                if cache.length(s) + len(t) > limit]
+        if over:
+            raise ValueError(
+                f"sequences {over!r} would exceed "
+                f"max_position_embeddings={limit}; free them or raise "
+                "the limit")
+        plan = cache.plan_ragged([(s, len(t)) for s, t in rows],
+                                 pad_to_tokens=pad_to_tokens,
+                                 pad_to_rows=pad_to_rows)
+        T = plan["tok_pages"].shape[0]
+        B, W = plan["page_table"].shape
+        toks = np.zeros((T,), np.int32)
+        off = 0
+        for _, t in rows:
+            toks[off:off + len(t)] = np.asarray(t, np.int32).reshape(-1)
+            off += len(t)
+        from ..jit.api import state_arrays
+        params = getattr(self, "_paged_params", None)
+        if params is None:
+            params = self._paged_params = state_arrays(self)[0]
+        entry = getattr(self, "_ragged_exec", {}).get(
+            self._ragged_sig(cache, T, B, W))
+        if entry is None:
+            # miss: compile inline (single-flight — a concurrent warm
+            # of the same signature is joined, not duplicated)
+            entry = self.warm_ragged(cache, T, B, W,
+                                     inline=True).result()
+        compiled, _ = entry
+        args = (params, list(cache.k), list(cache.v),
+                jnp.asarray(toks), jnp.asarray(plan["positions"]),
+                jnp.asarray(plan["token_seq"]),
+                jnp.asarray(plan["tok_pages"]),
+                jnp.asarray(plan["tok_in_pages"]),
+                jnp.asarray(plan["bounds"]),
+                jnp.asarray(plan["page_table"]),
+                jnp.asarray(plan["out_idx"]))
+        try:
+            last, nxt, new_k, new_v = compiled(*args)
+        except Exception as e:
+            # donation only consumes the pools once the program
+            # EXECUTES; a dispatch failure before that leaves them valid
+            if not any(getattr(a, "is_deleted", lambda: False)()
+                       for a in (*cache.k, *cache.v)):
+                raise
+            cache.k = cache.v = None
+            raise RuntimeError(
+                "jitted ragged step failed AFTER its page pools were "
+                "donated — this PagedKVCache is unrecoverable; rebuild "
+                "it with make_paged_cache() and re-prefill in-flight "
+                "sequences") from e
+        cache.k = list(new_k)
+        cache.v = list(new_v)
+        for s, t in rows:
+            cache.advance(s, len(t))
+        n = plan["n_rows"]
+        return Tensor(last[:n]), nxt[:n]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, top_p=None):

@@ -47,3 +47,15 @@ from .block_sparse import (block_sparse_attention,  # noqa: E402
                            local_strided_pattern)
 
 from .paged_attention import PagedKVCache, paged_attention  # noqa: E402
+
+
+def ragged_paged_attention(*args, **kwargs):
+    """Mixed prefill+decode paged attention (lazy import: the Pallas
+    module stays off the package-import path, like flash_attention)."""
+    from .pallas.paged_attention import ragged_paged_attention as rpa
+    return rpa(*args, **kwargs)
+
+
+def ragged_work_plan(bounds, page_size):
+    from .pallas.paged_attention import ragged_work_plan as rwp
+    return rwp(bounds, page_size)

@@ -12,9 +12,33 @@ attention into ONE XLA gather (pages → [B, max_pages*page_size, H, D])
 plus a masked flash-style softmax — static shapes, jit-stable across
 steps, no per-token recompilation. The allocator is host-side Python
 (free-list of page ids), exactly the part that should not be traced.
+
+Pages are REFCOUNTED, which buys two serving-scale features on top:
+
+- **prefix caching** — finished prompts register their pages in a
+  chain-keyed registry (each node: one page's token block, keyed under
+  its parent block), so a new request whose prompt matches a registered
+  chain `acquire_prefix()`s those pages instead of recomputing their KV
+  — N users behind one system prompt pay for its KV once. Registered
+  pages survive their sequence (the registry is a holder too) and are
+  reclaimed LRU-first when the allocator runs dry.
+- **copy-on-write** — a write into a page referenced by more than one
+  holder first materializes a private copy (one dynamic-slice device
+  copy per layer), so divergence after a shared prefix never corrupts a
+  neighbor — and the original snapshot stays valid for future sharers.
+
+Every write site (extend / plan_decode / plan_ragged) funnels through
+`_ensure_capacity`, which enforces the invariant: a page is never
+written while its refcount is above one.
+
+`plan_ragged` is the host planner for the Pallas ragged kernel
+(ops/pallas/paged_attention.py): ONE jitted step advances mixed
+decode rows and prefill chunks with per-token write coordinates and
+causal bounds — no row pays for another row's padding.
 """
 import functools
 import math
+from collections import OrderedDict
 
 import numpy as np
 import jax
@@ -32,6 +56,16 @@ def _write_block(pool, block, page, in_page):
     return jax.lax.dynamic_update_slice(
         pool, block, (page, in_page,
                       jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_page(pool, src, dst):
+    """Copy-on-write materialization: duplicate one page inside the
+    donated pool (src/dst traced — one program per pool shape)."""
+    z = jnp.zeros((), jnp.int32)
+    page = jax.lax.dynamic_slice(pool, (src, z, z, z),
+                                 (1,) + pool.shape[1:])
+    return jax.lax.dynamic_update_slice(pool, page, (dst, z, z, z))
 
 
 def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None):
@@ -57,13 +91,19 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None):
     return out.astype(q.dtype)
 
 
+_ROOT = 0  # prefix-chain id of the empty prefix
+
+
 class PagedKVCache:
     """Host-side page allocator + device-side page pools (per layer).
 
     write()/extend() copy new k/v into pages with one dynamic_update per
     page touched; sequences allocate pages lazily and release them on
     free() — the pool is shared, so peak HBM tracks the TOTAL tokens in
-    flight, not batch * max_len."""
+    flight, not batch * max_len. Pages are refcounted: prefix caching
+    shares prompt pages across sequences (and retains them LRU past
+    their sequence), copy-on-write materializes a private page before
+    any write to a shared one."""
 
     def __init__(self, n_layers, n_pages, page_size, n_heads, head_dim,
                  dtype=jnp.float32):
@@ -79,6 +119,20 @@ class PagedKVCache:
         self._free = list(range(1, n_pages))
         self._tables = {}   # seq_id -> list of page ids
         self._len = {}      # seq_id -> tokens stored
+        self._ref = {}      # page id -> holders (sequences + registry)
+        self._drawn = {}    # seq_id -> pages DRAWN from the pool (a
+        # shared prefix page is held but was never drawn — reservation
+        # accounting must compare against draws, see pages_drawn)
+        # prefix registry: a trie of page-sized token blocks. Node ids
+        # chain parent -> child; each node owns one registry hold on its
+        # page. _lru orders nodes for reclaim (oldest unused first).
+        self._chain_kids = {}   # parent id -> {token tuple: child id}
+        self._chain_info = {}   # id -> {page, tokens, parent}
+        self._lru = OrderedDict()  # id -> None (insertion/touch order)
+        self._next_chain = _ROOT + 1
+        self._stats = {"prefix_hits": 0, "prefix_hit_tokens": 0,
+                       "prefix_misses": 0, "cow_copies": 0,
+                       "prefix_evictions": 0}
 
     # ---- allocator ----------------------------------------------------
     def add_sequence(self, seq_id):
@@ -86,10 +140,16 @@ class PagedKVCache:
             raise ValueError(f"sequence {seq_id!r} already present")
         self._tables[seq_id] = []
         self._len[seq_id] = 0
+        self._drawn[seq_id] = 0
 
     def free_sequence(self, seq_id):
-        self._free.extend(self._tables.pop(seq_id))
+        """Release a sequence's holds. A page returns to the free list
+        only when NO other holder (sequence or prefix registry) still
+        references it — evicting one sharer never frees shared pages."""
+        for page in self._tables.pop(seq_id):
+            self._deref(page)
         self._len.pop(seq_id)
+        self._drawn.pop(seq_id)
 
     def length(self, seq_id):
         return self._len[seq_id]
@@ -97,44 +157,274 @@ class PagedKVCache:
     def n_free_pages(self):
         return len(self._free)
 
+    def n_evictable_pages(self):
+        """Registered pages held ONLY by the registry — reclaimable on
+        demand (prefix cache retention is best-effort memory)."""
+        return sum(1 for info in self._chain_info.values()
+                   if self._ref.get(info["page"], 0) == 1)
+
     def pages_needed(self, n_tokens):
-        """Pages a FRESH sequence of n_tokens would consume (pages are
-        never shared across sequences)."""
+        """Pages a FRESH sequence of n_tokens would consume, ignoring
+        prefix-cache credit (admission subtracts `match_prefix`'s full
+        pages itself — a partially-matched page earns no credit, its
+        copy-on-write target falls inside this count)."""
         return -(-int(n_tokens) // self.page_size)
 
     def pages_held(self, seq_id):
-        """Pages currently allocated to a sequence. Allocation is lazy
-        (pages are drawn as tokens arrive), so a scheduler reserving
-        worst cases must count each active sequence's outstanding claim
-        (reservation - held), not just n_free_pages()."""
+        """Pages currently in a sequence's table (shared prefix pages
+        count — each table slot is a hold)."""
         return len(self._tables[seq_id])
+
+    def pages_drawn(self, seq_id):
+        """Pages this sequence has DRAWN from the pool (fresh
+        allocations + copy-on-write copies; acquired shared pages are
+        NOT draws). Allocation is lazy, so a scheduler reserving worst
+        cases must count each active sequence's outstanding claim as
+        (reservation - drawn) — with prefix sharing, pages_held
+        overstates draws by the acquired pages and would let claims
+        vanish while copy-on-write + tail pages are still owed."""
+        return self._drawn[seq_id]
+
+    def shared_page_count(self):
+        """Pages with more than one holder (sequences sharing a prefix,
+        or a live page also retained by the prefix registry)."""
+        return sum(1 for r in self._ref.values() if r > 1)
 
     def can_allocate(self, n_tokens, reserved=0):
         """Admission control: True when a new sequence of n_tokens fits
-        the free list AFTER `reserved` pages of outstanding claims.
-        Allocation is lazy, so the free list alone overstates what is
-        safely available: a scheduler reserving each request's worst
-        case (prompt + max_new_tokens) must pass the sum of
-        (reservation - pages_held) over its active sequences — with
-        that term a mid-decode out-of-pages is impossible (see
-        GenerationEngine._admit)."""
+        the free list PLUS the prefix registry's evictable retention,
+        AFTER `reserved` pages of outstanding claims. Allocation is
+        lazy, so the free list alone overstates what is safely
+        available: a scheduler reserving each request's worst case
+        (prompt + max_new_tokens, credited with fully-matched prefix
+        pages) must pass the sum of (reservation - pages_drawn) over
+        its active sequences — with that term a mid-decode
+        out-of-pages is impossible (see GenerationEngine._admit)."""
         return self.pages_needed(n_tokens) + int(reserved) \
-            <= len(self._free)
+            <= len(self._free) + self.n_evictable_pages()
+
+    def _deref(self, page):
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            del self._ref[page]
+            self._free.append(page)
+
+    def _alloc_page(self):
+        if not self._free:
+            self._reclaim(1)
+        if not self._free:
+            raise RuntimeError(
+                f"PagedKVCache out of pages (free 0, evictable 0) — "
+                "free finished sequences or grow n_pages")
+        page = self._free.pop()
+        self._ref[page] = 1
+        return page
+
+    def _materialize(self, seq_id, page_idx):
+        """Copy-on-write: give seq_id a private copy of its table entry
+        `page_idx` (device copy of the page in every layer's pool)."""
+        old = self._tables[seq_id][page_idx]
+        new = self._alloc_page()
+        for layer in range(self.n_layers):
+            self.k[layer] = _copy_page(self.k[layer], jnp.int32(old),
+                                       jnp.int32(new))
+            self.v[layer] = _copy_page(self.v[layer], jnp.int32(old),
+                                       jnp.int32(new))
+        self._tables[seq_id][page_idx] = new
+        self._deref(old)
+        self._drawn[seq_id] += 1
+        self._stats["cow_copies"] += 1
+        return new
 
     def _ensure_capacity(self, seq_id, n_new):
-        need = self._len[seq_id] + n_new
-        have = len(self._tables[seq_id]) * self.page_size
-        n_pages = -(-max(need - have, 0) // self.page_size)
-        if n_pages > len(self._free):
-            # atomic: raise BEFORE touching the free list, so a caught
-            # allocation failure leaves the pool consistent (a scheduler
-            # can defer this sequence and admit a smaller one)
+        """Make the next n_new token writes safe: enough pages appended
+        to cover them, and every page in the write range OWNED (copy-
+        on-write materialization of shared ones). Atomic: raises BEFORE
+        touching the pool, so a caught allocation failure leaves it
+        consistent (a scheduler can defer this sequence and admit a
+        smaller one)."""
+        P = self.page_size
+        table = self._tables[seq_id]
+        pos = self._len[seq_id]
+        need = pos + n_new
+        have = len(table) * P
+        n_pages = -(-max(need - have, 0) // P)
+        last = (need - 1) // P
+        cow = [i for i in range(pos // P, min(len(table), last + 1))
+               if self._ref[table[i]] > 1]
+        # fast path first: n_evictable_pages() walks the whole prefix
+        # registry, and this runs per row per decode step — only pay
+        # the scan when the free list alone cannot cover the writes
+        if n_pages + len(cow) > len(self._free) and \
+                n_pages + len(cow) > len(self._free) \
+                + self.n_evictable_pages():
             raise RuntimeError(
-                f"PagedKVCache out of pages (need {n_pages}, free "
-                f"{len(self._free)}) — free finished sequences or grow "
-                f"n_pages")
+                f"PagedKVCache out of pages (need {n_pages + len(cow)}, "
+                f"free {len(self._free)}, evictable "
+                f"{self.n_evictable_pages()}) — free finished sequences "
+                "or grow n_pages")
+        for i in cow:
+            self._materialize(seq_id, i)
         for _ in range(n_pages):
-            self._tables[seq_id].append(self._free.pop())
+            table.append(self._alloc_page())
+        self._drawn[seq_id] += n_pages
+
+    # ---- prefix caching ----------------------------------------------
+    def _walk_prefix(self, token_ids, max_tokens=None):
+        """Longest registered chain matching token_ids[:max_tokens]:
+        [(chain id, page, tokens taken)]. The final entry may take a
+        page PARTIALLY (a divergence point or the max_tokens cap) — the
+        sharer's first write there goes through copy-on-write."""
+        tokens = [int(t) for t in np.asarray(token_ids).reshape(-1)]
+        limit = len(tokens) if max_tokens is None \
+            else min(len(tokens), int(max_tokens))
+        out, parent, off = [], _ROOT, 0
+        while off < limit:
+            kids = self._chain_kids.get(parent)
+            if not kids:
+                break
+            span = tokens[off:limit]
+            exact = tuple(span[:self.page_size])
+            cid = kids.get(exact) \
+                if len(exact) == self.page_size else None
+            if cid is not None:
+                out.append((cid, self._chain_info[cid]["page"],
+                            self.page_size))
+                parent, off = cid, off + self.page_size
+                continue
+            best, best_n = None, 0
+            for ktoks, kcid in kids.items():
+                n = 0
+                for a, b in zip(ktoks, span):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best_n:
+                    best, best_n = kcid, n
+            if best is not None:
+                out.append((best, self._chain_info[best]["page"], best_n))
+            break
+        return out
+
+    def match_prefix(self, token_ids, max_tokens=None):
+        """Peek (no side effects): (cached tokens, FULLY-matched pages)
+        for this prompt. Admission credit = full pages only — a partial
+        match still shares KV but its page will be copy-on-written, so
+        it earns no reservation credit."""
+        n, full, _ = self.match_prefix_credit(token_ids, max_tokens)
+        return n, full
+
+    def match_prefix_credit(self, token_ids, max_tokens=None):
+        """match_prefix plus the supply-side correction a scheduler
+        needs: (cached tokens, fully-matched pages, pinned). `pinned`
+        counts matched pages currently held ONLY by the registry —
+        today's evictable supply that acquire_prefix will PIN (ref 2).
+        Admission must subtract it from the evictable pool or the
+        prefix credit double-counts: the same pages would back both
+        the reduced need AND the supply, over-admitting into a
+        mid-decode out-of-pages."""
+        chain = self._walk_prefix(token_ids, max_tokens)
+        n = sum(took for _, _, took in chain)
+        full = sum(1 for _, _, took in chain if took == self.page_size)
+        pinned = sum(1 for _, page, _ in chain
+                     if self._ref.get(page, 0) == 1)
+        return n, full, pinned
+
+    def acquire_prefix(self, seq_id, token_ids, max_tokens=None):
+        """Attach the longest matching registered chain to a FRESH
+        sequence (one hold per page) and set its length to the cached
+        token count — the caller prefills only what remains. Returns
+        the cached token count (0 = miss)."""
+        if self._tables[seq_id] or self._len[seq_id]:
+            raise ValueError(
+                f"acquire_prefix: sequence {seq_id!r} is not fresh")
+        chain = self._walk_prefix(token_ids, max_tokens)
+        n = 0
+        for cid, page, took in chain:
+            self._tables[seq_id].append(page)
+            self._ref[page] += 1
+            self._lru.move_to_end(cid)
+            n += took
+        self._len[seq_id] = n
+        if n:
+            self._stats["prefix_hits"] += 1
+            self._stats["prefix_hit_tokens"] += n
+        else:
+            self._stats["prefix_misses"] += 1
+        return n
+
+    def register_prefix(self, seq_id, token_ids):
+        """Register a fully-written prompt's pages in the prefix
+        registry (call AFTER the prompt's KV is in the pool). Each new
+        node adds a registry hold, so the pages outlive the sequence —
+        until LRU reclaim needs them back. Already-registered blocks
+        (an earlier identical prompt) are only LRU-touched; the
+        sequence's own duplicate pages stay private."""
+        tokens = [int(t) for t in np.asarray(token_ids).reshape(-1)]
+        if self._len[seq_id] < len(tokens):
+            raise ValueError(
+                f"register_prefix: sequence {seq_id!r} holds "
+                f"{self._len[seq_id]} tokens < prompt {len(tokens)}")
+        table = self._tables[seq_id]
+        P = self.page_size
+        parent, off, idx = _ROOT, 0, 0
+        while off < len(tokens):
+            took = min(P, len(tokens) - off)
+            toks = tuple(tokens[off:off + took])
+            kids = self._chain_kids.setdefault(parent, {})
+            cid = kids.get(toks)
+            if cid is None:
+                cid = self._next_chain
+                self._next_chain += 1
+                kids[toks] = cid
+                page = table[idx]
+                self._chain_info[cid] = {"page": page, "tokens": toks,
+                                         "parent": parent}
+                self._ref[page] += 1
+                self._lru[cid] = None
+            else:
+                self._lru.move_to_end(cid)
+            if took < P:
+                break  # a partial block is a leaf (children start
+                # page-aligned), and nothing past the prompt registers
+            parent, off, idx = cid, off + took, idx + 1
+
+    def _evict_chain(self, cid):
+        """Deregister the subtree rooted at cid (a parent's KV is
+        useless for matching once gone). Pages drop their registry
+        hold; those no live sequence shares free immediately.
+        Iterative walk: a registered chain is one node per PAGE, so a
+        long-context prompt would blow Python's recursion limit."""
+        stack, subtree = [cid], []
+        while stack:
+            node = stack.pop()
+            subtree.append(node)
+            stack.extend(self._chain_kids.get(node, {}).values())
+        for node in subtree:
+            self._chain_kids.pop(node, None)
+            info = self._chain_info.pop(node)
+            parent_kids = self._chain_kids.get(info["parent"])
+            if parent_kids is not None:
+                parent_kids.pop(info["tokens"], None)
+            self._lru.pop(node, None)
+            self._stats["prefix_evictions"] += 1
+            self._deref(info["page"])
+
+    def _reclaim(self, n_pages):
+        """Evict LRU prefix chains until n_pages are free (or the
+        registry is empty — shared pages never free from under a live
+        sequence, they only lose future matchability)."""
+        while len(self._free) < n_pages and self._lru:
+            self._evict_chain(next(iter(self._lru)))
+
+    def prefix_stats(self):
+        """Counters + current registry shape (hits/misses are per
+        acquire_prefix call; hit_tokens the KV tokens served from
+        cache; cow_copies the materialized divergences)."""
+        return dict(self._stats,
+                    registered_pages=len(self._chain_info),
+                    shared_pages=self.shared_page_count(),
+                    evictable_pages=self.n_evictable_pages())
 
     # ---- writes -------------------------------------------------------
     def extend(self, seq_id, layer, k_new, v_new):
@@ -202,6 +492,89 @@ class PagedKVCache:
                 [pt, jnp.zeros((n_pad, pt.shape[1]), jnp.int32)])
             lens = jnp.concatenate([lens, jnp.zeros((n_pad,), jnp.int32)])
         return jnp.asarray(pages), jnp.asarray(in_pages), pt, lens
+
+    def plan_ragged(self, rows, pad_to_tokens=None, pad_to_rows=None):
+        """Host-side plan for ONE jitted RAGGED step (the Pallas kernel
+        in ops/pallas/paged_attention.py): `rows` is a list of
+        (seq_id, n_new_tokens) mixing decode rows (1) and prefill
+        chunks (n). Capacity is ensured (with copy-on-write) for every
+        row, then per-token write coordinates and causal bounds come
+        back as a dict of host arrays:
+
+            tok_pages/tok_in_pages [T]  scatter coordinates
+            token_seq [T]   row index into page_table per token
+            positions [T]   absolute position (pre-write len + offset)
+            bounds [T]      kv tokens visible (position + 1; 0 = pad)
+            page_table [B, W] int32 (width pow2-bucketed, 0-padded)
+            out_idx [B]     flat index of each row's LAST token
+            n_tokens/n_rows the REAL counts before padding
+
+        pad_to_tokens/pad_to_rows pad to fixed compiled shapes: pad
+        tokens scatter into the reserved pad page with bound 0 — the
+        kernel SKIPS them, so padding costs no attention work (the
+        whole point vs plan_decode's bucket rows). Lengths are
+        pre-write; advance(sid, n) after the step commits."""
+        sids = [s for s, _ in rows]
+        if len(set(sids)) != len(sids):
+            raise ValueError(f"duplicate seq_ids in ragged step: {sids!r}")
+        for s, n in rows:
+            if n < 1:
+                raise ValueError(f"row {s!r}: n_new_tokens must be >= 1")
+            self._ensure_capacity(s, n)
+        P = self.page_size
+        tok_pages, tok_in, tok_seq, tok_pos, bounds, out_idx = \
+            [], [], [], [], [], []
+        for i, (s, n) in enumerate(rows):
+            start = self._len[s]
+            table = self._tables[s]
+            for k in range(n):
+                pos = start + k
+                tok_pages.append(table[pos // P])
+                tok_in.append(pos % P)
+                tok_seq.append(i)
+                tok_pos.append(pos)
+                bounds.append(pos + 1)
+            out_idx.append(len(tok_pages) - 1)
+        T, B = len(tok_pages), len(rows)
+        n_tok_pad = 0
+        if pad_to_tokens is not None:
+            n_tok_pad = int(pad_to_tokens) - T
+            if n_tok_pad < 0:
+                raise ValueError(f"pad_to_tokens={pad_to_tokens} < {T}")
+        n_row_pad = 0
+        if pad_to_rows is not None:
+            n_row_pad = int(pad_to_rows) - B
+            if n_row_pad < 0:
+                raise ValueError(f"pad_to_rows={pad_to_rows} < {B}")
+        # host-built table (NOT batch_views: that returns a device
+        # array, and a np.asarray round-trip here would be a blocking
+        # D2H read in the decode hot loop)
+        tables = [self._tables[s] for s in sids]
+        width = max(1, max(len(t) for t in tables))
+        width = 1 << (width - 1).bit_length()  # pow2 bucket, as views
+        pt = np.zeros((B + n_row_pad, width), np.int32)
+        for i, t in enumerate(tables):
+            pt[i, :len(t)] = t
+        # pad tokens: pad page 0 / slot 0, bound 0 (kernel skips), row
+        # index pointing at a zeroed pad row when one exists
+        pad_row = B if n_row_pad else 0
+        tok_pages += [0] * n_tok_pad
+        tok_in += [0] * n_tok_pad
+        tok_seq += [pad_row] * n_tok_pad
+        tok_pos += [0] * n_tok_pad
+        bounds += [0] * n_tok_pad
+        out_idx += [0] * n_row_pad
+        return {
+            "tok_pages": np.asarray(tok_pages, np.int32),
+            "tok_in_pages": np.asarray(tok_in, np.int32),
+            "token_seq": np.asarray(tok_seq, np.int32),
+            "positions": np.asarray(tok_pos, np.int32),
+            "bounds": np.asarray(bounds, np.int32),
+            "page_table": pt.astype(np.int32),
+            "out_idx": np.asarray(out_idx, np.int32),
+            "n_tokens": T,
+            "n_rows": B,
+        }
 
     # ---- reads --------------------------------------------------------
     def batch_views(self, seq_ids):

@@ -1,0 +1,167 @@
+"""Ragged paged attention for TPU in Pallas.
+
+The serving-side twin of flash_attention.py (see PAPERS.md "Ragged
+Paged Attention: A High-Performance and Flexible LLM Inference Kernel
+for TPU"): ONE kernel call processes a batch of query tokens whose rows
+belong to DIFFERENT sequences at DIFFERENT lengths — decode rows (one
+token against a long history) and prefill-chunk rows (a slice of a
+prompt against its own growing history) mix freely. Per-token causal
+bounds drive the page-table walk, so no row ever pays for another
+row's padding:
+
+- the grid is (token, head, kv-page-slot); the page id each program
+  reads comes from a scalar-prefetched per-token page table, so the
+  DMA walks each sequence's own pages;
+- a kv slot at or past the token's causal bound is SKIPPED outright
+  (`pl.when` predication — on TPU the grid is sequential, a skipped
+  block costs ~nothing). A pad token (bound 0) therefore does ZERO
+  attention work; a decode token next to a 2048-token neighbor does
+  exactly ceil(len/page) blocks of its own.
+
+The kernel also emits a per-token WORK counter (kv blocks actually
+computed) — the ground truth behind the serving engine's
+`pad_token_fraction` metric and the tests' skip-proof, not an estimate.
+
+Softmax is the standard online/flash formulation in f32 scratch. On
+CPU (tier-1 tests) the same kernel runs in Pallas interpret mode, so
+the serving engine exercises identical code on every backend.
+"""
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import I0, NEG_INF
+
+__all__ = ["ragged_paged_attention"]
+
+
+def _kernel(pt_ref, bd_ref, q_ref, k_ref, v_ref, o_ref, w_ref,
+            m_ref, l_ref, acc_ref, *, page_size, scale):
+    """One (token t, head h, kv slot j) program: online-softmax update
+    of token t's head-h accumulator with page `pt[t, j]`, skipped when
+    the slot starts at or past the token's causal bound."""
+    h = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(NEG_INF))
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when((j == 0) & (h == 0))
+    def _init_work():
+        w_ref[0, 0] = jnp.int32(0)
+
+    bound = bd_ref[pl.program_id(0)]
+
+    @pl.when(j * page_size < bound)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [D]
+        k = k_ref[0, :, 0].astype(jnp.float32)       # [P, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)       # [P, D]
+        s = jax.lax.dot_general(q[None, :], k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * jnp.float32(scale)                   # [1, P]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < bound, s, jnp.float32(NEG_INF))
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+        @pl.when(h == 0)
+        def _count():
+            w_ref[0, 0] += jnp.int32(1)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        # a fully-skipped token (bound 0: pad slot) divides 0 by the
+        # floor and writes zeros — garbage by construction, sliced off
+        # by the caller
+        l = jnp.maximum(l_ref[:], jnp.float32(1e-30))
+        o_ref[0, 0] = (acc_ref[:] / l[:, None])[0].astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table, token_seq,
+                           bounds, scale=None, interpret=None,
+                           return_work=False):
+    """Mixed prefill+decode attention over paged KV state.
+
+    q:          [T, H, D]  query tokens, any mix of sequences/phases
+    k_pages:    [n_pages, P, H, D]  shared page pools
+    v_pages:    [n_pages, P, H, D]
+    page_table: [B, W] int32 page ids per sequence (pad page 0)
+    token_seq:  [T] int32  page_table row of each token
+    bounds:     [T] int32  kv tokens visible to each token (causal:
+                history + preceding new tokens + itself); 0 marks a pad
+                token that does NO work
+    Returns [T, H, D] (and, with return_work, the per-token count of
+    kv page blocks actually computed — ceil(bound/P), 0 for pads)."""
+    T, H, D = q.shape
+    P = k_pages.shape[1]
+    W = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # per-token page rows: ONE tiny gather so the index maps stay pure
+    # scalar reads (page_table rows are shared by a sequence's tokens)
+    tok_pt = jnp.take(page_table.astype(jnp.int32),
+                      token_seq.astype(jnp.int32), axis=0)
+    out, work = pl.pallas_call(
+        functools.partial(_kernel, page_size=P, scale=float(scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(T, H, W),
+            in_specs=[
+                pl.BlockSpec((1, 1, D),
+                             lambda t, h, j, pt, bd: (t, h, I0)),
+                pl.BlockSpec((1, P, 1, D),
+                             lambda t, h, j, pt, bd: (pt[t, j], I0, h, I0)),
+                pl.BlockSpec((1, P, 1, D),
+                             lambda t, h, j, pt, bd: (pt[t, j], I0, h, I0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, D),
+                             lambda t, h, j, pt, bd: (t, h, I0)),
+                # work lives in a [T, 1] column: trailing (1, 1) blocks
+                # keep the revisited accumulator on one resident tile
+                pl.BlockSpec((1, 1), lambda t, h, j, pt, bd: (t, I0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((1,), jnp.float32),       # m (running max)
+                pltpu.VMEM((1,), jnp.float32),       # l (running sum)
+                pltpu.VMEM((1, D), jnp.float32),     # acc
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((T, H, D), q.dtype),
+            jax.ShapeDtypeStruct((T, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(tok_pt, bounds.astype(jnp.int32), q, k_pages, v_pages)
+    if return_work:
+        return out, work[:, 0]
+    return out
+
+
+def ragged_work_plan(bounds, page_size):
+    """Host-side mirror of the kernel's work counter: kv blocks each
+    token will compute (ceil(bound/P); 0 for pads). The serving engine
+    uses this to report `pad_token_fraction` without reading the work
+    output back per step."""
+    b = np.asarray(bounds, np.int64)
+    return -(-b // int(page_size)) * (b > 0)

@@ -1,0 +1,596 @@
+"""Ragged paged attention + chunked prefill + refcounted prefix caching.
+
+Covers the serving-throughput tentpole end to end on CPU (the Pallas
+kernel runs in interpret mode — the same code path TPU compiles):
+
+- kernel numerics vs a dense per-token reference, mixed prefill+decode
+  rows, and the work counter PROVING pad tokens compute zero blocks
+- plan_ragged coordinates (positions, bounds, pads, copy-on-write)
+- paged_ragged_step token-for-token vs the plan_decode path
+- GenerationEngine ragged mode: equality incl. mid-stream admit/evict,
+  chunked-prefill boundaries, long prompts not stalling short ones
+- refcounted prefix caching: N identical system prompts hold ONE copy
+  of the shared pages, eviction of a sharer never frees them,
+  copy-on-write divergence keeps every fork correct, LRU reclaim
+- serve-record schema fields (prefix_hits, shared_pages,
+  chunked_prefill_tokens, pad_token_fraction) and the hot-sync fence
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+from paddle_tpu.ops.paged_attention import PagedKVCache
+from paddle_tpu.ops.pallas.paged_attention import (ragged_paged_attention,
+                                                   ragged_work_plan)
+from paddle_tpu.inference import GenerationEngine
+from paddle_tpu.profiler import monitor
+
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick gate no
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the kernel ---------------------------------------------------------
+
+def _dense_token_ref(q_tok, k_pages, v_pages, table, bound):
+    """One query token against `bound` kv tokens of its own pages."""
+    H, D = q_tok.shape
+    P = k_pages.shape[1]
+    ks = k_pages[table].reshape(-1, H, D)[:bound]
+    vs = v_pages[table].reshape(-1, H, D)[:bound]
+    s = np.einsum("hd,thd->ht", q_tok, ks) / np.sqrt(D)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    return np.einsum("ht,thd->hd", p, vs)
+
+
+class TestRaggedKernel:
+    def _setup(self, seed=0):
+        rng = np.random.RandomState(seed)
+        H, D, P = 2, 4, 4
+        k_pages = rng.randn(8, P, H, D).astype(np.float32)
+        v_pages = rng.randn(8, P, H, D).astype(np.float32)
+        pt = np.array([[1, 2, 6], [3, 4, 5]], np.int32)
+        return rng, H, D, P, k_pages, v_pages, pt
+
+    def test_mixed_prefill_decode_matches_dense(self):
+        """One call: a decode token (seq 0), a 3-token prefill chunk
+        (seq 1), and a pad token — each row against its OWN history."""
+        rng, H, D, P, kp, vp, pt = self._setup()
+        token_seq = np.array([0, 1, 1, 1, 0], np.int32)
+        bounds = np.array([7, 9, 10, 11, 0], np.int32)
+        q = rng.randn(5, H, D).astype(np.float32)
+        out, work = ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray(token_seq), jnp.asarray(bounds),
+            interpret=True, return_work=True)
+        out = np.asarray(out)
+        for i in range(5):
+            if bounds[i] == 0:
+                continue
+            want = _dense_token_ref(q[i], kp, vp, pt[token_seq[i]],
+                                    bounds[i])
+            np.testing.assert_allclose(out[i], want, rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_work_counter_pad_tokens_compute_zero_blocks(self):
+        """The skip-proof: the kernel reports exactly ceil(bound/P)
+        computed kv blocks per token and ZERO for pads — no row pays
+        for another row's padding, by measurement not by claim."""
+        rng, H, D, P, kp, vp, pt = self._setup(1)
+        token_seq = np.array([0, 1, 0, 0], np.int32)
+        bounds = np.array([12, 3, 0, 0], np.int32)
+        q = rng.randn(4, H, D).astype(np.float32)
+        _, work = ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray(token_seq), jnp.asarray(bounds),
+            interpret=True, return_work=True)
+        assert np.asarray(work).tolist() == [3, 1, 0, 0]
+        assert ragged_work_plan(bounds, P).tolist() == [3, 1, 0, 0]
+
+    def test_jit_composes(self):
+        """The kernel traces inside jax.jit (how the serving step uses
+        it) and the compiled program is reused."""
+        rng, H, D, P, kp, vp, pt = self._setup(2)
+        fn = jax.jit(lambda *a: ragged_paged_attention(*a,
+                                                       interpret=True))
+        args = (jnp.asarray(rng.randn(2, H, D).astype(np.float32)),
+                jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(pt),
+                jnp.asarray(np.array([0, 1], np.int32)),
+                jnp.asarray(np.array([5, 9], np.int32)))
+        a = fn(*args)
+        b = fn(*args)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        assert fn._cache_size() == 1
+
+
+# -- plan_ragged + copy-on-write ---------------------------------------
+
+class TestPlanRagged:
+    def test_coordinates_positions_bounds_and_pads(self):
+        c = PagedKVCache(1, 16, 4, 1, 2)
+        c.add_sequence("a")
+        c.add_sequence("b")
+        c.extend("a", 0, jnp.zeros((6, 1, 2)), jnp.zeros((6, 1, 2)))
+        c.advance("a", 6)
+        plan = c.plan_ragged([("a", 1), ("b", 3)], pad_to_tokens=8,
+                             pad_to_rows=4)
+        # a decodes at pos 6 (page idx 1, slot 2); b prefills 0..2
+        assert plan["positions"][:4].tolist() == [6, 0, 1, 2]
+        assert plan["bounds"].tolist() == [7, 1, 2, 3, 0, 0, 0, 0]
+        assert plan["token_seq"][:4].tolist() == [0, 1, 1, 1]
+        assert plan["tok_in_pages"][:4].tolist() == [2, 0, 1, 2]
+        assert plan["out_idx"][:2].tolist() == [0, 3]
+        assert plan["page_table"].shape[0] == 4  # padded rows
+        assert plan["n_tokens"] == 4 and plan["n_rows"] == 2
+        # pad tokens scatter into the reserved pad page 0
+        assert set(plan["tok_pages"][4:].tolist()) == {0}
+
+    def test_plan_decode_write_into_shared_page_cows(self):
+        """A decode write landing in a page another holder shares must
+        materialize a private copy first (the invariant every write
+        site enforces)."""
+        c = PagedKVCache(1, 16, 4, 1, 2)
+        c.add_sequence("a")
+        kv = np.arange(3 * 2, dtype=np.float32).reshape(3, 1, 2)
+        c.extend("a", 0, jnp.asarray(kv), jnp.asarray(kv))
+        c.advance("a", 3)
+        c.register_prefix("a", [7, 8, 9])  # partial page registered
+        shared = c._tables["a"][0]
+        assert c._ref[shared] == 2  # a + registry
+        c.plan_decode(["a"])  # writes pos 3 -> same page -> CoW
+        assert c._tables["a"][0] != shared
+        assert c._ref[shared] == 1  # registry keeps the original
+        got = np.asarray(c.k[0][c._tables["a"][0]])[:3]
+        np.testing.assert_allclose(got.reshape(3, 1, 2), kv)
+
+
+# -- model step equality ------------------------------------------------
+
+def _tiny_lm(seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _ref_greedy(m, prompt, max_new):
+    """Oracle: single-sequence LEGACY paged decode, one request alone."""
+    cache = m.make_paged_cache(n_pages=64, page_size=4)
+    cache.add_sequence("s")
+    logits = m.paged_decode_step(
+        cache, ["s"], paddle.to_tensor(prompt[None].astype(np.int64)))
+    toks = [int(np.asarray(logits.value)[0].argmax())]
+    while len(toks) < max_new:
+        logits = m.paged_decode_step(
+            cache, ["s"],
+            paddle.to_tensor(np.array([[toks[-1]]], np.int64)))
+        toks.append(int(np.asarray(logits.value)[0].argmax()))
+    return toks
+
+
+class TestPagedRaggedStep:
+    def test_mixed_step_token_for_token_vs_legacy(self):
+        """Chunked prefill of one sequence interleaved with another's
+        decode — every sampled token equal to the legacy path."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(0)
+        p1, p2 = rng.randint(0, 64, (5,)), rng.randint(0, 64, (3,))
+        r1, r2 = _ref_greedy(m, p1, 4), _ref_greedy(m, p2, 4)
+
+        cache = m.make_paged_cache(n_pages=64, page_size=4)
+        cache.add_sequence("a")
+        cache.add_sequence("b")
+        _, nxt = m.paged_ragged_step(cache, [("b", p2), ("a", p1[:2])])
+        b_toks = [int(np.asarray(nxt)[0])]
+        _, nxt = m.paged_ragged_step(
+            cache, [("b", [b_toks[-1]]), ("a", p1[2:])],
+            pad_to_tokens=8, pad_to_rows=2)
+        nx = np.asarray(nxt)
+        b_toks.append(int(nx[0]))
+        a_toks = [int(nx[1])]
+        while len(a_toks) < 4:
+            rows = []
+            if len(b_toks) < 4:
+                rows.append(("b", [b_toks[-1]]))
+            rows.append(("a", [a_toks[-1]]))
+            _, nxt = m.paged_ragged_step(cache, rows, pad_to_tokens=2,
+                                         pad_to_rows=2)
+            nx = np.asarray(nxt)
+            i = 0
+            if len(b_toks) < 4:
+                b_toks.append(int(nx[0]))
+                i = 1
+            a_toks.append(int(nx[i]))
+        assert a_toks == r1 and b_toks == r2
+
+    def test_sampling_stays_on_device(self):
+        """paged_ragged_step returns the argmax as a device int32
+        array — the serving loop never reads [vocab] logits."""
+        m = _tiny_lm()
+        cache = m.make_paged_cache(n_pages=16, page_size=4)
+        cache.add_sequence("s")
+        logits, nxt = m.paged_ragged_step(cache, [("s", [1, 2, 3])])
+        assert isinstance(nxt, jax.Array)
+        assert nxt.dtype == jnp.int32 and nxt.shape == (1,)
+        assert int(np.asarray(logits.value)[0].argmax()) == int(nxt[0])
+
+
+# -- the engine: ragged mode -------------------------------------------
+
+class TestRaggedEngine:
+    def test_equality_with_mid_stream_admit_and_evict(self):
+        m = _tiny_lm()
+        rng = np.random.RandomState(1)
+        p1, p2, p3 = (rng.randint(0, 64, (n,)) for n in (4, 6, 3))
+        r1 = _ref_greedy(m, p1, 2)
+        r2 = _ref_greedy(m, p2, 10)
+        r3 = _ref_greedy(m, p3, 4)
+        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+                               max_batch=2, max_new_tokens=10)
+        assert eng.ragged  # GPT serves through the ragged path
+        try:
+            h1 = eng.submit(p1, max_new_tokens=2)
+            h2 = eng.submit(p2, max_new_tokens=10)
+            streamed1 = list(h1.tokens())
+            h3 = eng.submit(p3, max_new_tokens=4)
+            assert streamed1 == r1
+            assert h2.result(timeout=300).tolist() == r2
+            assert h3.result(timeout=300).tolist() == r3
+        finally:
+            eng.shutdown()
+
+    def test_chunked_prefill_boundaries(self):
+        """Prompt lengths that are not chunk multiples admit over
+        several mixed steps and still match the oracle; the chunk
+        metric counts every prompt token exactly once."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(2)
+        p_long = rng.randint(0, 64, (9,))   # chunks of 4: 4+4+1
+        p_short = rng.randint(0, 64, (2,))
+        r_long = _ref_greedy(m, p_long, 3)
+        r_short = _ref_greedy(m, p_short, 3)
+        c0 = monitor.counter("serve.chunked_prefill_tokens").value
+        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+                               max_batch=2, max_new_tokens=3,
+                               prefill_chunk=4, prefix_cache=False)
+        try:
+            h_long = eng.submit(p_long)
+            h_short = eng.submit(p_short)
+            assert h_long.result(timeout=300).tolist() == r_long
+            assert h_short.result(timeout=300).tolist() == r_short
+        finally:
+            eng.shutdown()
+        added = monitor.counter("serve.chunked_prefill_tokens").value - c0
+        assert added == p_long.size + p_short.size
+
+    def test_long_prompt_does_not_stall_short_one(self):
+        """Chunked prefill interleaves: a short prompt submitted with a
+        long one gets its first token while the long one is still
+        admitting — TTFT ordering, the admission-stall fix."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(3)
+        p_long = rng.randint(0, 64, (30,))
+        p_short = rng.randint(0, 64, (2,))
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=2, prefill_chunk=2,
+                               prefix_cache=False)
+        try:
+            h_long = eng.submit(p_long)
+            h_short = eng.submit(p_short)
+            import time as _t
+            t0 = _t.perf_counter()
+            next(iter(h_short.tokens()))
+            short_ttft = _t.perf_counter() - t0
+            assert not h_long.future.done() or short_ttft >= 0
+            # the long prompt (15 chunks) cannot have finished before
+            # the short one produced its first token
+            long_done_first = h_long.future.done() and \
+                not h_short.future.done()
+            assert not long_done_first
+            h_long.result(timeout=300)
+            h_short.result(timeout=300)
+        finally:
+            eng.shutdown()
+
+    def test_retraces_counted_then_stable(self):
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=3)
+        try:
+            eng.submit(np.array([5, 9, 4])).result(timeout=300)
+            warm = eng.retraces
+            assert warm >= 1
+            eng.submit(np.array([8, 1, 2])).result(timeout=300)
+            # same shapes + prefix cache shortening the second prefill
+            # to an already-compiled signature: zero new compiles
+            assert eng.retraces == warm
+        finally:
+            eng.shutdown()
+
+    def test_warm_async_then_steady_adds_zero_signatures(self):
+        from paddle_tpu.profiler import compile_observatory as cobs
+        m = _tiny_lm()
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=3, prefix_cache=False)
+        try:
+            eng.warm(5, 3)
+            warmed = cobs.ledger_signatures()
+            eng.submit(np.random.RandomState(4).randint(0, 64, (5,))
+                       ).result(timeout=300)
+            steady = cobs.ledger_signatures()
+            assert steady == warmed, sorted(steady - warmed)
+        finally:
+            eng.shutdown()
+
+
+# -- prefix caching through the engine ---------------------------------
+
+class TestPrefixCaching:
+    def test_n_sequences_one_refcounted_copy(self):
+        """Acceptance: N requests behind one identical system prompt
+        hold exactly ONE copy of its full pages (pages_held counts the
+        SAME page ids), and page consumption reflects the sharing."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, 64, (9,))  # 2 full pages + partial
+        ref = _ref_greedy(m, prompt, 3)
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=4,
+                               max_new_tokens=3)
+        try:
+            # first request populates + registers the chain
+            assert eng.submit(prompt).result(
+                timeout=300).tolist() == ref
+            # queue all N atomically, so they admit and decode together
+            with eng._cv:
+                handles = [eng.submit(prompt) for _ in range(3)]
+            outs = [h.result(timeout=300).tolist() for h in handles]
+            assert outs == [ref] * 3
+            st = eng.cache.prefix_stats()
+            assert st["prefix_hits"] >= 3
+            # every sharer matched the 2 FULL pages (8 tokens each)
+            assert st["prefix_hit_tokens"] >= 3 * 8
+        finally:
+            eng.shutdown()
+
+    def test_shared_pages_are_one_copy_while_decoding(self):
+        """Mid-flight: N active sequences' tables point at the SAME
+        full-prefix page ids with refcount N+1 (registry included)."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(6)
+        prompt = rng.randint(0, 64, (8,))  # exactly 2 full pages
+        cache = m.make_paged_cache(n_pages=64, page_size=4)
+        cache.add_sequence("seed")
+        m.paged_ragged_step(cache, [("seed", prompt)])
+        cache.register_prefix("seed", prompt)
+        sids = []
+        for i in range(3):
+            sid = f"u{i}"
+            cache.add_sequence(sid)
+            got = cache.acquire_prefix(sid, prompt,
+                                       max_tokens=prompt.size - 1)
+            # page 1 matches fully, page 2 partially (the 7-token cap)
+            assert got == 7
+            sids.append(sid)
+        first_pages = {cache._tables[s][0] for s in sids}
+        assert len(first_pages) == 1  # ONE refcounted copy
+        page = first_pages.pop()
+        assert cache._ref[page] == 5  # seed + registry + 3 sharers
+        # eviction of a sharer never frees the shared page
+        cache.free_sequence(sids[0])
+        assert cache._ref[page] == 4
+        assert page not in cache._free
+
+    def test_cow_divergence_two_sequences_fork(self):
+        """Two prompts share a prefix then diverge INSIDE a page: both
+        outputs must equal their single-sequence references (the
+        copy-on-write correctness proof)."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(7)
+        stem = rng.randint(0, 64, (6,))
+        pa = np.concatenate([stem, [11, 12]])
+        pb = np.concatenate([stem, [13, 14]])  # forks mid-page-2
+        ra, rb = _ref_greedy(m, pa, 3), _ref_greedy(m, pb, 3)
+        eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
+                               max_new_tokens=3)
+        try:
+            assert eng.submit(pa).result(timeout=300).tolist() == ra
+            st0 = eng.cache.prefix_stats()
+            assert eng.submit(pb).result(timeout=300).tolist() == rb
+            st1 = eng.cache.prefix_stats()
+            assert st1["prefix_hit_tokens"] > st0["prefix_hit_tokens"]
+            assert st1["cow_copies"] > st0["cow_copies"]
+        finally:
+            eng.shutdown()
+
+    def test_admission_reservation_credits_shared_pages(self):
+        """A pool too small for two INDEPENDENT worst cases still
+        admits two sharers concurrently: the full-page prefix credit
+        is real capacity, not bookkeeping."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, 64, (8,))  # 2 full pages
+        ref = _ref_greedy(m, prompt, 4)
+        # 8 pages: seed uses 3 (2 prompt + 1 gen) and registers 2.
+        # Each sharer needs pages_needed(12)=3 minus 2 shared = 1.
+        eng = GenerationEngine(m, n_pages=9, page_size=4, max_batch=4,
+                               max_new_tokens=4)
+        try:
+            assert eng.submit(prompt).result(
+                timeout=300).tolist() == ref
+            with eng._cv:
+                handles = [eng.submit(prompt) for _ in range(3)]
+            assert [h.result(timeout=300).tolist()
+                    for h in handles] == [ref] * 3
+        finally:
+            eng.shutdown()
+
+    def test_prefix_credit_does_not_double_count_evictable_supply(self):
+        """A matched registry page is credited against `need` AND sits
+        in today's evictable supply — but acquire_prefix pins it, so
+        admission must subtract the pinned pages from supply or it
+        over-admits into a mid-decode out-of-pages that _fail_all
+        spreads to innocent neighbors. All three requests must
+        complete."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(13)
+        pa = rng.randint(0, 64, (16,))   # 4 full pages, registered
+        pc = rng.randint(0, 64, (4,))    # unrelated long-runner
+        ra = _ref_greedy(m, pa, 8)
+        rc = _ref_greedy(m, pc, 12)
+        eng = GenerationEngine(m, n_pages=8, page_size=4, max_batch=4,
+                               max_new_tokens=12)
+        try:
+            assert eng.submit(pa, max_new_tokens=8).result(
+                timeout=300).tolist() == ra
+            hc = eng.submit(pc, max_new_tokens=12)
+            next(iter(hc.tokens()))  # C is decoding, claims outstanding
+            hb = eng.submit(pa, max_new_tokens=8)  # matches A's chain
+            assert hc.result(timeout=300).tolist() == rc
+            assert hb.result(timeout=300).tolist() == ra
+        finally:
+            eng.shutdown()
+
+    def test_lru_reclaim_frees_registry_pages_under_pressure(self):
+        """Registered pages are best-effort retention: when a new
+        request needs the pool, LRU chains evict and their pages free
+        — and the engine still serves correctly afterwards."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(9)
+        eng = GenerationEngine(m, n_pages=9, page_size=4, max_batch=1,
+                               max_new_tokens=2)
+        try:
+            outs = []
+            for i in range(4):  # distinct prompts: registry fills, then
+                p = rng.randint(0, 64, (8,))  # reclaim must kick in
+                outs.append((p, eng.submit(p).result(
+                    timeout=300).tolist()))
+            st = eng.cache.prefix_stats()
+            assert st["prefix_evictions"] > 0
+            for p, got in outs[-1:]:
+                assert got == _ref_greedy(m, p, 2)
+        finally:
+            eng.shutdown()
+
+
+# -- records, schema, lint ---------------------------------------------
+
+class TestTelemetryAndFences:
+    def test_serve_records_carry_ragged_fields_and_validate(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_metrics_schema as cms
+        finally:
+            sys.path.pop(0)
+        path = tmp_path / "serve.jsonl"
+        os.environ["PADDLE_TPU_METRICS_FILE"] = str(path)
+        try:
+            m = _tiny_lm()
+            eng = GenerationEngine(m, n_pages=64, page_size=4,
+                                   max_batch=2, max_new_tokens=3)
+            try:
+                p = np.random.RandomState(10).randint(0, 64, (6,))
+                eng.submit(p).result(timeout=300)
+                eng.submit(p).result(timeout=300)  # prefix hit
+            finally:
+                eng.shutdown()
+        finally:
+            os.environ.pop("PADDLE_TPU_METRICS_FILE", None)
+        recs = [json.loads(line) for line in
+                path.read_text().splitlines() if line.strip()]
+        serve = [r for r in recs if r.get("kind") == "serve"]
+        assert serve, "no serve records exported"
+        for key in ("prefix_hits", "shared_pages",
+                    "chunked_prefill_tokens", "pad_token_fraction"):
+            assert any(key in r for r in serve), key
+        assert any(r.get("prefix_hits", 0) > 0 for r in serve)
+        assert any(r.get("chunked_prefill_tokens", 0) > 0
+                   for r in serve)
+        assert all(0.0 <= r.get("pad_token_fraction", 0.0) <= 1.0
+                   for r in serve)
+        assert cms.validate_file(str(path)) == []
+        # and the lint REJECTS bad values for the new fields
+        bad = dict(serve[0])
+        bad["prefix_hits"] = -1
+        assert cms.validate_line(json.dumps(bad))
+        bad = dict(serve[0])
+        bad["pad_token_fraction"] = 1.5
+        assert cms.validate_line(json.dumps(bad))
+
+    def test_prefill_sampling_region_has_no_allowlist_entry(self):
+        """Satellite contract: the prefill sampling fix must hold
+        WITHOUT a hot-sync-ok marker — on-device argmax + async read,
+        statically fenced by tools/check_no_hot_sync.py."""
+        import inspect
+        from paddle_tpu.inference.serving import GenerationEngine as GE
+        src = inspect.getsource(GE._admit)
+        assert "np.asarray(logits" not in src
+        assert "hot-sync-ok" not in src
+        assert "hot-sync-ok" not in inspect.getsource(GE._admit_ragged)
+        # the ragged step keeps exactly ONE marked sync — the int32
+        # token read whose copy was launched at dispatch — and the
+        # fence's device_get pattern catches any other
+        step_src = inspect.getsource(GE._ragged_step)
+        assert step_src.count("hot-sync-ok") == 1
+        assert step_src.count("device_get") == 1
+
+    def test_hot_sync_lint_covers_ragged_loop(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import check_no_hot_sync as lint
+        finally:
+            sys.path.pop(0)
+        assert lint.check_repo(REPO) == []
+        names = lint.HOT_REGIONS["paddle_tpu/inference/serving.py"]
+        assert "GenerationEngine._ragged_step" in names
+        assert "GenerationEngine._admit_ragged" in names
+
+    def test_legacy_mode_still_available_and_equal(self):
+        """ragged=False keeps the fixed-shape path alive (the bench's
+        same-run comparison depends on it) — equality across modes."""
+        m = _tiny_lm()
+        p = np.random.RandomState(11).randint(0, 64, (5,))
+        ref = _ref_greedy(m, p, 4)
+        for ragged in (False, True):
+            eng = GenerationEngine(m, n_pages=64, page_size=4,
+                                   max_batch=2, max_new_tokens=4,
+                                   ragged=ragged)
+            try:
+                assert eng.submit(p).result(timeout=300).tolist() == ref
+            finally:
+                eng.shutdown()
+
+    def test_pad_token_fraction_ragged_below_bucketed(self):
+        """The tentpole's measured win: the same staggered workload
+        leaves the bucketed engine wasting strictly more attention
+        slots than the ragged one."""
+        m = _tiny_lm()
+        rng = np.random.RandomState(12)
+        prompts = [rng.randint(0, 64, (n,)) for n in (20, 3, 3, 3)]
+        new = [8, 2, 3, 4]
+        fracs = {}
+        for ragged in (False, True):
+            eng = GenerationEngine(m, n_pages=64, page_size=4,
+                                   max_batch=4, max_new_tokens=8,
+                                   ragged=ragged, prefix_cache=False)
+            try:
+                hs = [eng.submit(p, max_new_tokens=n)
+                      for p, n in zip(prompts, new)]
+                for h in hs:
+                    h.result(timeout=300)
+            finally:
+                eng.shutdown()
+            fracs[ragged] = eng.pad_token_fraction()
+        assert fracs[True] < fracs[False]
+        assert fracs[False] > 0.3  # bucketed pays the table width
+        assert fracs[True] < 0.25  # ragged: intra-page remainder only

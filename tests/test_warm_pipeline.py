@@ -235,6 +235,10 @@ def test_warm_set_compiles_overlapped(tmp_path, monkeypatch):
     executor exists for. Calibrated best-of-3 on the 2-CPU container
     (host 'weather' can serialize any single round): one clean round
     passes; the failure message carries every round's numbers."""
+    if warm.workers() < 2:
+        pytest.skip("compile overlap needs >= 2 warm workers; this "
+                    f"container gives {warm.workers()} (1 CPU) — wall "
+                    "== sum is physics here, not a regression")
     mfile = tmp_path / "m.jsonl"
     monkeypatch.setenv("PADDLE_TPU_METRICS_FILE", str(mfile))
     rounds = []

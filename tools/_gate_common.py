@@ -8,7 +8,9 @@ compilation observatory's ledger, profiler/compile_observatory.py)
 against the checked-in BASELINE_HLO.json. The ledger can come from any
 metrics JSONL (`--ledger file.jsonl`), but the apples-to-apples source
 is the CANONICAL WORKLOAD here: a fixed tiny GPT train step (per-step,
-scanned run_steps, scanned accumulate) plus a two-bucket serving engine,
+scanned run_steps, scanned accumulate), a two-bucket serving engine,
+and the ragged paged-attention serving step (serve.ragged_step: the
+Pallas mixed prefill+decode program behind GenerationEngine),
 compiled cold (persistent cache off) on the single-device CPU backend —
 same model, same shapes, same flags every run, so fusion counts and
 bytes-accessed are deterministic and compile seconds are comparable.
@@ -185,8 +187,9 @@ def emit_workload():
     """The canonical workload body (runs in the child run_workload
     spawns; expects the env above to be set already).
 
-    The full warm set — the three TrainStep program flavors plus both
-    serving buckets — compiles OVERLAPPED through the background
+    The full warm set — the three TrainStep program flavors, both
+    serving buckets, and the ragged serving step's prefill+decode
+    signatures — compiles OVERLAPPED through the background
     compile pipeline (jit/warm.py), exactly as a production startup
     would; `jit.warm.join` exports the `kind:"warm"` wall-vs-sum
     record the compile-budget gate ratchets. The steady-state calls
@@ -223,16 +226,29 @@ def emit_workload():
     stacked = paddle.to_tensor(
         np.stack([ids.numpy(), ids.numpy()]))
 
-    from paddle_tpu.inference import InferenceEngine
+    from paddle_tpu.inference import InferenceEngine, GenerationEngine
     paddle.seed(0)
     eng = InferenceEngine(nn.Linear(8, 8), batch_sizes=(1, 2),
                           name="canonical")
     x_serve = np.zeros((1, 8), np.float32)
+    # the ragged serving executable (serve.ragged_step — the Pallas
+    # mixed prefill+decode program): its own tiny GPT in eval mode so
+    # the train step's donation traffic can't touch its param snapshot.
+    # prompt 4 + max_new 3 at page_size 16 keeps the table width at 1,
+    # so warm_async's simulated schedule is exactly two signatures:
+    # one prefill chunk (T=4) and the decode step (T=1)
+    paddle.seed(0)
+    gen_model = GPTForCausalLM(cfg)
+    gen_model.eval()
+    gen = GenerationEngine(gen_model, n_pages=8, page_size=16,
+                           max_batch=2, max_new_tokens=3,
+                           name="canonical_gen")
     handles = [
         step.warm(ids, ids),                       # train.step
         step.warm_run_steps(2, ids, ids),          # train.run_steps
         step.warm_accumulate(2, stacked, stacked),  # train.accumulate
-    ] + eng.warm_async(x_serve)                    # serve.*.batch{1,2}
+    ] + eng.warm_async(x_serve) \
+      + gen.warm_async(4, 3)                       # serve.ragged_step
     summary = jwarm.join(handles)                  # kind:"warm" record
     warmed = cobs.ledger_signatures()
 
@@ -242,6 +258,8 @@ def emit_workload():
     float(step.accumulate(2, stacked, stacked).item())
     eng(x_serve)
     eng.shutdown()
+    gen.submit(np.array([1, 2, 3, 4]), max_new_tokens=3).result(120)
+    gen.shutdown()
     steady = cobs.ledger_signatures()
     if steady != warmed:
         raise AssertionError(

@@ -38,6 +38,18 @@ Schema (documented in docs/OBSERVABILITY.md):
                   engine       str     emitting engine's name (non-empty;
                                        the per-engine key that keeps
                                        multi-engine JSONL attributable)
+                  pad_token_fraction number  in [0, 1] — measured
+                                       fraction of the step's attention
+                                       score slots outside any causal
+                                       bound (ragged steps report only
+                                       the intra-page remainder; the
+                                       pad_tokens COUNTER is what the
+                                       ragged path zeroes)
+                  prefix_hits  int     >= 0 prompt tokens served from the
+                                       refcounted prefix cache
+                  shared_pages int     >= 0 KV pages with > 1 holder
+                  chunked_prefill_tokens int  >= 0 prompt tokens admitted
+                                       via chunked prefill this step
   kind == "health" (one record per resolved health vector —
                   TrainStep/HybridTrainStep monitor_health=True)
                   additionally requires:
@@ -206,6 +218,22 @@ def validate_line(line, where="<line>"):
                 f"{where}: bucket_batch {rec['bucket_batch']} < "
                 f"batch_size {rec['batch_size']} — the bucket must fit "
                 "the rows it padded")
+        # ragged-serving fields (optional, typed+ranged when present)
+        for key in ("prefix_hits", "shared_pages",
+                    "chunked_prefill_tokens"):
+            if key in rec:
+                v = rec[key]
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    errors.append(
+                        f"{where}: {key} must be an int >= 0, got {v!r}")
+        if "pad_token_fraction" in rec:
+            v = rec["pad_token_fraction"]
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not (0.0 <= v <= 1.0):
+                errors.append(
+                    f"{where}: pad_token_fraction must be a number in "
+                    f"[0, 1], got {v!r}")
     elif rec.get("kind") == "health":
         _check_types(rec, HEALTH_REQUIRED, where, errors)
         if isinstance(rec.get("step"), int) and \

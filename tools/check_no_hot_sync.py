@@ -44,15 +44,20 @@ HOT_REGIONS = {
     "paddle_tpu/io/device_prefetch.py": ["*"],
     # the serving engine's scheduler core: the only legitimate blocks
     # are the queue wait and the ONE device read per dispatched batch /
-    # decode step (marked hot-sync-ok at the sampling / result-slicing
-    # sync points)
+    # decode step (marked hot-sync-ok at the result-slicing sync
+    # points). Sampling is an on-device argmax collected via an async
+    # copy: the prefill path (_admit) and the whole ragged loop carry
+    # NO allowlist entry — int()/device_get of b int32s with the copy
+    # already in flight, never a [vocab]-sized np.asarray
     "paddle_tpu/inference/serving.py": [
         "_run_scheduler",
         "InferenceEngine._take_batch", "InferenceEngine._scan_matching",
         "InferenceEngine._loop_once", "InferenceEngine._dispatch_batch",
         "InferenceEngine._resolve_batch",
         "GenerationEngine._loop_once", "GenerationEngine._admit",
-        "GenerationEngine._decode_step", "GenerationEngine._emit"],
+        "GenerationEngine._decode_step", "GenerationEngine._emit",
+        "GenerationEngine._admit_ragged",
+        "GenerationEngine._ragged_step"],
 }
 
 PATTERNS = [
@@ -63,6 +68,9 @@ PATTERNS = [
     # np.asarray of a device array is a blocking D2H read — the serving
     # dispatcher idiom (jnp.asarray stays device-side and is NOT matched)
     (re.compile(r"(?<![\w.])np\.asarray\s*\("), "np.asarray()"),
+    # jax.device_get is the other blocking D2H idiom (the ragged decode
+    # loop's one deliberate sync is marked; anything else is a leak)
+    (re.compile(r"device_get\s*\("), "device_get()"),
 ]
 
 ALLOW_MARKER = "hot-sync-ok"
