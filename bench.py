@@ -482,6 +482,17 @@ def _run():
         "flops_per_step": flops_per_step,
         "mfu_cost_analysis": round(
             flops_per_step * iters / dt / peak, 4) if on_tpu else 0.0,
+        # fused multi-tensor update epilogue (ops/pallas/
+        # fused_update.py): analytic HBM bytes of the two update passes
+        # and their share of the executable's cost-analysis bytes — the
+        # step-cost slice the epilogue is responsible for. 0/0.0 when
+        # the tree path is active (PADDLE_TPU_FUSED_UPDATE=0 or an
+        # unsupported optimizer/clip config).
+        "epilogue_bytes_per_step": int(
+            getattr(step, "_epilogue_bytes", 0) or 0),
+        "epilogue_share": round(min(
+            (getattr(step, "_epilogue_bytes", 0) or 0)
+            / max(float(exec_info.get("bytes", 0.0)), 1.0), 1.0), 4),
         # in-graph health observatory (monitor_health=True): final grad
         # norm / update ratio, plus how many anomaly events the host
         # detectors emitted over the run (0 = numerically clean)

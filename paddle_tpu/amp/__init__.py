@@ -321,20 +321,28 @@ class GradScaler:
                 g.astype(jnp.float32))))
         grads = jax.tree.map(
             lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
-        if not self._dynamic:
-            return grads, found, state
+        return grads, found, self.jit_update_scale_state(state, found)
+
+    def jit_update_scale_state(self, state, found):
+        """Pure (call under jit): advance only the dynamic-scaling state
+        for a precomputed `found` (traced bool) — the half of
+        jit_unscale_and_update the fused multi-tensor epilogue reuses
+        (its Pallas pass 1 already produced the unscaled grads and the
+        non-finite sweep in one read of the gradients)."""
+        if not self._enable or not self._dynamic:
+            return state
+        incr_every, decr_every = self._incr_every, self._decr_every
         good = jnp.where(found, 0, state["good_steps"] + 1)
         bad = jnp.where(found, state["bad_steps"] + 1, 0)
-        incr = good >= self._incr_every
-        decr = bad >= self._decr_every
+        incr = good >= incr_every
+        decr = bad >= decr_every
         scale = jnp.where(
             decr, jnp.maximum(state["scale"] * self._decr_ratio, 1.0),
             jnp.where(incr, state["scale"] * self._incr_ratio,
                       state["scale"]))
-        new_state = {"scale": scale,
-                     "good_steps": jnp.where(incr, 0, good),
-                     "bad_steps": jnp.where(decr, 0, bad)}
-        return grads, found, new_state
+        return {"scale": scale,
+                "good_steps": jnp.where(incr, 0, good),
+                "bad_steps": jnp.where(decr, 0, bad)}
 
     def sync_from_jit_state(self, state):
         """Pull the carried device state back into the eager scaler

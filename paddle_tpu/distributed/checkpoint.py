@@ -72,9 +72,16 @@ def load_train_state(step_obj, path):
     target = {"params": step_obj.params, "opt_state": step_obj.opt_state,
               "step": np.asarray(step_obj._step_i)}
     restored = load_sharded(path, target, None)
-    step_obj.params = restored["params"]
-    step_obj.opt_state = jax.tree.map(
+    opt_state = jax.tree.map(
         lambda cur, new: new, step_obj.opt_state, restored["opt_state"],
         is_leaf=lambda x: hasattr(x, "dtype"))
+    if hasattr(step_obj, "set_tree_state"):
+        # TrainStep: params/opt_state are per-leaf VIEWS (the donated
+        # truth may be the fused epilogue's flat stores) — restore
+        # through the layout-aware setter
+        step_obj.set_tree_state(restored["params"], opt_state)
+    else:
+        step_obj.params = restored["params"]
+        step_obj.opt_state = opt_state
     step_obj._step_i = int(restored["step"])
     return step_obj

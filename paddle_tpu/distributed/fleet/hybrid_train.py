@@ -23,9 +23,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...framework.core import Tensor, no_grad, _Slot
 from ...framework.random import split_key
+from ...framework.jax_compat import shard_map
 from ...jit.api import (functional_call, state_arrays, aot_compile,
                         count_train_use, export_step_metrics,
-                        HealthMonitorMixin, _step_arg_names)
+                        HealthMonitorMixin, _step_arg_names,
+                        epilogue_leaf_meta)
 from ...jit import warm as _warm
 from ...jit.deferred import DeferredLoss
 from ...profiler import statistic as _stat
@@ -92,7 +94,8 @@ class HybridTrainStep(HealthMonitorMixin):
 
     def __init__(self, model, loss_fn, optimizer, mesh, recompute=False,
                  accumulate_steps=1, donate=True, param_dtype=None,
-                 sharding_stage=1, scaler=None, monitor_health=False):
+                 sharding_stage=1, scaler=None, monitor_health=False,
+                 fused_update=None):
         """sharding_stage selects the ZeRO behavior over the 'sharding'
         mesh axis (ref sharding/sharding_stage2.py:43, sharding_stage3.py:51):
           1 — optimizer state sharded (grads allreduced, params replicated)
@@ -176,6 +179,37 @@ class HybridTrainStep(HealthMonitorMixin):
         stage = self.sharding_stage
         zero_shardings = {k: NamedSharding(mesh, s)
                           for k, s in self.zero_specs.items()}
+        # per-leaf epilogue metadata, shared by the fused kernels and
+        # the tree path (defaults are trivial: historical numerics)
+        (self._leaf_meta, self._need_clip_tree, self._decay_mask_tree,
+         self._lr_scale_tree) = epilogue_leaf_meta(model, optimizer,
+                                                   self.params)
+        # fused multi-tensor epilogue over PER-SHARD dtype buckets:
+        # every leaf's ZeRO shard flattens into its device-local bucket,
+        # the kernels run on local contiguous buffers, and ONE psum (of
+        # norm-weighted partial sums) yields the global grad norm
+        self._fused = self._build_fused(fused_update)
+        if self._fused is not None:
+            from ...nn.clip import ClipGradByGlobalNorm
+            lay = self._fused.layout
+            master_keys = {
+                key for key, leaf in lay.leaf_order
+                if isinstance(self.opt_state[leaf.name], dict)}
+            # PER-DEVICE bytes (local shards), matching the per-device
+            # cost_analysis the step record's bytes come from
+            self._epilogue_bytes = self._fused.bytes_per_step(
+                scaling=scaler is not None and scaler.is_enable(),
+                need_norm=bool(monitor_health) or isinstance(
+                    optimizer._grad_clip, ClipGradByGlobalNorm),
+                master_keys=master_keys)
+            # hybrid packs grads/params/opt into local buckets each
+            # step inside the shard_map (states stay tree-sharded at
+            # rest): account that traffic too
+            pack_elems = sum(b.total * b.dtype.itemsize
+                             for b in lay.buckets.values())
+            n_state = self._fused.spec["n_moments"] + 1 + (
+                1 if master_keys else 0)
+            self._epilogue_bytes += 2 * (n_state + 1) * pack_elems
 
         def loss_of(ps, bufs, key, micro):
             def run(inputs):
@@ -227,34 +261,65 @@ class HybridTrainStep(HealthMonitorMixin):
                 loss, grads = jax.value_and_grad(
                     lambda ps: objective(ps, batch))(params_)
 
-            # the health vector norms the RAW (possibly scale-multiplied)
-            # grads — _health_vec unscales by division, so a non-finite
-            # gradient stays visible as a non-finite grad_norm
-            raw_grads = grads if mon_health else None
             if scaling:
                 loss = loss / scale
-                grads, found_inf, new_scaler_state = \
-                    scaler_ref.jit_unscale_and_update(scaler_state_, grads)
+
+            if self._fused is not None:
+                # fused multi-tensor epilogue: unscale + ONE psum'd
+                # global norm + clip + update, as per-shard bucket
+                # kernels under shard_map (see _fused_finish)
+                new_params, new_state, new_scaler_state, aux = \
+                    self._fused_finish(grads, params_, opt_state_,
+                                       scaler_state_, lr, step_i)
             else:
-                found_inf, new_scaler_state = None, scaler_state_
+                if scaling:
+                    grads, found_inf, new_scaler_state = \
+                        scaler_ref.jit_unscale_and_update(scaler_state_,
+                                                          grads)
+                else:
+                    found_inf, new_scaler_state = None, scaler_state_
 
-            if stage >= 2:
-                # ZeRO-2: pin gradients to the zero specs — the SPMD
-                # partitioner then lowers dp grad sync as reduce-scatter
-                # (each rank keeps only its grad shard) instead of
-                # all-reduce, and the optimizer update below runs on
-                # shards (ref sharding_stage2.py:43)
-                grads = jax.lax.with_sharding_constraint(grads,
-                                                         zero_shardings)
+                if stage >= 2:
+                    # ZeRO-2: pin gradients to the zero specs — the SPMD
+                    # partitioner then lowers dp grad sync as
+                    # reduce-scatter (each rank keeps only its grad
+                    # shard) instead of all-reduce, and the optimizer
+                    # update below runs on shards (ref
+                    # sharding_stage2.py:43)
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, zero_shardings)
 
-            from ...nn.clip import clip_grads_tree
-            grads = clip_grads_tree(grads, opt._grad_clip)
-            new_params, new_state = opt.apply_gradients_tree(
-                params_, grads, opt_state_, lr, step_i,
-                found_inf=found_inf)
+                from ...nn.clip import (clip_grads_tree, global_grad_norm,
+                                        ClipGradByGlobalNorm)
+                gn = None
+                if mon_health or isinstance(opt._grad_clip,
+                                            ClipGradByGlobalNorm):
+                    # computed ONCE, shared by the clip factor and the
+                    # health vector's grad_norm (no second traversal)
+                    gn = global_grad_norm(grads, self._need_clip_tree)
+                grads = clip_grads_tree(grads, opt._grad_clip,
+                                        need_clip=self._need_clip_tree,
+                                        global_norm=gn)
+                new_params, new_state = opt.apply_gradients_tree(
+                    params_, grads, opt_state_, lr, step_i,
+                    found_inf=found_inf,
+                    decay_mask=self._decay_mask_tree,
+                    lr_scale=self._lr_scale_tree)
+                aux = {"grad_norm": gn, "found_inf": found_inf}
+                if mon_health:
+                    self._tree_health_aux(aux, params_, new_params)
+                    if gn is not None and \
+                            self._need_clip_tree is not None:
+                        # leaves the need_clip mask keeps out of the
+                        # norm must still trip health found_inf
+                        nonfin = ~jnp.isfinite(gn)
+                        for k, g in grads.items():
+                            if not self._need_clip_tree.get(k, True):
+                                nonfin = nonfin | jnp.any(~jnp.isfinite(
+                                    g.astype(jnp.float32)))
+                        aux["nonfinite"] = nonfin
             if mon_health:
-                health = self._health_vec(loss, raw_grads, scaler_state_,
-                                          params_, new_params)
+                health = self._health_vec(loss, aux)
                 return loss, health, new_params, new_state, \
                     new_scaler_state
             return loss, new_params, new_state, new_scaler_state
@@ -283,6 +348,107 @@ class HybridTrainStep(HealthMonitorMixin):
         # trace/compile phases timed, persistent-cache hit observed,
         # cost_analysis free
         self._exec = {}
+
+    # -- fused per-shard epilogue ---------------------------------------
+    def _build_fused(self, fused_update):
+        """A FusedEpilogue over the LOCAL (ZeRO-shard) leaf shapes, or
+        None -> per-leaf tree path. The bucket layout is built from each
+        leaf's `zero_spec` shard shape — the update always runs on
+        optimizer-state shards (ZeRO semantics for every stage); leaves
+        replicated over some mesh axes carry a norm_weight of
+        1/replication so the ONE global-norm psum does not count a
+        replica per device."""
+        import os
+        if fused_update is None:
+            fused_update = os.environ.get(
+                "PADDLE_TPU_FUSED_UPDATE", "1") != "0"
+        if not fused_update or not self.params:
+            return None
+        spec = self.optimizer.fused_spec()
+        if spec is None:
+            return None
+        from ...nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+        clip = self.optimizer._grad_clip
+        if clip is not None and not isinstance(
+                clip, (ClipGradByGlobalNorm, ClipGradByValue)):
+            return None
+        if not all(jnp.issubdtype(v.dtype, jnp.floating)
+                   for v in self.params.values()):
+            return None
+        from ...ops.pallas.fused_update import (BucketLayout,
+                                                FusedEpilogue)
+        mesh = self.mesh
+        leaves, meta = [], {}
+        for k, v in self.params.items():
+            zspec = self.zero_specs[k]
+            lshape = NamedSharding(mesh, zspec).shard_shape(v.shape)
+            axes = set()
+            for d in zspec:
+                if d is None:
+                    continue
+                axes.update(d if isinstance(d, (tuple, list)) else (d,))
+            sharded = int(np.prod([mesh.shape[a] for a in axes])) \
+                if axes else 1
+            rep = mesh.size // sharded
+            leaves.append((k, lshape, v.dtype))
+            meta[k] = dict(self._leaf_meta[k], norm_weight=1.0 / rep)
+        layout = BucketLayout(leaves, meta=meta)
+        epi = FusedEpilogue(layout, spec)
+        epi.set_psum_axes(tuple(mesh.axis_names))
+        return epi
+
+    def _fused_finish(self, grads, params, opt_state, scaler_state, lr,
+                      step_i):
+        """The fused epilogue as ONE shard_map region: every device
+        packs its local ZeRO shards into dtype buckets, runs the two
+        Pallas passes, and the global grad norm / found_inf / health
+        sums reduce with one psum (+pmax) — then the per-leaf tree comes
+        back out and the jit-level out_shardings re-gather parameters to
+        their storage layout (an all-gather for stage < 3, a no-op for
+        stage 3 where storage IS the zero layout)."""
+        epi = self._fused
+        lay = epi.layout
+        scaler = self.scaler
+        clip = self.optimizer._grad_clip
+        mon = self.monitor_health
+        zero = jnp.float32(0.0)
+
+        def body(grads, params, opt_state, scaler_state, lr, step_i):
+            g_store = lay.pack(grads)
+            p_store = lay.pack(params)
+            o_store = epi.pack_opt_tree(opt_state)
+            new_p, new_o, new_sc, aux = epi.finish(
+                g_store, p_store, o_store, lr, step_i, scaler=scaler,
+                scaler_state=scaler_state, clip=clip, with_stats=mon)
+            found = aux["found_inf"]
+            aux_vec = jnp.stack([
+                aux["grad_norm"],
+                found.astype(jnp.float32) if found is not None
+                else jnp.float32(-1.0),
+                aux.get("param_sumsq", zero),
+                aux.get("update_sumsq", zero)])
+            return (lay.unpack(new_p), epi.state_view(new_o), new_sc,
+                    aux_vec)
+
+        zspecs = {k: self.zero_specs[k] for k in params}
+        state_specs = {
+            k: jax.tree.map(lambda _, s=self.zero_specs[k]: s,
+                            opt_state[k])
+            for k in opt_state}
+        scaler_specs = jax.tree.map(lambda _: P(), scaler_state)
+        new_params, new_state, new_sc, aux_vec = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(zspecs, zspecs, state_specs, scaler_specs, P(),
+                      P()),
+            out_specs=(zspecs, state_specs, scaler_specs, P()),
+            check_vma=False)(grads, params, opt_state, scaler_state, lr,
+                             step_i)
+        found = None
+        if scaler is not None and scaler.is_enable():
+            found = aux_vec[1] > 0
+        aux = {"grad_norm": aux_vec[0], "found_inf": found,
+               "param_sumsq": aux_vec[2], "update_sumsq": aux_vec[3]}
+        return new_params, new_state, new_sc, aux
 
     def input_sharding(self, arr):
         """Sharding the compiled step expects for a batch leaf (batch dim

@@ -180,14 +180,30 @@ def export_step_metrics(step, dispatch_s, info, compiled_now):
     # export_step always runs: file or no file, the record lands in the
     # flight-recorder ring so a debug bundle carries the step tail
     from .. import device as _device
-    _monitor.export_step({
+    rec = {
         "step": int(step._step_i),
         "step_time_s": float(step_time),
         "compile_s": float(compile_s),
         "cache_hit": bool((not compiled_now) or info["cache_hit"]),
         "peak_bytes": int(_device.max_memory_allocated()),
         "flops": flops,
-        "mfu": float(m)})
+        "mfu": float(m)}
+    # fused-epilogue cost split: epilogue_bytes is the ANALYTIC HBM
+    # traffic of the two update passes (ops/pallas/fused_update.py
+    # bytes_per_step); epilogue_share relates it to the executable's
+    # cost_analysis bytes (clamped — interpret-mode cost analysis counts
+    # kernel loop bodies once). The update.epilogue span attributes the
+    # same share of the step's wall time for the profiler summary.
+    eb = int(getattr(step, "_epilogue_bytes", 0) or 0)
+    if eb:
+        total_b = float(info.get("bytes", 0.0))
+        share = min(eb / total_b, 1.0) if total_b > 0 else 0.0
+        rec["epilogue_bytes"] = eb
+        rec["epilogue_share"] = float(share)
+        _monitor.gauge("train.epilogue_share").set(float(share))
+        if steady:
+            _stat.record_span("update.epilogue", step_time * share)
+    _monitor.export_step(rec)
 
 
 def state_arrays(layer):
@@ -195,6 +211,35 @@ def state_arrays(layer):
     params = {k: p.value for k, p in layer.named_parameters()}
     buffers = {k: b.value for k, b in layer.named_buffers()}
     return params, buffers
+
+
+def epilogue_leaf_meta(model, optimizer, params):
+    """Per-leaf epilogue metadata from the model's Parameters + the
+    optimizer config: need_clip (ClipGradByGlobalNorm opt-out), lr_scale
+    (Parameter.optimize_attr), decay-applies (AdamW
+    apply_decay_param_fun, keyed by the flat tree name). Returns (meta,
+    need_clip_tree, decay_mask_tree, lr_scale_tree) — the tree views are
+    None when trivial, so the default config keeps the historical tree
+    numerics bit-for-bit; fused and tree paths both consume the SAME
+    tables, which is what keeps them numerically equal."""
+    named = dict(model.named_parameters())
+    meta = {}
+    for k in params:
+        p = named.get(k)
+        attr = getattr(p, "optimize_attr", None)
+        meta[k] = {
+            "need_clip": bool(getattr(p, "need_clip", True)),
+            "lr_scale": float(attr.get("learning_rate", 1.0)) if attr
+            else 1.0,
+            "decay": bool(optimizer._decay_applies_name(k)),
+        }
+    nc = {k: m["need_clip"] for k, m in meta.items()}
+    dm = {k: m["decay"] for k, m in meta.items()}
+    ls = {k: m["lr_scale"] for k, m in meta.items()}
+    return (meta,
+            None if all(nc.values()) else nc,
+            None if all(dm.values()) else dm,
+            None if all(v == 1.0 for v in ls.values()) else ls)
 
 
 def _bind(layer, arrays):
@@ -459,14 +504,34 @@ class HealthMonitorMixin:
         else:
             self.anomalies = None
 
-    def _health_vec(self, loss, grads, scaler_state, params, new_params):
+    def _health_vec(self, loss, aux):
         """[loss, grad_norm, param_norm, update_ratio, found_inf] as ONE
         f32 device vector, computed under the trace (monitor_health=True
-        appends this to the compiled step). `grads` are the raw
-        (possibly scale-multiplied) gradients from value_and_grad; the
-        norm is unscaled by division, so a non-finite gradient shows up
-        as a non-finite grad_norm — which is also the found_inf signal
-        (no second tree traversal)."""
+        appends this to the compiled step). `aux` is `_finish`'s
+        epilogue by-product dict: the grad norm is computed ONCE per
+        step (shared with the clip factor and — via the GradScaler or
+        non-finiteness — found_inf), never as a second tree traversal;
+        the fused epilogue's pass-2 kernels supply param/update sums as
+        per-chunk side accumulators."""
+        grad_norm = aux["grad_norm"]
+        # found_inf preference order: the GradScaler's exact flag, then
+        # the epilogue's full-tree non-finite sweep (covers leaves a
+        # need_clip mask keeps out of the norm), then norm finiteness
+        found = aux.get("found_inf")
+        if found is None:
+            found = aux.get("nonfinite")
+        found_inf = found.astype(jnp.float32) if found is not None \
+            else (~jnp.isfinite(grad_norm)).astype(jnp.float32)
+        param_norm = jnp.sqrt(aux["param_sumsq"])
+        update_ratio = jnp.sqrt(aux["update_sumsq"]) / jnp.maximum(
+            param_norm, 1e-12)
+        return jnp.stack([loss.astype(jnp.float32).reshape(()), grad_norm,
+                          param_norm, update_ratio, found_inf])
+
+    @staticmethod
+    def _tree_health_aux(aux, params, new_params):
+        """Fill aux's param/update sums for a TREE-layout epilogue (the
+        fused path's kernels produce them as side outputs instead)."""
         def sumsq(tree):
             leaves = [jnp.sum(jnp.square(l.astype(jnp.float32)))
                       for l in jax.tree.leaves(tree)]
@@ -475,18 +540,12 @@ class HealthMonitorMixin:
                 total = total + l
             return total
 
-        grad_norm = jnp.sqrt(sumsq(grads))
-        found_inf = (~jnp.isfinite(grad_norm)).astype(jnp.float32)
-        if self.scaler is not None and self.scaler.is_enable():
-            grad_norm = grad_norm / scaler_state["scale"]
-        param_norm = jnp.sqrt(sumsq(new_params))
+        aux["param_sumsq"] = sumsq(new_params)
         delta = jax.tree.map(
             lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
             new_params, params)
-        update_ratio = jnp.sqrt(sumsq(delta)) / jnp.maximum(param_norm,
-                                                            1e-12)
-        return jnp.stack([loss.astype(jnp.float32).reshape(()), grad_norm,
-                          param_norm, update_ratio, found_inf])
+        aux["update_sumsq"] = sumsq(delta)
+        return aux
 
     def _queue_health(self, step_i, vec):
         """Start the async D2H copy of one step's health vector, then
@@ -567,7 +626,7 @@ class TrainStep(HealthMonitorMixin):
 
     def __init__(self, model, loss_fn, optimizer, mesh=None,
                  in_shardings=None, donate=True, model_returns_loss=False,
-                 scaler=None, monitor_health=False):
+                 scaler=None, monitor_health=False, fused_update=None):
         """model_returns_loss=True: the model's forward(*batch) IS the
         scalar loss (e.g. GPTForCausalLM.fused_loss via a wrapper) —
         loss_fn is ignored. Lets memory-fused loss formulations (chunked
@@ -587,7 +646,17 @@ class TrainStep(HealthMonitorMixin):
         only once it has LANDED (is_ready-gated — zero new host syncs on
         the hot path; `flush_health()` is the blocking drain). Each
         resolved step also exports a `kind:"health"` metrics record.
-        Donation and GradScaler semantics are unchanged."""
+        Donation and GradScaler semantics are unchanged.
+
+        fused_update: run the optimizer epilogue as the fused
+        multi-tensor Pallas kernels over dtype-bucketed flat buffers
+        (ops/pallas/fused_update.py) instead of the per-leaf tree op
+        chain. Default (None) reads PADDLE_TPU_FUSED_UPDATE (on unless
+        "0") and silently falls back to the tree path when the
+        optimizer/clip config has no fused mapping (Lars, RMSProp,
+        per-leaf ClipGradByNorm, stochastic rounding). Both paths are
+        numerically equal (tests/test_fused_update.py); params and
+        opt_state remain visible as per-leaf tree VIEWS either way."""
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -596,10 +665,17 @@ class TrainStep(HealthMonitorMixin):
         params, self.buffers = state_arrays(model)
         # params are donated every step; take a private copy so the
         # model's own Parameters stay valid for eager use
-        self.params = jax.tree.map(jnp.array, params)
-        self.opt_state = jax.tree.map(
-            lambda v: self.optimizer.init_leaf_state(v), self.params,
-            is_leaf=lambda x: hasattr(x, "dtype"))
+        params = jax.tree.map(jnp.array, params)
+        self._collect_leaf_meta(model, optimizer, params)
+        self._fused = self._build_fused(params, fused_update)
+        if self._fused is not None:
+            self._params_store, self._opt_store = self._fused.init_stores(
+                params, optimizer._multi_precision)
+        else:
+            self._params_store = params
+            self._opt_store = jax.tree.map(
+                lambda v: self.optimizer.init_leaf_state(v), params,
+                is_leaf=lambda x: hasattr(x, "dtype"))
         # an empty dict is a valid (leafless) donated pytree when no
         # scaler rides along, keeping one step_fn signature
         self.scaler_state = scaler.init_jit_state() if scaler is not None \
@@ -610,24 +686,32 @@ class TrainStep(HealthMonitorMixin):
         self.compile_s = 0.0
         self.last_compile_s = None
         self._init_health(monitor_health)
+        if self._fused is not None:
+            from ..nn.clip import ClipGradByGlobalNorm
+            self._epilogue_bytes = self._fused.bytes_per_step(
+                scaling=scaler is not None and scaler.is_enable(),
+                need_norm=bool(monitor_health) or isinstance(
+                    optimizer._grad_clip, ClipGradByGlobalNorm),
+                master_keys=set(self._opt_store["masters"]))
 
         def step_fn(params, opt_state, scaler_state, buffers, key, lr,
                     step_i, *batch):
             loss, grads = jax.value_and_grad(
                 lambda ps: self._objective(ps, scaler_state, buffers, key,
                                            batch))(params)
-            return self._finish(loss, grads, params, opt_state,
-                                scaler_state, lr, step_i)
+            loss, new_params, new_state, new_scaler, _ = self._finish(
+                loss, grads, params, opt_state, scaler_state, lr, step_i)
+            return loss, new_params, new_state, new_scaler
 
         def step_fn_health(params, opt_state, scaler_state, buffers, key,
                            lr, step_i, *batch):
             loss, grads = jax.value_and_grad(
                 lambda ps: self._objective(ps, scaler_state, buffers, key,
                                            batch))(params)
-            out_loss, new_params, new_state, new_scaler = self._finish(
-                loss, grads, params, opt_state, scaler_state, lr, step_i)
-            health = self._health_vec(out_loss, grads, scaler_state,
-                                      params, new_params)
+            out_loss, new_params, new_state, new_scaler, aux = \
+                self._finish(loss, grads, params, opt_state, scaler_state,
+                             lr, step_i, want_health=True)
+            health = self._health_vec(out_loss, aux)
             return out_loss, health, new_params, new_state, new_scaler
 
         donate_argnums = (0, 1, 2) if donate else ()
@@ -643,6 +727,69 @@ class TrainStep(HealthMonitorMixin):
         self._exec = {}
         self._scan_jit = {}
         self._acc_jit = {}
+
+    # -- fused epilogue plumbing ----------------------------------------
+    def _collect_leaf_meta(self, model, optimizer, params):
+        (self._leaf_meta, self._need_clip_tree, self._decay_mask_tree,
+         self._lr_scale_tree) = epilogue_leaf_meta(model, optimizer,
+                                                   params)
+
+    def _build_fused(self, params, fused_update):
+        """The fused multi-tensor epilogue for this (optimizer, clip,
+        params) config, or None -> per-leaf tree path. Explicit
+        fused_update=True/False wins over PADDLE_TPU_FUSED_UPDATE."""
+        import os
+        if fused_update is None:
+            fused_update = os.environ.get(
+                "PADDLE_TPU_FUSED_UPDATE", "1") != "0"
+        if not fused_update or not params:
+            return None
+        spec = self.optimizer.fused_spec()
+        if spec is None:
+            return None
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+        clip = self.optimizer._grad_clip
+        if clip is not None and not isinstance(
+                clip, (ClipGradByGlobalNorm, ClipGradByValue)):
+            return None
+        if not all(jnp.issubdtype(v.dtype, jnp.floating)
+                   for v in jax.tree.leaves(params)):
+            return None
+        from ..ops.pallas.fused_update import BucketLayout, FusedEpilogue
+        layout = BucketLayout(
+            [(k, v.shape, v.dtype) for k, v in params.items()],
+            meta=self._leaf_meta)
+        return FusedEpilogue(layout, spec)
+
+    @property
+    def params(self):
+        """Per-leaf {name: array} view of the step's parameters. On the
+        fused path the donated truth lives in dtype-bucketed flat
+        buffers (`_params_store`); this view slices them back out."""
+        if self._fused is not None:
+            return self._fused.layout.unpack(self._params_store)
+        return self._params_store
+
+    @property
+    def opt_state(self):
+        """Per-leaf optimizer-state view ({name: tuple | {"master",
+        "state"}}), state_dict-compatible on both epilogue layouts."""
+        if self._fused is not None:
+            return self._fused.state_view(self._opt_store)
+        return self._opt_store
+
+    def set_tree_state(self, params=None, opt_state=None):
+        """Load per-leaf state back into the step (checkpoint restore:
+        distributed/checkpoint.load_train_state) — the layout-aware
+        inverse of the `params`/`opt_state` views, packing into the
+        donated flat stores on the fused path."""
+        if params is not None:
+            self._params_store = self._fused.layout.pack(params) \
+                if self._fused is not None \
+                else {k: jnp.asarray(v) for k, v in params.items()}
+        if opt_state is not None:
+            self._opt_store = self._fused.pack_opt_tree(opt_state) \
+                if self._fused is not None else opt_state
 
     # -- traced pieces (shared by __call__ / run_steps / accumulate) -----
     def _loss_of(self, ps, buffers, key, batch):
@@ -665,30 +812,76 @@ class TrainStep(HealthMonitorMixin):
 
     def _objective(self, ps, scaler_state, buffers, key, batch):
         """The differentiated quantity: the loss, scaled when a
-        GradScaler rides inside the step."""
+        GradScaler rides inside the step. `ps` is the donated parameter
+        store — on the fused path the dtype-bucketed flat buffers, whose
+        per-leaf views the forward consumes (differentiating THROUGH the
+        unpack makes the gradients arrive already bucketed: the VJP
+        packs leaf cotangents with one concatenate per bucket)."""
+        if self._fused is not None:
+            ps = self._fused.layout.unpack(ps)
         l = self._loss_of(ps, buffers, key, batch)
         if self.scaler is not None and self.scaler.is_enable():
             return l.astype(jnp.float32) * scaler_state["scale"]
         return l
 
     def _finish(self, loss, grads, params, opt_state, scaler_state, lr,
-                step_i):
+                step_i, want_health=False):
         """From (possibly scaled) loss + grads to the updated carry: one
         unscale/scale-adaptation, one clip, ONE optimizer update —
         whether the grads came from one batch or a scanned accumulation
-        of k microbatches."""
+        of k microbatches. Returns (loss, new_params, new_state,
+        new_scaler_state, aux); aux carries the epilogue's shared
+        by-products — the ONE global grad norm (clip factor, health
+        grad_norm) and found_inf — plus the health sums when
+        want_health.
+
+        Fused path: two Pallas passes over the flat buffers
+        (ops/pallas/fused_update.py). Tree path: the per-leaf reference
+        shape, with the grad norm computed ONCE and threaded to both
+        the clip and the health vector instead of per-consumer."""
         scaler = self.scaler
+        clip = self.optimizer._grad_clip
+        if self._fused is not None:
+            if scaler is not None and scaler.is_enable():
+                loss = loss / scaler_state["scale"]
+            new_params, new_state, new_scaler_state, aux = \
+                self._fused.finish(
+                    grads, params, opt_state, lr, step_i, scaler=scaler,
+                    scaler_state=scaler_state, clip=clip,
+                    with_stats=want_health)
+            return loss, new_params, new_state, new_scaler_state, aux
         if scaler is not None and scaler.is_enable():
             loss = loss / scaler_state["scale"]
             grads, found_inf, new_scaler_state = \
                 scaler.jit_unscale_and_update(scaler_state, grads)
         else:
             found_inf, new_scaler_state = None, scaler_state
-        from ..nn.clip import clip_grads_tree
-        grads = clip_grads_tree(grads, self.optimizer._grad_clip)
+        from ..nn.clip import (clip_grads_tree, global_grad_norm,
+                               ClipGradByGlobalNorm)
+        gn = None
+        if want_health or isinstance(clip, ClipGradByGlobalNorm):
+            gn = global_grad_norm(grads, self._need_clip_tree)
+        grads = clip_grads_tree(grads, clip,
+                                need_clip=self._need_clip_tree,
+                                global_norm=gn)
         new_params, new_state = self.optimizer.apply_gradients_tree(
-            params, grads, opt_state, lr, step_i, found_inf=found_inf)
-        return loss, new_params, new_state, new_scaler_state
+            params, grads, opt_state, lr, step_i, found_inf=found_inf,
+            decay_mask=self._decay_mask_tree,
+            lr_scale=self._lr_scale_tree)
+        aux = {"grad_norm": gn, "found_inf": found_inf}
+        if want_health:
+            self._tree_health_aux(aux, params, new_params)
+            if gn is not None:
+                nonfin = ~jnp.isfinite(gn)
+                if self._need_clip_tree is not None:
+                    # leaves a need_clip mask keeps out of the norm must
+                    # still trip the health found_inf signal
+                    for k, g in grads.items():
+                        if not self._need_clip_tree.get(k, True):
+                            nonfin = nonfin | jnp.any(~jnp.isfinite(
+                                g.astype(jnp.float32)))
+                aux["nonfinite"] = nonfin
+        return loss, new_params, new_state, new_scaler_state, aux
 
     def _dispatch(self, cache, sig, make_jitted, args, span,
                   max_entries=None, static=None, arg_names=None):
@@ -810,7 +1003,7 @@ class TrainStep(HealthMonitorMixin):
         key = split_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         base = jnp.asarray(self._step_i + 1, jnp.int32)
-        return (self.params, self.opt_state, self.scaler_state,
+        return (self._params_store, self._opt_store, self.scaler_state,
                 self.buffers, key, lr, base, *arrays)
 
     def run_steps(self, n, *batch, data_per_step=False):
@@ -838,7 +1031,8 @@ class TrainStep(HealthMonitorMixin):
             self._scan_jit, sig, make_jitted, args, "train.run_steps",
             max_entries=8, static=static,
             arg_names=_step_arg_names(len(arrays)))
-        losses, self.params, self.opt_state, self.scaler_state = out
+        losses, self._params_store, self._opt_store, \
+            self.scaler_state = out
         # telemetry keeps dispatch-only time: the first call's span also
         # covered the compile
         if compiled_now:
@@ -878,11 +1072,11 @@ class TrainStep(HealthMonitorMixin):
             # batch (equal microbatch sizes)
             loss = loss_sum / k
             grads = jax.tree.map(lambda g: g / k, grads)
-            out_loss, new_params, new_state, new_scaler = self._finish(
-                loss, grads, params, opt_state, scaler_state, lr, step_i)
+            out_loss, new_params, new_state, new_scaler, aux = \
+                self._finish(loss, grads, params, opt_state, scaler_state,
+                             lr, step_i, want_health=self.monitor_health)
             if self.monitor_health:
-                health = self._health_vec(out_loss, grads, scaler_state,
-                                          params, new_params)
+                health = self._health_vec(out_loss, aux)
                 return out_loss, health, new_params, new_state, new_scaler
             return out_loss, new_params, new_state, new_scaler
         return acc_fn
@@ -922,7 +1116,7 @@ class TrainStep(HealthMonitorMixin):
         self._step_i += 1
         key = split_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        args = (self.params, self.opt_state, self.scaler_state,
+        args = (self._params_store, self._opt_store, self.scaler_state,
                 self.buffers, key, lr, self._step_i, *arrays)
 
         out, info, compiled_now, dispatch_s = self._dispatch(
@@ -930,11 +1124,12 @@ class TrainStep(HealthMonitorMixin):
             max_entries=8, static={"k": k},
             arg_names=_step_arg_names(len(arrays)))
         if self.monitor_health:
-            loss, health, self.params, self.opt_state, \
+            loss, health, self._params_store, self._opt_store, \
                 self.scaler_state = out
             self._queue_health(self._step_i, health)
         else:
-            loss, self.params, self.opt_state, self.scaler_state = out
+            loss, self._params_store, self._opt_store, \
+                self.scaler_state = out
         export_step_metrics(self, dispatch_s, info, compiled_now)
         return DeferredLoss(loss)
 
@@ -954,7 +1149,7 @@ class TrainStep(HealthMonitorMixin):
         arrays = [b.value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
-        args = (self.params, self.opt_state, self.scaler_state,
+        args = (self._params_store, self._opt_store, self.scaler_state,
                 self.buffers, split_key(),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 step_i, *arrays)
@@ -1009,7 +1204,7 @@ class TrainStep(HealthMonitorMixin):
         sig, make_jitted, arrays = self._prep_accumulate(k, batch)
         if k == 1:
             return self.warm(*[a[0] for a in arrays])
-        args = (self.params, self.opt_state, self.scaler_state,
+        args = (self._params_store, self._opt_store, self.scaler_state,
                 self.buffers, split_key(),
                 jnp.asarray(self.optimizer.get_lr(), jnp.float32),
                 self._step_i + 1, *arrays)
@@ -1025,11 +1220,12 @@ class TrainStep(HealthMonitorMixin):
             self._exec, sig, lambda: self._jitted, args, "train.step",
             arg_names=_step_arg_names(len(batch)))
         if self.monitor_health:
-            loss, health, self.params, self.opt_state, \
+            loss, health, self._params_store, self._opt_store, \
                 self.scaler_state = out
             self._queue_health(self._step_i, health)
         else:
-            loss, self.params, self.opt_state, self.scaler_state = out
+            loss, self._params_store, self._opt_store, \
+                self.scaler_state = out
         export_step_metrics(self, dispatch_s, info, compiled_now)
         # non-blocking handle: dispatch has already returned; the host
         # copy streams in the background and resolves on first read

@@ -109,19 +109,57 @@ def clip_grad_value_(parameters, clip_value):
                                          clip_value))
 
 
-def clip_grads_tree(grads, clip):
+def global_grad_norm(grads, need_clip=None):
+    """Global L2 norm of a pytree of RAW jax arrays in f32 (call under
+    jit). `need_clip` is an optional same-structure tree of bools:
+    False leaves are excluded from the norm (eager
+    ClipGradByGlobalNorm semantics — Parameter.need_clip). Computed
+    ONCE per step by TrainStep._finish and shared by the clip factor,
+    the health vector's grad_norm, and (via non-finiteness) found_inf."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree.leaves(grads)
+    mask = _clip_mask(grads, need_clip)
+    total = jnp.zeros((), jnp.float32)
+    for g, m in zip(leaves, mask):
+        if m:
+            total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return jnp.sqrt(total)
+
+
+def _clip_mask(grads, need_clip):
+    import jax
+    leaves = jax.tree.leaves(grads)
+    if need_clip is None:
+        return [True] * len(leaves)
+    _, treedef = jax.tree.flatten(grads)
+    return [bool(m) for m in treedef.flatten_up_to(need_clip)]
+
+
+def clip_grads_tree(grads, clip, need_clip=None, global_norm=None):
     """Apply a grad-clip config to a pytree of RAW jax arrays (the shared
     jit-path implementation for TrainStep / HybridTrainStep /
-    LocalSGDTrainStep — one source of truth for the clip math)."""
+    LocalSGDTrainStep — one source of truth for the clip math).
+
+    `global_norm`: precomputed `global_grad_norm(grads, need_clip)` so a
+    caller that also feeds the norm to the health vector / GradScaler
+    does not pay a second full-tree traversal. `need_clip` (tree of
+    bools) excludes leaves from both the norm and the scaling."""
     if clip is None:
         return grads
     import jax
     import jax.numpy as jnp
     if isinstance(clip, ClipGradByGlobalNorm):
-        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                          for g in jax.tree.leaves(grads)))
+        gn = global_norm if global_norm is not None \
+            else global_grad_norm(grads, need_clip)
         f = jnp.minimum(clip.clip_norm / jnp.maximum(gn, 1e-12), 1.0)
-        return jax.tree.map(lambda g: (g * f).astype(g.dtype), grads)
+        if need_clip is None:
+            return jax.tree.map(lambda g: (g * f).astype(g.dtype), grads)
+        leaves, treedef = jax.tree.flatten(grads)
+        mask = _clip_mask(grads, need_clip)
+        return treedef.unflatten([
+            (g * f).astype(g.dtype) if m else g
+            for g, m in zip(leaves, mask)])
     if isinstance(clip, ClipGradByNorm):
         def per_leaf(g):
             n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))

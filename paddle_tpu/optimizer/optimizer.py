@@ -78,6 +78,41 @@ class Optimizer:
     def _decoupled_decay_coeff(self):
         return 0.0
 
+    # -- fused multi-tensor epilogue (ops/pallas/fused_update.py) --------
+    def _fused_kind(self):
+        """Kernel family this optimizer's update maps onto ("sgd" /
+        "momentum" / "adam" / "adamw"), or None when only the per-leaf
+        tree path can express it (Lars, Adamax, RMSProp, ...)."""
+        return None
+
+    def fused_spec(self):
+        """Static hyperparameter dict for the fused multi-tensor update
+        kernels, or None when this optimizer (or its current config)
+        must take the per-leaf tree path."""
+        kind = self._fused_kind()
+        if kind is None or self._stochastic_rounding:
+            return None
+        spec = {"kind": kind,
+                "n_moments": {"sgd": 0, "momentum": 1,
+                              "adam": 2, "adamw": 2}[kind],
+                "state_dtype": self._state_dtype,
+                "wd": float(self._decoupled_decay_coeff() or 0.0)}
+        if kind in ("adam", "adamw"):
+            spec.update(beta1=float(self._beta1),
+                        beta2=float(self._beta2),
+                        eps=float(self._epsilon))
+        elif kind == "momentum":
+            spec.update(momentum=float(self._momentum),
+                        nesterov=bool(self._nesterov))
+        return spec
+
+    def _decay_applies_name(self, name):
+        """Per-leaf decoupled-decay decision for the jit/tree path,
+        keyed by the flat param-tree name (AdamW apply_decay_param_fun;
+        the eager path's _decay_applies uses Parameter.name instead)."""
+        apply_fn = getattr(self, "_apply_decay_param_fun", None)
+        return True if apply_fn is None else bool(apply_fn(name))
+
     # -- eager path -----------------------------------------------------
     def _ensure_state(self, p):
         if id(p) not in self._states:
@@ -234,8 +269,16 @@ class Optimizer:
                             is_leaf=lambda x: hasattr(x, "dtype"))
 
     def apply_gradients_tree(self, params_tree, grads_tree, state_tree, lr,
-                             step, found_inf=None):
+                             step, found_inf=None, decay_mask=None,
+                             lr_scale=None):
         """Pure: returns (new_params, new_state). Call under jit.
+
+        `decay_mask` / `lr_scale` are optional per-leaf metadata trees
+        (same structure as params): decay_mask=False skips decoupled
+        decay for that leaf (AdamW apply_decay_param_fun, threaded by
+        TrainStep via _decay_applies_name), lr_scale multiplies the
+        learning rate per leaf (Parameter.optimize_attr). Defaults (all
+        True / 1.0) reproduce the historical tree-path numerics exactly.
 
         `found_inf` (a traced bool from GradScaler.jit_unscale_and_update)
         turns the whole update into a branchless skip: every param and
@@ -271,7 +314,7 @@ class Optimizer:
                     jnp.float32).astype(jnp.bfloat16)
             return x32.astype(dtype)
 
-        def upd(p, g, s, idx):
+        def upd(p, g, s, idx, decay_on, lrs):
             # master-weight leaf (init_leaf_state, multi_precision): the
             # f32 master accumulates sub-bf16-ulp updates; the working
             # param is just its rounded shadow
@@ -280,9 +323,11 @@ class Optimizer:
             if isinstance(s, dict) and "master" in s:
                 master, s = s["master"], s["state"]
             w = master if master is not None else p.astype(jnp.float32)
-            if wd:
-                w = w * (1.0 - lr * wd)
-            np_, ns_ = self._update(w, g.astype(jnp.float32), s, lr, step)
+            lr_leaf = lr if lrs is None else lr * lrs
+            if wd and decay_on:
+                w = w * (1.0 - lr_leaf * wd)
+            np_, ns_ = self._update(w, g.astype(jnp.float32), s, lr_leaf,
+                                    step)
             leaves = jax.tree.leaves(ns_)
             keys = (jax.random.split(jax.random.fold_in(key, 1),
                                      max(len(leaves), 1))
@@ -299,9 +344,15 @@ class Optimizer:
         flat_p, treedef = jax.tree.flatten(params_tree)
         flat_g = treedef.flatten_up_to(grads_tree)
         flat_s = treedef.flatten_up_to(state_tree)
+        flat_dm = treedef.flatten_up_to(decay_mask) \
+            if decay_mask is not None else [True] * len(flat_p)
+        flat_ls = treedef.flatten_up_to(lr_scale) \
+            if lr_scale is not None else [None] * len(flat_p)
         new_p, new_s = [], []
         for i, (p, g, s) in enumerate(zip(flat_p, flat_g, flat_s)):
-            np_, ns_ = upd(p, g, s, i)
+            np_, ns_ = upd(p, g, s, i, flat_dm[i],
+                           None if flat_ls[i] is None
+                           or float(flat_ls[i]) == 1.0 else flat_ls[i])
             if found_inf is not None:
                 np_ = jnp.where(found_inf, p, np_)
                 ns_ = jax.tree.map(
@@ -321,6 +372,9 @@ class SGD(Optimizer):
 
     def _update(self, p, g, state, lr, step):
         return p - lr * g, state
+
+    def _fused_kind(self):
+        return "sgd"
 
 
 class Momentum(Optimizer):
@@ -343,6 +397,9 @@ class Momentum(Optimizer):
         else:
             p = p - lr * vel
         return p, (vel,)
+
+    def _fused_kind(self):
+        return "momentum"
 
 
 class LarsMomentum(Momentum):
@@ -377,6 +434,9 @@ class LarsMomentum(Momentum):
             gf + self._lars_wd * pf).astype(vel.dtype)
         return (pf - vel.astype(jnp.float32)).astype(p.dtype), (vel,)
 
+    def _fused_kind(self):
+        return None  # per-leaf norms: tree path only
+
 
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -404,6 +464,9 @@ class Adam(Optimizer):
         p = p - lr_t * m / (jnp.sqrt(v) + eps)
         return p, (m, v)
 
+    def _fused_kind(self):
+        return "adam"
+
 
 class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -418,6 +481,9 @@ class AdamW(Adam):
 
     def _decoupled_decay_coeff(self):
         return self._coeff
+
+    def _fused_kind(self):
+        return "adamw"
 
 
 class Adamax(Optimizer):

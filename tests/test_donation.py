@@ -45,14 +45,32 @@ def _alias_count(hlo_text):
     return m.group(1).count("must-alias") + m.group(1).count("may-alias")
 
 
+def _donated_leaves(step):
+    # what the dispatch actually donates: on the fused-epilogue layout
+    # that is the dtype-bucketed flat stores (few megabuffers), on the
+    # tree layout the per-leaf params/opt-state trees
+    return (len(jax.tree.leaves(step._params_store))
+            + len(jax.tree.leaves(step._opt_store)))
+
+
 def test_train_step_aliases_params_and_opt_state():
     step, x, y = _make()
-    n_leaves = (len(jax.tree.leaves(step.params))
-                + len(jax.tree.leaves(step.opt_state)))
+    n_leaves = _donated_leaves(step)
     aliases = _alias_count(step.compiled_text(x, y))
     assert aliases >= n_leaves, (
         f"{aliases} aliased buffers < {n_leaves} donated leaves — "
         "the step is copying the model instead of updating in place")
+
+
+def test_train_step_aliases_every_tree_leaf_unfused():
+    """The tree path's per-leaf donation contract, kept alive by the
+    escape hatch: every param and optimizer-state leaf aliases."""
+    step, x, y = _make()
+    tree = TrainStep(step.model, _loss_fn, step.optimizer,
+                     fused_update=False)
+    n_leaves = (len(jax.tree.leaves(tree.params))
+                + len(jax.tree.leaves(tree.opt_state)))
+    assert _alias_count(tree.compiled_text(x, y)) >= n_leaves
 
 
 def test_no_donation_no_aliases():
@@ -62,8 +80,7 @@ def test_no_donation_no_aliases():
 
 def test_scaler_state_is_donated_too():
     step, x, y = _make(scaler=GradScaler(init_loss_scaling=2.0 ** 10))
-    n_leaves = (len(jax.tree.leaves(step.params))
-                + len(jax.tree.leaves(step.opt_state))
+    n_leaves = (_donated_leaves(step)
                 + len(jax.tree.leaves(step.scaler_state)))
     assert _alias_count(step.compiled_text(x, y)) >= n_leaves
 

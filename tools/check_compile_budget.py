@@ -127,6 +127,18 @@ def compare_warm(baseline, warm_rec, factor, slack, require_all):
             "the budget")
         return violations, notes, None
     if wall < base_wall:
+        if entry["wall_s"] >= entry["sum_s"]:
+            # a single-worker box (1 CPU) serializes compiles: wall ~=
+            # sum. Its faster absolute wall must NOT replace checked-in
+            # OVERLAP evidence (wall well under sum) — the comparand
+            # exists to catch the overlap breaking, and a wall>=sum
+            # baseline could never catch it again.
+            notes.append(
+                f"warm_set: wall {wall:.2f}s beats baseline "
+                f"{base_wall:.2f}s but carries no overlap evidence "
+                f"(wall >= sum {entry['sum_s']:.2f}s — serialized "
+                "compiles); keeping the checked-in evidence")
+            return violations, notes, None
         notes.append(f"warm_set: wall {wall:.2f}s beats baseline "
                      f"{base_wall:.2f}s (ratchet with --update)")
         return violations, notes, entry

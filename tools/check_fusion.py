@@ -18,6 +18,12 @@ Comparison: per baseline tag, FAIL when
                       container, same flags — the HLO is deterministic;
                       MORE fusion regions means a region broke apart)
     bytes_accessed >  baseline * (1 + BYTES_TOL) (default 10%)
+    instructions   >  baseline + INSTR_SLACK    (default 0: the HLO
+                      instruction count is deterministic; growth is the
+                      per-leaf op-soup signature the fused multi-tensor
+                      epilogue exists to prevent — a tree-path
+                      regression shows up here as hundreds of extra
+                      tiny ops before it shows up in seconds)
 
 Sources and ratcheting: identical to tools/check_compile_budget.py
 (--ledger JSONL or the canonical workload; `--update` only ever
@@ -28,7 +34,7 @@ executable) on an injected fusion/bytes regression.
 Usage:
   python tools/check_fusion.py [--baseline BASELINE_HLO.json]
          [--ledger FILE.jsonl] [--fusion-slack 0] [--bytes-tol 0.10]
-         [--require-all] [--update]
+         [--instr-slack 0] [--require-all] [--update]
 Exit 0 clean, 1 on regression, 2 on gate failure.
 """
 import argparse
@@ -40,7 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _gate_common as gc  # noqa: E402
 
 
-def compare(baseline, current, fusion_slack, bytes_tol, require_all):
+def compare(baseline, current, fusion_slack, bytes_tol, require_all,
+            instr_slack=0):
     """(violations, notes, ratchet) — ratchet maps tag -> better entry."""
     violations, notes, ratchet = [], [], {}
     base_tags = baseline["executables"]
@@ -54,6 +61,7 @@ def compare(baseline, current, fusion_slack, bytes_tol, require_all):
             continue
         base_fusion = int(base.get("fusion_count", 0))
         base_bytes = float(base.get("bytes_accessed", 0.0))
+        base_instr = int(base.get("instructions", 0))
         if cur["fusion_count"] > base_fusion + fusion_slack:
             violations.append(
                 f"{tag}: fusion_count {cur['fusion_count']} > baseline "
@@ -66,17 +74,28 @@ def compare(baseline, current, fusion_slack, bytes_tol, require_all):
                 f"{tag}: bytes_accessed {cur['bytes_accessed']:.3e} > "
                 f"baseline {base_bytes:.3e} * {1.0 + bytes_tol:.2f} — "
                 "the executable moves more HBM bytes per run")
+        if base_instr and cur["instructions"] > base_instr + instr_slack:
+            violations.append(
+                f"{tag}: instructions {cur['instructions']} > baseline "
+                f"{base_instr} (+{instr_slack} slack) — per-leaf op "
+                "soup is creeping back; check what stopped going "
+                "through the fused epilogue / fused kernels")
         strictly_better = (cur["fusion_count"] < base_fusion or
-                           cur["bytes_accessed"] < base_bytes)
+                           cur["bytes_accessed"] < base_bytes or
+                           (base_instr and
+                            cur["instructions"] < base_instr))
         no_worse = (cur["fusion_count"] <= base_fusion and
-                    cur["bytes_accessed"] <= base_bytes)
+                    cur["bytes_accessed"] <= base_bytes and
+                    (not base_instr or
+                     cur["instructions"] <= base_instr))
         if strictly_better and no_worse:
             ratchet[tag] = cur
             notes.append(
                 f"{tag}: fusion {cur['fusion_count']} / bytes "
-                f"{cur['bytes_accessed']:.3e} beats baseline "
-                f"{base_fusion} / {base_bytes:.3e} (ratchet with "
-                "--update)")
+                f"{cur['bytes_accessed']:.3e} / instr "
+                f"{cur['instructions']} beats baseline "
+                f"{base_fusion} / {base_bytes:.3e} / {base_instr} "
+                "(ratchet with --update)")
     for tag in sorted(set(current) - set(base_tags)):
         notes.append(f"{tag}: new executable with no fusion baseline — "
                      "add it with --update")
@@ -97,6 +116,8 @@ def main(argv=None):
         os.environ.get("PADDLE_TPU_FUSION_SLACK", "0")))
     ap.add_argument("--bytes-tol", type=float, default=float(
         os.environ.get("PADDLE_TPU_BYTES_TOL", "0.10")))
+    ap.add_argument("--instr-slack", type=int, default=int(
+        os.environ.get("PADDLE_TPU_INSTR_SLACK", "0")))
     ap.add_argument("--require-all", action="store_true",
                     help="every baseline executable must appear in the "
                          "ledger (canonical-workload ledgers)")
@@ -120,7 +141,7 @@ def main(argv=None):
 
     violations, notes, ratchet = compare(
         baseline, current, args.fusion_slack, args.bytes_tol,
-        args.require_all)
+        args.require_all, instr_slack=args.instr_slack)
 
     print("fusion accounting (per executable):")
     for tag in sorted(current):
@@ -130,7 +151,9 @@ def main(argv=None):
             f"fusions {cur['fusion_count']:4d}"
             f" (base {base.get('fusion_count', '-')})",
             f"bytes {cur['bytes_accessed']:.3e}"
-            f" (base {float(base.get('bytes_accessed', 0.0)):.3e})"]))
+            f" (base {float(base.get('bytes_accessed', 0.0)):.3e})",
+            f"instr {cur['instructions']:5d}"
+            f" (base {base.get('instructions', '-')})"]))
     for n in notes:
         print(f"note: {n}")
     if args.update and ratchet:

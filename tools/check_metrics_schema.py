@@ -22,6 +22,15 @@ Schema (documented in docs/OBSERVABILITY.md):
                   flops        number  per-step FLOPs (XLA cost analysis;
                                        0.0 when unavailable)
                   mfu          number  in [0, ~1]; 0.0 when unknown
+                  and optionally (fused multi-tensor epilogue,
+                  ops/pallas/fused_update.py):
+                  epilogue_bytes int   > 0 — analytic HBM traffic of the
+                                       two fused update passes
+                  epilogue_share number in [0, 1] — epilogue_bytes over
+                                       the executable's cost_analysis
+                                       bytes (the `update.epilogue` span
+                                       attributes the same share of the
+                                       step's wall time)
   kind == "serve" (one record per dispatched serving batch —
                   paddle_tpu/inference/serving.py) additionally requires:
                   requests     int     requests fused into the batch (>= 1)
@@ -186,6 +195,20 @@ def validate_line(line, where="<line>"):
         if isinstance(rec.get("step"), int) and \
                 not isinstance(rec.get("step"), bool) and rec["step"] < 1:
             errors.append(f"{where}: step must be >= 1, got {rec['step']}")
+        # fused-epilogue cost split (optional, typed+ranged when present)
+        if "epilogue_bytes" in rec:
+            v = rec["epilogue_bytes"]
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                errors.append(
+                    f"{where}: epilogue_bytes must be an int > 0, "
+                    f"got {v!r}")
+        if "epilogue_share" in rec:
+            v = rec["epilogue_share"]
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not (0.0 <= v <= 1.0):
+                errors.append(
+                    f"{where}: epilogue_share must be a number in "
+                    f"[0, 1], got {v!r}")
     elif rec.get("kind") == "serve":
         _check_types(rec, SERVE_REQUIRED, where, errors)
         # engine (the emitting engine's name) is optional for forward
