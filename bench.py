@@ -633,6 +633,7 @@ def _serve_gen_workload():
     from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
     from paddle_tpu.inference import GenerationEngine
     from paddle_tpu.profiler import monitor as _pmon
+    from paddle_tpu.profiler import serve_observatory as _sobs
 
     n_long = int(os.environ.get("BENCH_SERVE_GEN_LONG", "2"))
     n_short = int(os.environ.get("BENCH_SERVE_GEN_SHORT", "6"))
@@ -658,15 +659,20 @@ def _serve_gen_workload():
     def run(ragged):
         c0 = {k: _pmon.get_metric(f"serve.{k}")
               for k in ("pad_tokens", "prefix_hits",
-                        "chunked_prefill_tokens")}
+                        "chunked_prefill_tokens", "goodput_tokens",
+                        "wasted_tokens")}
         base = {k: (int(m.value) if m else 0) for k, m in c0.items()}
+        slo0 = _sobs.slo_report()["deadline"]
         eng = GenerationEngine(model, n_pages=128, page_size=8,
                                max_batch=4, max_new_tokens=max_new,
                                ragged=ragged, prefill_chunk=16,
                                name=f"bench_{'ragged' if ragged else 'bucketed'}")
         outs, ttfts = [None] * len(prompts), [None] * len(prompts)
         t0 = time.perf_counter()
-        handles = [eng.submit(p, max_new_tokens=n)
+        # a generous per-request SLO: attainment < 1.0 on this tiny
+        # workload means the engine (or the host) is badly degraded —
+        # exactly the regression serve_history exists to surface
+        handles = [eng.submit(p, max_new_tokens=n, deadline_ms=120_000)
                    for p, n in zip(prompts, new_toks)]
 
         def drain(i, h):
@@ -685,9 +691,15 @@ def _serve_gen_workload():
             t.join()
         wall = time.perf_counter() - t0
         frac = eng.pad_token_fraction()
+        kv_peak = eng.kv_peak_occupancy()
         eng.shutdown()
         delta = {k: (int(m2.value) if (m2 := _pmon.get_metric(
             f"serve.{k}")) else 0) - v for k, v in base.items()}
+        slo1 = _sobs.slo_report()["deadline"]
+        slo_total = slo1["requests"] - slo0["requests"]
+        slo_met = slo1["met"] - slo0["met"]
+        goodput = delta["goodput_tokens"]
+        wasted = delta["wasted_tokens"]
         ttfts_ms = sorted(1e3 * t for t in ttfts if t is not None)
         return {
             "outs": outs, "wall_s": round(wall, 3),
@@ -703,6 +715,16 @@ def _serve_gen_workload():
             "prefix_hit_rate": round(
                 delta["prefix_hits"] / max(total_prompt_toks, 1), 4),
             "chunked_prefill_tokens": delta["chunked_prefill_tokens"],
+            # SLO/goodput accounting (profiler/serve_observatory):
+            # deadline attainment over this run's deadline-carrying
+            # requests, useful-vs-dead generated tokens, and the page
+            # pool's peak occupancy (pad page excluded)
+            "slo_attainment": round(slo_met / slo_total, 4)
+            if slo_total else 1.0,
+            "goodput_tokens_per_s": round(goodput / wall, 1),
+            "wasted_token_fraction": round(
+                wasted / max(goodput + wasted, 1), 4),
+            "kv_peak_occupancy": round(kv_peak, 4),
             "ttft_p50_ms": round(
                 ttfts_ms[len(ttfts_ms) // 2], 1) if ttfts_ms else 0.0,
             "ttft_p99_ms": round(
@@ -726,6 +748,11 @@ def _serve_gen_workload():
         "prefix_hit_rate": ragged["prefix_hit_rate"],
         "ttft_p50_ms": ragged["ttft_p50_ms"],
         "ttft_p99_ms": ragged["ttft_p99_ms"],
+        # the serving-observatory headline (ragged path — the default)
+        "slo_attainment": ragged["slo_attainment"],
+        "goodput_tokens_per_s": ragged["goodput_tokens_per_s"],
+        "wasted_token_fraction": ragged["wasted_token_fraction"],
+        "kv_peak_occupancy": ragged["kv_peak_occupancy"],
     }
 
 
@@ -895,7 +922,9 @@ def _run_serve():
         for k in ("pad_token_fraction_ragged",
                   "pad_token_fraction_bucketed", "prefix_hit_rate",
                   "ttft_p50_ms", "ttft_p99_ms",
-                  "ragged_equals_bucketed"):
+                  "ragged_equals_bucketed", "slo_attainment",
+                  "goodput_tokens_per_s", "wasted_token_fraction",
+                  "kv_peak_occupancy"):
             if k in gen:
                 entry[k] = gen[k]
         history.append(entry)

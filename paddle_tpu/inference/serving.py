@@ -48,6 +48,23 @@ Both report into `profiler/monitor`:
     serve.shared_pages  gauge      KV pages with more than one holder
     serve.chunked_prefill_tokens counter  prompt tokens admitted via
                                    chunked prefill (ragged steps)
+    serve.generated_tokens counter tokens emitted to callers
+    serve.goodput_tokens / serve.wasted_tokens counters  generated
+                                   tokens split by whether the request
+                                   completed or died (expired/
+                                   cancelled/errored) — maintained by
+                                   profiler/serve_observatory
+    serve.tpot_s        histogram  time per output token (decode phase)
+    serve.kv_*          gauges     page-pool occupancy snapshots
+
+Every request additionally carries a `profiler.serve_observatory`
+RequestTrace — submit/admit/first-token/terminal timestamps, token
+counts, prefix-hit tokens, peak pages held — emitted as ONE
+`kind:"request"` record at its terminal state (completed / expired /
+rejected / error / cancelled), and `GenerationEngine` emits periodic
+`kind:"kvcache"` pool snapshots plus `load_report()` (the admission
+snapshot a load-aware router consumes). See docs/SERVING.md
+"The serving observatory".
 
 The dispatcher and decode loops are fenced by tools/check_no_hot_sync.py:
 the ONLY host blocks are the scheduler's queue wait and the one
@@ -68,6 +85,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..profiler import monitor as _monitor
+from ..profiler import serve_observatory as _obs
 from ..profiler import statistic as _stat
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
@@ -132,15 +150,37 @@ class BucketLadder:
 
 
 class _Request:
-    __slots__ = ("arrays", "n", "key", "future", "deadline", "t_submit")
+    __slots__ = ("arrays", "n", "key", "future", "deadline", "t_submit",
+                 "trace")
 
-    def __init__(self, arrays, n, key, deadline):
+    def __init__(self, arrays, n, key, deadline, trace=None):
         self.arrays = arrays
         self.n = n
         self.key = key  # coalescing signature, computed once at submit
         self.future = Future()
         self.deadline = deadline
         self.t_submit = time.perf_counter()
+        self.trace = trace  # serve_observatory RequestTrace
+
+
+def _trace_outcome(exc):
+    """Map a rejection exception onto a request-record outcome: a
+    deadline expiry is "expired", shutdown-shed work is "cancelled"
+    (the server chose not to serve it), anything else failed onto the
+    future is "error"."""
+    if isinstance(exc, DeadlineExceeded):
+        return "expired"
+    if isinstance(exc, EngineStopped):
+        return "cancelled"
+    return "error"
+
+
+def _finish_trace(trace, exc):
+    """Close a trace from a rejection path (trace may be None only for
+    handles built outside submit — engine paths always attach one)."""
+    if trace is not None:
+        trace.finish(_trace_outcome(exc),
+                     error=f"{type(exc).__name__}: {exc}")
 
 
 def _resolve_future(fut, value):
@@ -388,6 +428,7 @@ class InferenceEngine(_SchedulerLifecycle):
         self._inflight = 0       # requests claimed but not yet resolved
         self._expired_reqs = deque()  # deferred rejections (dispatcher)
         self._pending_results = deque()  # dispatched, awaiting resolution
+        _obs.register_engine(self)  # debug bundles snapshot load_report
         self._thread = threading.Thread(
             target=_run_scheduler, args=(weakref.ref(self),),
             name="serve-dispatch", daemon=True)
@@ -421,19 +462,30 @@ class InferenceEngine(_SchedulerLifecycle):
         key = self._key_of(arrays)
         deadline = None if deadline_ms is None else \
             time.perf_counter() + float(deadline_ms) / 1000.0
-        req = _Request(arrays, n, key, deadline)
+        trace = _obs.start_request(
+            self.name, rows=n,
+            deadline_s=None if deadline_ms is None
+            else float(deadline_ms) / 1000.0)
+        req = _Request(arrays, n, key, deadline, trace=trace)
+        reject = None
         with self._cv:
             if self._stopping:
-                raise EngineStopped("engine is drained/shut down")
-            if len(self._buf) >= self.max_queue:
+                reject = EngineStopped("engine is drained/shut down")
+            elif len(self._buf) >= self.max_queue:
                 _monitor.counter("serve.rejected").inc()
-                raise QueueFullError(
+                reject = QueueFullError(
                     f"serving queue full ({self.max_queue} waiting) — "
                     "shed load or raise max_queue")
-            self._buf.append(req)
-            _monitor.counter("serve.requests").inc()
-            _monitor.gauge("serve.queue_depth").set(len(self._buf))
-            self._cv.notify_all()
+            else:
+                self._buf.append(req)
+                _monitor.counter("serve.requests").inc()
+                _monitor.gauge("serve.queue_depth").set(len(self._buf))
+                self._cv.notify_all()
+        if reject is not None:
+            # trace close OUTSIDE the lock: finish() appends to the
+            # metrics JSONL, and file I/O must never stall the engine
+            trace.finish("rejected", error=str(reject))
+            raise reject
         return req.future
 
     def __call__(self, *args, deadline_ms=None, timeout=None):
@@ -553,20 +605,33 @@ class InferenceEngine(_SchedulerLifecycle):
         deferred to _flush_expired (outside the lock) because
         set_exception fires done-callbacks synchronously, and a
         callback that re-enters the engine would deadlock here."""
-        if req.deadline is not None and now > req.deadline:
-            _monitor.counter("serve.expired").inc()
-            self._expired_reqs.append(req)
+        if req.future.cancelled():
+            # a cancelled future occupies no bucket row; it still rides
+            # _expired_reqs so its request trace closes outside the
+            # lock (outcome "cancelled")
+            self._expired_reqs.append(("cancelled", req))
             return True
-        # a cancelled future occupies no bucket row either
-        return req.future.cancelled()
+        if req.deadline is not None and now > req.deadline:
+            # outcome decided HERE, with the counter: a caller cancel
+            # racing the deferred flush must not file this deadline
+            # miss as "cancelled" while serve.expired already counted it
+            _monitor.counter("serve.expired").inc()
+            self._expired_reqs.append(("expired", req))
+            return True
+        return False
 
     def _flush_expired(self):
-        """Reject deferred deadline expiries. Dispatcher thread only,
-        never holding self._cv."""
+        """Reject deferred deadline expiries (and close cancelled
+        requests' traces). Dispatcher thread only, never holding
+        self._cv. Outcomes were fixed at triage time (_expired) —
+        rejecting an already-cancelled future is a tolerated no-op."""
         while self._expired_reqs:
-            req = self._expired_reqs.popleft()
-            _reject_future(req.future, DeadlineExceeded(
-                "deadline passed before dispatch"))
+            outcome, req = self._expired_reqs.popleft()
+            if outcome == "expired":
+                _reject_future(req.future, DeadlineExceeded(
+                    "deadline passed before dispatch"))
+            if req.trace is not None:
+                req.trace.finish(outcome)
 
     def _take_batch(self, block=True):
         """Pop the oldest live request, then coalesce same-signature
@@ -659,6 +724,7 @@ class InferenceEngine(_SchedulerLifecycle):
         _monitor.counter("serve.errors").inc()
         for r in batch:
             _reject_future(r.future, exc)
+            _finish_trace(r.trace, exc)
         with self._cv:
             self._inflight -= len(batch)
             self._cv.notify_all()
@@ -667,6 +733,9 @@ class InferenceEngine(_SchedulerLifecycle):
         """Pad + fuse the coalesced requests and dispatch the bucket's
         executable ASYNCHRONOUSLY — returns (batch, device outputs,
         meta) for _resolve_batch; nothing here blocks on the device."""
+        for r in batch:  # claimed by the dispatcher: queue phase over
+            if r.trace is not None:
+                r.trace.admitted()
         rows = sum(r.n for r in batch)
         b = self.ladder.batch(rows)
         cols, pad_elems = [], 0
@@ -734,6 +803,12 @@ class InferenceEngine(_SchedulerLifecycle):
             lat = now - r.t_submit
             lat_sum += lat
             _monitor.histogram("serve.latency_s").observe(lat)
+            if r.trace is not None:  # record exists before result lands
+                # a caller may have cancelled AFTER dispatch: the
+                # set_result below is then a no-op, and the ledger must
+                # not claim a completion nobody received
+                r.trace.finish("cancelled" if r.future.cancelled()
+                               else "completed")
             _resolve_future(r.future, sl[0] if single else sl)
         with self._cv:
             self._inflight -= len(batch)
@@ -782,6 +857,39 @@ class InferenceEngine(_SchedulerLifecycle):
     def _reject_detached(self, reqs, exc):
         for r in reqs:
             _reject_future(r.future, exc)
+            _finish_trace(r.trace, exc)
+
+    def load_report(self):
+        """Instantaneous admission snapshot (the serving observatory's
+        router interface — docs/SERVING.md): queue depth vs capacity,
+        claimed-but-unresolved work, compiled buckets, and recent tail
+        latency from the process-global histograms. Pure host reads.
+        The lock acquire is BOUNDED: debug bundles call this to
+        diagnose a hung engine, and a scheduler wedged holding _cv
+        must not hang the hang-diagnosis tool."""
+        if not self._cv.acquire(timeout=1.0):
+            return {"engine": self.name,
+                    "unavailable": "engine lock held > 1s (wedged?)"}
+        try:
+            q = len(self._buf)
+            inflight = self._inflight
+            stopping = self._stopping
+        finally:
+            self._cv.release()
+        lat = _monitor.get_metric("serve.latency_s")
+        return {
+            "engine": self.name, "stopping": stopping,
+            "queue_depth": q, "max_queue": self.max_queue,
+            "inflight": inflight, "pipeline": self.pipeline,
+            "buckets_compiled": len(self._exec),
+            "latency_p50_s": lat.percentile(50) if lat else 0.0,
+            "latency_p99_s": lat.percentile(99) if lat else 0.0,
+        }
+
+    def observatory_snapshot(self):
+        """What a debug bundle records for this engine
+        (serve_observatory.debug_payload)."""
+        return {"load_report": self.load_report()}
 
 
 # ---------------------------------------------------------------------------
@@ -805,6 +913,8 @@ class GenerationHandle:
         self._cv = threading.Condition()
         self._closed = False
         self.t_submit = time.perf_counter()
+        self.deadline = None  # perf_counter bound (submit deadline_ms=)
+        self.trace = None     # serve_observatory RequestTrace
 
     def _push(self, tok):
         with self._cv:
@@ -897,7 +1007,7 @@ class GenerationEngine(_SchedulerLifecycle):
     def __init__(self, model, n_pages=256, page_size=16, max_batch=8,
                  max_queue=64, max_new_tokens=64, eos_token_id=None,
                  cache=None, name=None, ragged=None, prefill_chunk=32,
-                 prefix_cache=True):
+                 prefix_cache=True, kv_snapshot_every=8):
         self.name = name or f"gen{next(_ENGINE_IDS)}"
         for need in ("paged_decode_step", "make_paged_cache"):
             if not hasattr(model, need):
@@ -936,16 +1046,28 @@ class GenerationEngine(_SchedulerLifecycle):
         self._stopping = False
         self._abort = False      # no-wait shutdown: fail active too
         self._next_sid = 0
+        # pool observatory cadence: one kind:"kvcache" snapshot per
+        # kv_snapshot_every steps (the first step always snapshots)
+        self.kv_snapshot_every = max(1, int(kv_snapshot_every))
+        self._step_i = 0
+        self._kv_peak_held = 0   # peak pages held at any step
+        _obs.register_engine(self)
         self._thread = threading.Thread(
             target=_run_scheduler, args=(weakref.ref(self),),
             name="serve-decode", daemon=True)
         self._thread.start()
 
     # -- admission -------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None):
+    def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
+               deadline_ms=None):
         """Queue one prompt (1-D int array) for generation; returns a
         GenerationHandle. Rejects immediately (QueueFullError) when the
-        queue is full, and validates the context limit up front."""
+        queue is full, and validates the context limit up front. A
+        `deadline_ms` that passes while the request is still QUEUED
+        fails the handle with DeadlineExceeded (outcome "expired") —
+        in-flight generation is never killed by its deadline, but the
+        request record states whether it was met (`deadline_met`), and
+        the SLO aggregates count it."""
         prompt = np.asarray(
             prompt_ids.value if isinstance(prompt_ids, Tensor)
             else prompt_ids).astype(np.int64).reshape(-1)
@@ -971,17 +1093,33 @@ class GenerationEngine(_SchedulerLifecycle):
                 "admitted; grow n_pages or shorten the request")
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         handle = GenerationHandle(prompt, max_new, eos)
+        if deadline_ms is not None:
+            handle.deadline = time.perf_counter() \
+                + float(deadline_ms) / 1000.0
+        handle.trace = _obs.start_request(
+            self.name, prompt_tokens=int(prompt.size),
+            max_new_tokens=max_new,
+            deadline_s=None if deadline_ms is None
+            else float(deadline_ms) / 1000.0)
+        reject = None
         with self._cv:
             if self._stopping:
-                raise EngineStopped("engine is drained/shut down")
-            if len(self._pending) >= self.max_queue:
+                reject = EngineStopped("engine is drained/shut down")
+            elif len(self._pending) >= self.max_queue:
                 _monitor.counter("serve.rejected").inc()
-                raise QueueFullError(
+                reject = QueueFullError(
                     f"generation queue full ({self.max_queue} waiting)")
-            self._pending.append(handle)
-            _monitor.counter("serve.requests").inc()
-            _monitor.gauge("serve.queue_depth").set(len(self._pending))
-            self._cv.notify_all()
+            else:
+                self._pending.append(handle)
+                _monitor.counter("serve.requests").inc()
+                _monitor.gauge("serve.queue_depth").set(
+                    len(self._pending))
+                self._cv.notify_all()
+        if reject is not None:
+            # trace close OUTSIDE the lock: finish() appends to the
+            # metrics JSONL, and file I/O must never stall the engine
+            handle.trace.finish("rejected", error=str(reject))
+            raise reject
         return handle
 
     # -- the scheduler/decode loop --------------------------------------
@@ -1035,39 +1173,87 @@ class GenerationEngine(_SchedulerLifecycle):
             self._fail_all(e)
         return True
 
+    def _pop_doomed_head(self):
+        """Queue-head triage shared by both admission loops. Caller
+        HOLDS self._cv. A head that was cancelled while queued, or
+        whose deadline passed, is popped — before paying any prefill
+        or reserving pages — and returned as (outcome, handle) for
+        `_close_doomed` to resolve OUTSIDE the lock (set_exception
+        fires done-callbacks synchronously, and the trace close does
+        file I/O). `_admitting` counts the handoff so drain() never
+        observes "queue empty, nothing in flight" while the rejection
+        is still pending. Returns None when the head is live."""
+        handle = self._pending[0]
+        outcome = None
+        if handle.future.cancelled():
+            outcome = "cancelled"
+        elif handle.deadline is not None \
+                and time.perf_counter() > handle.deadline:
+            outcome = "expired"
+            _monitor.counter("serve.expired").inc()
+        if outcome is None:
+            return None
+        self._pending.popleft()
+        _monitor.gauge("serve.queue_depth").set(len(self._pending))
+        self._admitting += 1
+        return outcome, handle
+
+    def _close_doomed(self, doomed):
+        """Resolve a popped dead head (scheduler thread, OUTSIDE the
+        lock): reject expiries, close the trace and the stream, then
+        release the drain() handoff."""
+        outcome, handle = doomed
+        try:
+            if outcome == "expired":
+                _reject_future(handle.future, DeadlineExceeded(
+                    "deadline passed before admission"))
+            if handle.trace is not None:
+                handle.trace.finish(outcome)
+            handle._close()
+        finally:
+            with self._cv:
+                self._admitting -= 1
+                self._cv.notify_all()
+
     def _admit(self):
         """Prefill queued prompts into free slots between decode steps.
         Admission reserves the worst case (prompt + max_new tokens of
         pages) so a decoding sequence can never hit out-of-pages."""
         while True:
+            doomed = None
             with self._cv:
-                if not self._pending or len(self._active) >= self.max_batch:
+                if not self._pending:
                     return
-                handle = self._pending[0]
-                if handle.future.cancelled():
-                    # cancelled while queued: drop it BEFORE paying the
-                    # prefill (the priciest per-request op here) or
-                    # reserving its pages
+                # triage BEFORE the capacity gate: a saturated engine
+                # must still shed expired/cancelled heads — overload is
+                # exactly the regime deadline shedding exists for
+                doomed = self._pop_doomed_head()
+                if doomed is None:
+                    if len(self._active) >= self.max_batch:
+                        return
+                    handle = self._pending[0]
+                    need = self.cache.pages_needed(
+                        handle.prompt.size + handle.max_new_tokens)
+                    # allocation is LAZY: active sequences still hold
+                    # claims on pages they haven't drawn yet — admit
+                    # only against what's free AFTER every outstanding
+                    # reservation
+                    outstanding = sum(
+                        max(s.reserve - self.cache.pages_drawn(s.sid), 0)
+                        for s in self._active)
+                    if not self.cache.can_allocate(
+                            handle.prompt.size + handle.max_new_tokens,
+                            reserved=outstanding):
+                        return  # wait for evictions to free pages
                     self._pending.popleft()
+                    self._admitting += 1  # drain() must see the handoff
                     _monitor.gauge("serve.queue_depth").set(
                         len(self._pending))
-                    handle._close()
-                    continue
-                need = self.cache.pages_needed(
-                    handle.prompt.size + handle.max_new_tokens)
-                # allocation is LAZY: active sequences still hold claims
-                # on pages they haven't drawn yet — admit only against
-                # what's free AFTER every outstanding reservation
-                outstanding = sum(
-                    max(s.reserve - self.cache.pages_drawn(s.sid), 0)
-                    for s in self._active)
-                if not self.cache.can_allocate(
-                        handle.prompt.size + handle.max_new_tokens,
-                        reserved=outstanding):
-                    return  # wait for evictions to free pages
-                self._pending.popleft()
-                self._admitting += 1  # drain() must see the handoff
-                _monitor.gauge("serve.queue_depth").set(len(self._pending))
+                    if handle.trace is not None:
+                        handle.trace.admitted()
+            if doomed is not None:
+                self._close_doomed(doomed)
+                continue
             try:
                 sid = f"g{self._next_sid}"
                 self._next_sid += 1
@@ -1088,6 +1274,7 @@ class GenerationEngine(_SchedulerLifecycle):
                 except Exception as e:
                     self.cache.free_sequence(sid)
                     _reject_future(handle.future, e)
+                    _finish_trace(handle.trace, e)
                     handle._close()
                     continue
                 _monitor.histogram("serve.ttft_s").observe(
@@ -1148,6 +1335,7 @@ class GenerationEngine(_SchedulerLifecycle):
                               for s in self._active) / b}, kind="serve")
         for seq, tok in zip(list(self._active), nxt):
             self._emit(seq, int(tok))
+        self._note_kv_step()
 
     def pad_token_fraction(self):
         """Measured fraction of this engine's attention score slots
@@ -1171,48 +1359,53 @@ class GenerationEngine(_SchedulerLifecycle):
         the prefix cache's fully-matched pages, against the free list
         plus the registry's evictable retention."""
         while True:
+            doomed = None
             with self._cv:
-                in_flight = len(self._active) + len(self._prefilling)
-                if not self._pending or in_flight >= self.max_batch:
+                if not self._pending:
                     return
-                handle = self._pending[0]
-                if handle.future.cancelled():
-                    # cancelled while queued: drop BEFORE reserving
-                    # pages or paying any prefill chunks
+                # triage BEFORE the capacity gate (see _admit): shed
+                # expired/cancelled heads even at max_batch
+                doomed = self._pop_doomed_head()
+                if doomed is None:
+                    in_flight = len(self._active) + len(self._prefilling)
+                    if in_flight >= self.max_batch:
+                        return
+                    handle = self._pending[0]
+                    matched_full = pinned = 0
+                    if self.prefix_cache:
+                        # at most prompt-1 cached tokens: the final
+                        # prompt token must run through the model to
+                        # produce the first sampled token's logits
+                        _, matched_full, pinned = \
+                            self.cache.match_prefix_credit(
+                                handle.prompt,
+                                max_tokens=handle.prompt.size - 1)
+                    need = self.cache.pages_needed(
+                        handle.prompt.size + handle.max_new_tokens) \
+                        - matched_full
+                    # claims compare against pages DRAWN, not held: an
+                    # acquired shared prefix inflates pages_held
+                    # without consuming the pool, and its copy-on-write
+                    # + tail pages are still owed from this reservation
+                    outstanding = sum(
+                        max(s.reserve - self.cache.pages_drawn(s.sid), 0)
+                        for s in self._active + self._prefilling)
+                    # supply subtracts `pinned`: matched registry-only
+                    # pages count as evictable TODAY but acquire_prefix
+                    # pins them — crediting need AND counting them as
+                    # supply would admit against phantom capacity
+                    if need + outstanding > self.cache.n_free_pages() \
+                            + self.cache.n_evictable_pages() - pinned:
+                        return  # wait for evictions to free pages
                     self._pending.popleft()
+                    self._admitting += 1  # drain() sees the handoff
                     _monitor.gauge("serve.queue_depth").set(
                         len(self._pending))
-                    handle._close()
-                    continue
-                matched_full = pinned = 0
-                if self.prefix_cache:
-                    # at most prompt-1 cached tokens: the final prompt
-                    # token must run through the model to produce the
-                    # first sampled token's logits
-                    _, matched_full, pinned = \
-                        self.cache.match_prefix_credit(
-                            handle.prompt,
-                            max_tokens=handle.prompt.size - 1)
-                need = self.cache.pages_needed(
-                    handle.prompt.size + handle.max_new_tokens) \
-                    - matched_full
-                # claims compare against pages DRAWN, not held: an
-                # acquired shared prefix inflates pages_held without
-                # consuming the pool, and its copy-on-write + tail
-                # pages are still owed from this reservation
-                outstanding = sum(
-                    max(s.reserve - self.cache.pages_drawn(s.sid), 0)
-                    for s in self._active + self._prefilling)
-                # supply subtracts `pinned`: matched registry-only
-                # pages count as evictable TODAY but acquire_prefix
-                # pins them — crediting need AND counting them as
-                # supply would admit against phantom capacity
-                if need + outstanding > self.cache.n_free_pages() \
-                        + self.cache.n_evictable_pages() - pinned:
-                    return  # wait for evictions to free pages
-                self._pending.popleft()
-                self._admitting += 1  # drain() must see the handoff
-                _monitor.gauge("serve.queue_depth").set(len(self._pending))
+                    if handle.trace is not None:
+                        handle.trace.admitted()
+            if doomed is not None:
+                self._close_doomed(doomed)
+                continue
             try:
                 sid = f"g{self._next_sid}"
                 self._next_sid += 1
@@ -1225,6 +1418,8 @@ class GenerationEngine(_SchedulerLifecycle):
                 if cached:
                     _monitor.counter("serve.prefix_hits").inc(cached)
                     self._step_prefix_hits += cached
+                    if handle.trace is not None:
+                        handle.trace.note_prefix(cached)
                 self._prefilling.append(
                     _ActiveSeq(sid, handle, need, cached=cached))
             finally:
@@ -1244,6 +1439,8 @@ class GenerationEngine(_SchedulerLifecycle):
             if s.handle.future.cancelled():
                 self.cache.free_sequence(s.sid)
                 self._prefilling.remove(s)
+                if s.handle.trace is not None:
+                    s.handle.trace.finish("cancelled")
                 s.handle._close()
         rows, metas = [], []
         for s in self._active:
@@ -1263,6 +1460,8 @@ class GenerationEngine(_SchedulerLifecycle):
             n = min(budget, s.handle.prompt.size - s.filled)
             rows.append((s.sid, s.handle.prompt[s.filled:s.filled + n]))
             metas.append(("prefill", s, n))
+            if s.handle.trace is not None:
+                s.handle.trace.note_chunk()
             budget -= n
         if not rows:
             return
@@ -1337,6 +1536,88 @@ class GenerationEngine(_SchedulerLifecycle):
                 now - s.handle.t_submit)
             self._active.append(s)
             self._emit(s, tok)
+        self._note_kv_step()
+
+    def _note_kv_step(self):
+        """Per-step pool bookkeeping (loop thread, lint-fenced): track
+        peak LIVE occupancy and emit the periodic `kind:"kvcache"`
+        snapshot every kv_snapshot_every steps. Pure host dict math, no
+        device reads, no per-token records. Evictable prefix-registry
+        retention is subtracted — it is best-effort cache, reclaimed on
+        demand, so counting it would drift the peak toward 1.0 on any
+        long prefix-cached run regardless of real pressure (the
+        registry walk is bounded by the pool size: one short host scan
+        per ms-scale decode step)."""
+        self._step_i += 1
+        live = self.cache.n_pages - 1 - self.cache.n_free_pages() \
+            - self.cache.n_evictable_pages()
+        if live > self._kv_peak_held:
+            self._kv_peak_held = live
+        if (self._step_i - 1) % self.kv_snapshot_every == 0:
+            _obs.record_pool_stats(
+                self.name, self.cache,
+                extra={"queue_depth": len(self._pending),
+                       "active": len(self._active)
+                       + len(self._prefilling)})
+
+    def kv_peak_occupancy(self):
+        """Peak LIVE fraction of the usable page pool (pad page and
+        evictable registry retention excluded) held at any step so far
+        — the bench headline's KV occupancy."""
+        return self._kv_peak_held / max(self.cache.n_pages - 1, 1)
+
+    def load_report(self):
+        """Instantaneous admission snapshot (the serving observatory's
+        router interface — ROADMAP open item 3's load-aware admission
+        consumes exactly this): queue depth, active slots, free /
+        reserved / projected-admittable pages via the same
+        `pages_needed`/`pages_drawn` math admission uses, and recent
+        TTFT/TPOT tail percentiles from the process-global histograms.
+        Callable from any thread; pure host reads (lint-fenced). The
+        lock acquire is BOUNDED — a wedged decode loop holding _cv
+        must not hang the debug bundle asking what it was doing."""
+        if not self._cv.acquire(timeout=1.0):
+            return {"engine": self.name,
+                    "unavailable": "engine lock held > 1s (wedged?)"}
+        try:
+            pending = len(self._pending)
+            seqs = list(self._active) + list(self._prefilling)
+            stopping = self._stopping
+        finally:
+            self._cv.release()
+        outstanding = 0
+        for s in seqs:
+            try:
+                outstanding += max(
+                    s.reserve - self.cache.pages_drawn(s.sid), 0)
+            except KeyError:
+                pass  # evicted between the snapshot and this read
+        free = self.cache.n_free_pages()
+        evictable = self.cache.n_evictable_pages()
+        admittable = max(free + evictable - outstanding, 0)
+        ttft = _monitor.get_metric("serve.ttft_s")
+        tpot = _monitor.get_metric("serve.tpot_s")
+        return {
+            "engine": self.name, "stopping": stopping,
+            "queue_depth": pending,
+            "active": len(seqs), "max_batch": self.max_batch,
+            "slots_free": max(self.max_batch - len(seqs), 0),
+            "free_pages": free, "evictable_pages": evictable,
+            "reserved_pages": outstanding,
+            "admittable_pages": admittable,
+            "admittable_tokens": admittable * self.cache.page_size,
+            "kv_peak_occupancy": self.kv_peak_occupancy(),
+            "ttft_p50_s": ttft.percentile(50) if ttft else 0.0,
+            "ttft_p99_s": ttft.percentile(99) if ttft else 0.0,
+            "tpot_p50_s": tpot.percentile(50) if tpot else 0.0,
+            "tpot_p99_s": tpot.percentile(99) if tpot else 0.0,
+        }
+
+    def observatory_snapshot(self):
+        """What a debug bundle records for this engine: the admission
+        snapshot + the full pool observatory state."""
+        return {"load_report": self.load_report(),
+                "pool_stats": self.cache.pool_stats()}
 
     def warm(self, prompt_len, max_new_tokens=None):
         """Blocking warm_async: AOT-compile every ragged signature one
@@ -1391,10 +1672,17 @@ class GenerationEngine(_SchedulerLifecycle):
         if h.future.cancelled():
             self.cache.free_sequence(seq.sid)
             self._active.remove(seq)
+            if h.trace is not None:  # tokens already generated = waste
+                h.trace.finish("cancelled")
             h._close()
             with self._cv:
                 self._cv.notify_all()  # pages freed: admission may proceed
             return
+        if h.trace is not None:
+            if not seq.generated:
+                h.trace.first_token()  # TTFT boundary
+            h.trace.note_token(self.cache.pages_held(seq.sid))
+        _monitor.counter("serve.generated_tokens").inc()
         seq.generated.append(tok)
         seq.last = tok
         seq.handle._push(tok)
@@ -1411,6 +1699,8 @@ class GenerationEngine(_SchedulerLifecycle):
             self._active.remove(seq)
             _monitor.histogram("serve.latency_s").observe(
                 time.perf_counter() - h.t_submit)
+            if h.trace is not None:  # record exists before result lands
+                h.trace.finish("completed")
             final = np.asarray(seq.generated, np.int64)  # hot-sync-ok: host int list, not a device read
             _resolve_future(h.future, final)
             h._close()
@@ -1443,9 +1733,11 @@ class GenerationEngine(_SchedulerLifecycle):
             except Exception:
                 pass
             _reject_future(seq.handle.future, exc)
+            _finish_trace(seq.handle.trace, exc)
             seq.handle._close()
         for h in pend:
             _reject_future(h.future, exc)
+            _finish_trace(h.trace, exc)
             h._close()
 
     # -- lifecycle (drain/shutdown via _SchedulerLifecycle) --------------
@@ -1477,4 +1769,5 @@ class GenerationEngine(_SchedulerLifecycle):
                 except Exception:
                     pass
             _reject_future(h.future, exc)
+            _finish_trace(h.trace, exc)
             h._close()

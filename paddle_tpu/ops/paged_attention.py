@@ -132,7 +132,7 @@ class PagedKVCache:
         self._next_chain = _ROOT + 1
         self._stats = {"prefix_hits": 0, "prefix_hit_tokens": 0,
                        "prefix_misses": 0, "cow_copies": 0,
-                       "prefix_evictions": 0}
+                       "prefix_evictions": 0, "pages_drawn": 0}
 
     # ---- allocator ----------------------------------------------------
     def add_sequence(self, seq_id):
@@ -218,6 +218,9 @@ class PagedKVCache:
                 "free finished sequences or grow n_pages")
         page = self._free.pop()
         self._ref[page] = 1
+        self._stats["pages_drawn"] += 1  # cumulative pool draws (fresh
+        # allocations + CoW copies — the one choke point every draw
+        # passes through; pool_stats() reports it)
         return page
 
     def _materialize(self, seq_id, page_idx):
@@ -425,6 +428,44 @@ class PagedKVCache:
                     registered_pages=len(self._chain_info),
                     shared_pages=self.shared_page_count(),
                     evictable_pages=self.n_evictable_pages())
+
+    def pool_stats(self):
+        """The pool observatory's snapshot (profiler/serve_observatory
+        `record_pool_stats` emits it as a `kind:"kvcache"` record):
+        instantaneous free/held/shared/registered/evictable page counts,
+        the refcount histogram, prefix-registry size, and the cumulative
+        draw / copy-on-write / LRU-reclaim counters. Pure host dict
+        math — safe inside the serving hot loop (lint-fenced). Note
+        free + held == n_pages - 1: the reserved pad page 0 is neither
+        free nor held.
+
+        Callable from ANY thread (debug bundles snapshot a live
+        engine's pool mid-decode): the allocator dicts are copied
+        first via C-level dict()/list() — which the decode thread
+        cannot interleave — so iteration never races a mutation."""
+        ref = dict(self._ref)
+        chain = list(self._chain_info.values())
+        refcounts = {}
+        for r in ref.values():
+            refcounts[r] = refcounts.get(r, 0) + 1
+        reg_pages = {info["page"] for info in chain}
+        return {
+            "n_pages": int(self.n_pages),
+            "page_size": int(self.page_size),
+            "free_pages": len(self._free),
+            "held_pages": len(ref),
+            "shared_pages": sum(1 for r in ref.values() if r > 1),
+            "registered_pages": len(reg_pages),
+            "evictable_pages": sum(
+                1 for info in chain if ref.get(info["page"], 0) == 1),
+            "prefix_nodes": len(chain),
+            "sequences": len(self._tables),
+            "pages_drawn": int(self._stats["pages_drawn"]),
+            "cow_copies": int(self._stats["cow_copies"]),
+            "lru_reclaims": int(self._stats["prefix_evictions"]),
+            "refcounts": {str(r): n
+                          for r, n in sorted(refcounts.items())},
+        }
 
     # ---- writes -------------------------------------------------------
     def extend(self, seq_id, layer, k_new, v_new):

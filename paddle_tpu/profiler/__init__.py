@@ -27,6 +27,7 @@ from . import cost
 from . import trace_export
 from . import health
 from . import compile_observatory
+from . import serve_observatory
 from .statistic import SortedKeys
 from .health import AnomalyDetector
 
@@ -40,7 +41,7 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "load_profiler_result", "ProfilerResult", "SortedKeys",
            "statistic", "monitor", "cost", "flight_recorder",
            "trace_export", "health", "compile_observatory",
-           "AnomalyDetector"]
+           "serve_observatory", "AnomalyDetector"]
 
 
 class ProfilerTarget:

@@ -37,10 +37,13 @@ Each trigger writes `<dir>/<reason>/` containing `MANIFEST.json`,
 `ring.json` (the rings above), `metrics_tail.jsonl` (tail of
 `PADDLE_TPU_METRICS_FILE`), `hlo/<tag>.txt` + `<tag>.cost.json` (HLO and
 XLA cost analysis of every registered AOT executable — `jit/api.py`
-registers each train-step/serving compile), `env.json`
-(argv/versions/PADDLE*/JAX* env), and `stacks.txt` (faulthandler
-all-thread stacks). Writing never raises: a dump is diagnostics, not a
-second crash. See docs/OBSERVABILITY.md "The flight recorder".
+registers each train-step/serving compile), `requests_tail.jsonl` +
+`serve_state.json` (the serving observatory's recent terminal request
+records and every live engine's load_report/pool_stats —
+`serve_observatory.py`), `env.json` (argv/versions/PADDLE*/JAX* env),
+and `stacks.txt` (faulthandler all-thread stacks). Writing never
+raises: a dump is diagnostics, not a second crash. See
+docs/OBSERVABILITY.md "The flight recorder".
 
 `paddle_tpu.distributed.launch` propagates `PADDLE_TPU_DEBUG_DUMP` with
 a per-rank subdirectory and sets `PADDLE_TPU_SIGQUIT_STACKS=1` so a
@@ -290,6 +293,24 @@ def dump(reason="manual", exc=None, base_dir=None):
                             {"records": recs,
                              "by_tag": _obs.aggregate(recs)})
                 manifest["compile_records"] = len(recs)
+        except Exception:
+            pass
+
+        # the serving observatory: recent terminal request records +
+        # per-engine admission/pool state — a hung serving loop names
+        # the requests in flight (docs/SERVING.md)
+        try:
+            from . import serve_observatory as _serve
+            tail = _serve.requests_tail()
+            if tail:
+                with open(os.path.join(d, "requests_tail.jsonl"),
+                          "w") as f:
+                    for rec in tail:
+                        f.write(json.dumps(rec, default=str) + "\n")
+                manifest["request_records"] = len(tail)
+            payload = _serve.debug_payload()
+            if payload.get("engines") or tail:
+                _write_json(os.path.join(d, "serve_state.json"), payload)
         except Exception:
             pass
 

@@ -13,13 +13,22 @@ the device by the prefetch ring), `prefetch.depth` (gauge — ring fill
 level; pinned at 0 means the step loop is data-bound).
 
 Serving signals (the continuous-batching engines, docs/SERVING.md):
-`serve.queue_depth` gauge, `serve.batch_size` / `serve.latency_s` /
-`serve.ttft_s` histograms, `serve.requests` / `serve.rejected` /
+`serve.queue_depth` / `serve.shared_pages` / `serve.kv_free_pages` /
+`serve.kv_held_pages` / `serve.kv_registered_pages` /
+`serve.kv_evictable_pages` / `serve.kv_peak_held_pages` gauges,
+`serve.batch_size` / `serve.latency_s` / `serve.ttft_s` /
+`serve.tpot_s` histograms, `serve.requests` / `serve.rejected` /
 `serve.expired` / `serve.pad_tokens` / `serve.retraces` /
-`serve.errors` counters.
+`serve.errors` / `serve.prefix_hits` / `serve.chunked_prefill_tokens` /
+`serve.generated_tokens` / `serve.goodput_tokens` /
+`serve.wasted_tokens` counters (the kv_*/goodput split is maintained by
+profiler/serve_observatory.py, which also emits the per-request
+`kind:"request"` and page-pool `kind:"kvcache"` records).
 Histograms keep a bounded reservoir of recent observations, so tail
 latency is queryable in-process: `histogram("serve.latency_s")
-.percentile(99)`.
+.percentile(99)` — and `snapshot()` carries `p50`/`p99` from the same
+reservoir, so `metrics_snapshot()` and `load_report()` serialize tail
+latency without callers reaching into `percentile()`.
 
 Registry usage:
 
@@ -124,22 +133,38 @@ class Histogram:
     def avg(self):
         return self.sum / self.count if self.count else 0.0
 
-    def percentile(self, p):
-        """Nearest-rank percentile (p in [0, 100]) over the reservoir of
-        the last RESERVOIR observations — a recent window, not all-time
-        (all-time min/max/avg stay exact in the streaming fields)."""
-        with _lock:
-            s = sorted(self._samples)
+    @staticmethod
+    def _nearest_rank(s, p):
+        """Nearest-rank pick from an already-sorted sample list."""
         if not s:
             return 0.0
         idx = min(len(s) - 1,
                   max(0, int(round(float(p) / 100.0 * (len(s) - 1)))))
         return s[idx]
 
+    def percentile(self, p):
+        """Nearest-rank percentile (p in [0, 100]) over the reservoir of
+        the last RESERVOIR observations — a recent window, not all-time
+        (all-time min/max/avg stay exact in the streaming fields)."""
+        with _lock:
+            s = sorted(self._samples)
+        return self._nearest_rank(s, p)
+
     def snapshot(self):
-        return {"count": self.count, "sum": self.sum, "avg": self.avg,
-                "min": self.min if self.count else 0.0, "max": self.max,
-                "last": self.last}
+        # p50/p99 ride along (reservoir window, like percentile()): the
+        # serialized forms — metrics_snapshot, host_stats.json, serving
+        # load_report — carry tail latency without a percentile() call.
+        # ONE sort serves both ranks (metrics_snapshot walks every
+        # histogram under the registry lock)
+        with _lock:
+            s = sorted(self._samples)
+            snap = {"count": self.count, "sum": self.sum,
+                    "avg": self.avg,
+                    "min": self.min if self.count else 0.0,
+                    "max": self.max, "last": self.last}
+        snap["p50"] = self._nearest_rank(s, 50)
+        snap["p99"] = self._nearest_rank(s, 99)
+        return snap
 
 
 def _get_or_create(name, cls):

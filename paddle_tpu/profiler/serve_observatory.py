@@ -1,0 +1,342 @@
+"""The serving observatory: per-request lifecycle tracing, KV page-pool
+telemetry, and SLO/goodput accounting for the continuous-batching
+engines (`paddle_tpu/inference/serving.py`).
+
+Sibling of `compile_observatory.py`, built for the same reason at a
+different layer: the serving engines (PR 4/8) are the path to the
+"millions of users" north star, and the disaggregated multi-engine
+router (ROADMAP open item 3) cannot be built on process-global
+aggregates alone. Three pieces:
+
+- **Per-request lifecycle ledger** — every request submitted to either
+  engine gets an id and a `RequestTrace` accumulating
+  submit/admit/first-token/terminal timestamps, token counts
+  (prompt / prefix-hit / generated), prefill-chunk count, peak KV pages
+  held, and the outcome. ONE `kind:"request"` record is emitted at the
+  terminal state (ringed in the flight recorder always, JSONL when
+  `PADDLE_TPU_METRICS_FILE` is set) — per-request aggregation, never
+  per-token records, and every trace method is pure host arithmetic
+  (no device reads: the module is fenced whole by
+  tools/check_no_hot_sync.py).
+
+  Outcomes: ``completed`` (result delivered), ``expired`` (deadline
+  passed before admission), ``rejected`` (queue-full / stopped-engine
+  fast fail at submit), ``cancelled`` (caller cancel, or work shed by
+  `shutdown(wait=False)`), ``error`` (failed onto the future).
+
+- **KV page-pool telemetry** — `record_pool_stats(engine, cache)`
+  turns `PagedKVCache.pool_stats()` into a periodic `kind:"kvcache"`
+  snapshot (free/held/shared/registered/drawn pages, refcount
+  histogram, prefix-registry size, copy-on-write and LRU-reclaim
+  counters) plus `serve.kv_*` gauges, emitted from the engine loop
+  every `kv_snapshot_every` steps.
+
+- **SLO/goodput accounting** — deadline attainment by outcome
+  (`slo_report()`), `serve.goodput_tokens` (tokens generated for
+  requests that completed) vs `serve.wasted_tokens` (tokens generated
+  for requests that later expired / were cancelled / errored), and
+  `serve.tpot_s` (time per output token, decode phase) feeding
+  `GenerationEngine.load_report()`'s tail percentiles — the admission
+  snapshot a load-aware router will consume.
+
+Debug bundles (`flight_recorder.dump`) pull `requests_tail()` (the ring
+of recent terminal request records -> `requests_tail.jsonl`) and
+`debug_payload()` (per-registered-engine `load_report` + `pool_stats`
+-> `serve_state.json`), so a hung serving loop names the requests in
+flight. See docs/SERVING.md "The serving observatory".
+"""
+import collections
+import itertools
+import threading
+import time
+import weakref
+
+from . import monitor as _monitor
+
+__all__ = ["RequestTrace", "start_request", "record_pool_stats",
+           "register_engine", "requests_tail", "slo_report",
+           "debug_payload", "reset", "OUTCOMES", "REQUEST_RING"]
+
+OUTCOMES = ("completed", "expired", "rejected", "error", "cancelled")
+
+REQUEST_RING = 512  # terminal request records kept for bundle tails
+
+_lock = threading.RLock()
+_ids = itertools.count()
+_requests = collections.deque(maxlen=REQUEST_RING)
+_outcomes = collections.Counter()
+# deadline-carrying requests only: outcome -> [met, total]
+_deadline_by_outcome = {}
+_engines = collections.OrderedDict()  # name -> weakref(engine)
+MAX_ENGINES = 16
+
+
+class RequestTrace:
+    """One request's lifecycle accumulator. Created at submit
+    (`start_request`), mutated by the engine as the request moves
+    through admit / prefill / decode, closed exactly once by
+    `finish(outcome)` — which emits the `kind:"request"` record and
+    folds the request into the SLO/goodput aggregates. Every method is
+    a few host float/int ops; `finish` additionally does the (ring +
+    optional JSONL) export."""
+
+    __slots__ = ("request_id", "engine", "rows", "prompt_tokens",
+                 "max_new_tokens", "deadline_s", "prefix_hit_tokens",
+                 "generated_tokens", "prefill_chunks", "peak_pages_held",
+                 "t_submit", "t_admit", "t_first", "done")
+
+    def __init__(self, engine, rows=1, prompt_tokens=0,
+                 max_new_tokens=None, deadline_s=None):
+        self.request_id = f"{engine}-r{next(_ids)}"
+        self.engine = str(engine)
+        self.rows = int(rows)
+        self.prompt_tokens = int(prompt_tokens)
+        self.max_new_tokens = max_new_tokens
+        self.deadline_s = deadline_s
+        self.prefix_hit_tokens = 0
+        self.generated_tokens = 0
+        self.prefill_chunks = 0
+        self.peak_pages_held = 0
+        self.t_submit = time.perf_counter()
+        self.t_admit = None
+        self.t_first = None
+        self.done = False
+
+    # -- lifecycle marks (engine loop; pure host arithmetic) -----------
+    def admitted(self):
+        """The request left the queue (claimed by the scheduler)."""
+        if self.t_admit is None:
+            self.t_admit = time.perf_counter()
+
+    def first_token(self):
+        """First generated token streamed (TTFT boundary)."""
+        if self.t_first is None:
+            self.t_first = time.perf_counter()
+
+    def note_prefix(self, n_tokens):
+        """Prompt tokens served from the refcounted prefix cache."""
+        self.prefix_hit_tokens += int(n_tokens)
+
+    def note_chunk(self):
+        """One prefill chunk of this request's prompt dispatched."""
+        self.prefill_chunks += 1
+
+    def note_token(self, pages_held=0):
+        """One token generated; `pages_held` updates the peak."""
+        self.generated_tokens += 1
+        if pages_held > self.peak_pages_held:
+            self.peak_pages_held = int(pages_held)
+
+    # -- terminal state -------------------------------------------------
+    def finish(self, outcome, error=None):
+        """Close the trace: emit the ONE `kind:"request"` record and
+        update the SLO/goodput aggregates. Idempotent (the first call
+        wins — engine teardown paths may race a completion) and never
+        raises. Returns the record (None on the duplicate call)."""
+        with _lock:
+            if self.done:
+                return None
+            self.done = True
+        try:
+            return self._emit(outcome, error)
+        except Exception:
+            return None  # telemetry must never take down the engine
+
+    def _emit(self, outcome, error):
+        outcome = str(outcome)
+        t_end = time.perf_counter()
+        latency = max(t_end - self.t_submit, 0.0)
+        admit = self.t_admit if self.t_admit is not None else t_end
+        queue_s = max(min(admit, t_end) - self.t_submit, 0.0)
+        prefill_s = decode_s = 0.0
+        if self.t_first is not None:
+            if self.t_admit is not None:
+                prefill_s = max(self.t_first - admit, 0.0)
+            decode_s = max(t_end - self.t_first, 0.0)
+        elif self.t_admit is not None:
+            # admitted but no token ever streamed: the post-queue time
+            # is all prefill (e.g. errored/cancelled mid-prefill)
+            prefill_s = max(t_end - admit, 0.0)
+        # full exported shape (ts/rank/kind included): the ring copy in
+        # requests_tail.jsonl must validate standalone, not only the
+        # JSONL line export_step re-stamps
+        rec = {
+            "ts": time.time(),
+            "rank": _monitor.rank(),
+            "kind": "request",
+            "engine": self.engine,
+            "request_id": self.request_id,
+            "outcome": outcome,
+            "rows": self.rows,
+            "prompt_tokens": self.prompt_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "generated_tokens": self.generated_tokens,
+            "prefill_chunks": self.prefill_chunks,
+            "peak_pages_held": self.peak_pages_held,
+            "queue_s": round(queue_s, 6),
+            "prefill_s": round(prefill_s, 6),
+            "decode_s": round(decode_s, 6),
+            "latency_s": round(latency, 6),
+        }
+        if self.max_new_tokens is not None:
+            rec["max_new_tokens"] = int(self.max_new_tokens)
+        met = None
+        if self.deadline_s is not None:
+            met = outcome == "completed" and latency <= self.deadline_s
+            rec["deadline_s"] = round(self.deadline_s, 6)
+            rec["deadline_met"] = bool(met)
+        if error:
+            rec["error"] = str(error)[:300]
+        # SLO/goodput aggregates
+        with _lock:
+            _outcomes[outcome] += 1
+            if met is not None:
+                bucket = _deadline_by_outcome.setdefault(outcome, [0, 0])
+                bucket[0] += 1 if met else 0
+                bucket[1] += 1
+        gen = self.generated_tokens
+        if gen:
+            if outcome == "completed":
+                _monitor.counter("serve.goodput_tokens").inc(gen)
+            else:
+                # generated for a request nobody will use the output of
+                _monitor.counter("serve.wasted_tokens").inc(gen)
+        if outcome == "completed" and gen >= 2 and self.t_first is not None:
+            _monitor.histogram("serve.tpot_s").observe(
+                decode_s / (gen - 1))
+        _monitor.export_step(rec, kind="request")
+        with _lock:
+            _requests.append(rec)
+        return rec
+
+
+def start_request(engine, rows=1, prompt_tokens=0, max_new_tokens=None,
+                  deadline_s=None):
+    """New RequestTrace for one submitted request (both engines call
+    this from submit, after validation — caller-bug ValueErrors produce
+    no record, queue-full rejections do)."""
+    return RequestTrace(engine, rows=rows, prompt_tokens=prompt_tokens,
+                        max_new_tokens=max_new_tokens,
+                        deadline_s=deadline_s)
+
+
+# -- KV page-pool telemetry ----------------------------------------------
+
+def record_pool_stats(engine, cache, extra=None):
+    """One `kind:"kvcache"` snapshot of a PagedKVCache's pool state
+    (`cache.pool_stats()`: free/held/shared/registered/drawn pages,
+    refcount histogram, prefix-registry size, CoW/reclaim counters) +
+    the `serve.kv_*` gauges. Called periodically from the engine loop —
+    pure host-side dict math, never raises. Returns the record."""
+    try:
+        stats = cache.pool_stats()
+        rec = {"engine": str(engine)}
+        rec.update(stats)
+        if extra:
+            rec.update(extra)
+        held = int(stats.get("held_pages", 0))
+        _monitor.gauge("serve.kv_free_pages").set(
+            int(stats.get("free_pages", 0)))
+        _monitor.gauge("serve.kv_held_pages").set(held)
+        _monitor.gauge("serve.kv_registered_pages").set(
+            int(stats.get("registered_pages", 0)))
+        _monitor.gauge("serve.kv_evictable_pages").set(
+            int(stats.get("evictable_pages", 0)))
+        peak = _monitor.gauge("serve.kv_peak_held_pages")
+        if held > peak.value:
+            peak.set(held)
+        _monitor.export_step(rec, kind="kvcache")
+        return rec
+    except Exception:
+        return None
+
+
+# -- engine registry (debug bundles) -------------------------------------
+
+def register_engine(engine):
+    """Remember a live engine (weakref — an abandoned engine stays
+    collectible) so debug bundles can snapshot its `load_report()` /
+    pool state. Bounded; oldest forgotten."""
+    try:
+        name = str(getattr(engine, "name", "serve"))
+        with _lock:
+            _engines.pop(name, None)
+            _engines[name] = weakref.ref(engine)
+            while len(_engines) > MAX_ENGINES:
+                _engines.popitem(last=False)
+    except Exception:
+        pass
+
+
+def live_engines():
+    """[(name, engine)] for the registered engines still alive."""
+    out = []
+    with _lock:
+        items = list(_engines.items())
+    for name, ref in items:
+        eng = ref()
+        if eng is not None:
+            out.append((name, eng))
+    return out
+
+
+# -- aggregates / bundle payloads ----------------------------------------
+
+def requests_tail():
+    """The ring of recent terminal `kind:"request"` records (oldest
+    first) — what a debug bundle writes as requests_tail.jsonl."""
+    with _lock:
+        return [dict(r) for r in _requests]
+
+
+def slo_report():
+    """Deadline attainment by outcome + the goodput/wasted token split:
+    {"requests", "outcomes": {outcome: n}, "deadline": {"requests",
+    "met", "attainment"}, "deadline_by_outcome": {outcome: {met,
+    total}}, "goodput_tokens", "wasted_tokens"}. `attainment` is None
+    until a deadline-carrying request finishes."""
+    with _lock:
+        outcomes = dict(_outcomes)
+        by_outcome = {k: {"met": v[0], "total": v[1]}
+                      for k, v in _deadline_by_outcome.items()}
+    met = sum(v["met"] for v in by_outcome.values())
+    total = sum(v["total"] for v in by_outcome.values())
+    good = _monitor.get_metric("serve.goodput_tokens")
+    waste = _monitor.get_metric("serve.wasted_tokens")
+    return {
+        "requests": sum(outcomes.values()),
+        "outcomes": outcomes,
+        "deadline": {"requests": total, "met": met,
+                     "attainment": (met / total) if total else None},
+        "deadline_by_outcome": by_outcome,
+        "goodput_tokens": int(good.value) if good else 0,
+        "wasted_tokens": int(waste.value) if waste else 0,
+    }
+
+
+def debug_payload():
+    """Per-registered-engine state for a debug bundle: each live
+    engine's `observatory_snapshot()` (load_report + pool_stats) plus
+    the SLO aggregate. Never raises; engines that refuse to snapshot
+    are reported by error string instead."""
+    engines = {}
+    for name, eng in live_engines():
+        try:
+            snap = eng.observatory_snapshot()
+        except Exception as e:  # a wedged engine must not kill the dump
+            snap = {"error": f"{type(e).__name__}: {e}"[:200]}
+        engines[name] = snap
+    try:
+        slo = slo_report()
+    except Exception:
+        slo = {}
+    return {"engines": engines, "slo": slo}
+
+
+def reset():
+    """Drop request ring + SLO aggregates (tests). The engine registry
+    persists (it self-cleans via weakrefs)."""
+    with _lock:
+        _requests.clear()
+        _outcomes.clear()
+        _deadline_by_outcome.clear()

@@ -19,8 +19,14 @@ Track layout (what you see in Perfetto):
   serve-dispatch, prefetch producer, ...), duration events from spans;
 - synthetic tracks "train steps" / "serve batches" rendering the
   exported step/serve records with their metadata as args;
+- "serving requests" lanes: one slice per request LIFETIME (submit ->
+  terminal, labelled engine/id/outcome, the full `kind:"request"`
+  record in its args) with queued/prefill/decode phase sub-slices
+  nested inside; concurrent requests spread over a small fixed set of
+  lanes so overlapping lifetimes stay readable;
 - a counter track per metric (queue depth, prefetch depth, device
-  memory, host.blocked_s, ...);
+  memory, host.blocked_s, ...) plus `kv.<engine>.*` page-pool tracks
+  from `kind:"kvcache"` snapshots;
 - instant markers for `kind:"event"` anomalies (NaN, loss spike,
   watchdog, ...).
 
@@ -36,14 +42,17 @@ from . import flight_recorder
 from . import monitor
 
 __all__ = ["chrome_trace_events", "write_chrome_trace",
-           "TRAIN_TID", "SERVE_TID", "EVENT_TID", "COMPILE_TID"]
+           "TRAIN_TID", "SERVE_TID", "EVENT_TID", "COMPILE_TID",
+           "REQUEST_TID", "REQUEST_LANES"]
 
 # synthetic track ids for record-derived events; real thread idents are
-# pointer-sized on linux, so single digits can never collide with them
+# pointer-sized on linux, so small ints can never collide with them
 TRAIN_TID = 1
 SERVE_TID = 2
 EVENT_TID = 3
 COMPILE_TID = 4
+REQUEST_TID = 5     # first "serving requests" lane
+REQUEST_LANES = 12  # concurrent-request lanes before reuse
 
 
 def _sanitize(obj):
@@ -107,6 +116,7 @@ def chrome_trace_events(snap=None, rank=None):
 
     # exported records -> synthetic tracks; the record itself rides in
     # args so a slice click shows step/compile/mfu or batch/pad/latency
+    request_recs = []  # (start_s, latency_s, record): laned below
     for rec in snap.get("records", ()):
         kind = rec.get("kind")
         ts = float(rec.get("ts", 0.0))
@@ -148,6 +158,27 @@ def chrome_trace_events(snap=None, rank=None):
                 "name": f"compile {tag}", "ph": "X", "cat": "compile",
                 "ts": (ts - comp) * 1e6, "dur": comp * 1e6,
                 "pid": pid, "tid": COMPILE_TID, "args": _sanitize(rec)})
+        elif kind == "request":
+            # one slice per request LIFETIME, reconstructed backwards
+            # from the terminal record's stamp; laned after the loop so
+            # overlapping lifetimes don't render as bogus nesting
+            lat = rec.get("latency_s", 0.0)
+            if isinstance(lat, (int, float)) and not isinstance(lat, bool):
+                lat = max(float(lat), 0.0)
+                request_recs.append((ts - lat, lat, rec))
+        elif kind == "kvcache":
+            # page-pool counter tracks, per engine (two engines' pools
+            # must not interleave into one series)
+            eng = rec.get("engine", "serve")
+            for key in ("free_pages", "held_pages", "shared_pages",
+                        "registered_pages", "evictable_pages"):
+                v = rec.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    events.append({
+                        "name": f"kv.{eng}.{key}", "ph": "C",
+                        "cat": "kvcache", "ts": ts * 1e6, "pid": pid,
+                        "tid": 0, "args": {"value": _sanitize(v)}})
         elif kind == "health":
             for key in ("grad_norm", "param_norm", "update_ratio",
                         "loss"):
@@ -157,6 +188,55 @@ def chrome_trace_events(snap=None, rank=None):
                         "name": f"health.{key}", "ph": "C",
                         "cat": "health", "ts": ts * 1e6, "pid": pid,
                         "tid": 0, "args": {"value": _sanitize(v)}})
+
+    # "serving requests" lanes: greedy interval partitioning — a
+    # request takes the first lane free at its start, so concurrent
+    # lifetimes land on different tids and phase sub-slices (queued ->
+    # prefill -> decode) nest INSIDE their own request only
+    lane_busy_until = []
+    used_lanes = set()
+    for start, lat, rec in sorted(request_recs, key=lambda r: r[0]):
+        lane = next((i for i, end in enumerate(lane_busy_until)
+                     if start >= end), None)
+        if lane is None:
+            if len(lane_busy_until) < REQUEST_LANES:
+                lane = len(lane_busy_until)
+                lane_busy_until.append(0.0)
+            else:  # saturated: least-recently-busy lane (readability
+                # degrades gracefully, nothing is dropped)
+                lane = min(range(len(lane_busy_until)),
+                           key=lambda i: lane_busy_until[i])
+        # max(): a short request reusing a saturated lane must not
+        # rewind its busy-until past a longer resident slice, or later
+        # requests would stack on top of it
+        lane_busy_until[lane] = max(lane_busy_until[lane], start + lat)
+        tid = REQUEST_TID + lane
+        used_lanes.add(lane)
+        name = (f"{rec.get('engine', 'serve')} "
+                f"{rec.get('request_id', '?')} "
+                f"[{rec.get('outcome', '?')}]")
+        events.append({
+            "name": name, "ph": "X", "cat": "request",
+            "ts": start * 1e6, "dur": lat * 1e6,
+            "pid": pid, "tid": tid, "args": _sanitize(rec)})
+        t = start
+        for phase, key in (("queued", "queue_s"),
+                           ("prefill", "prefill_s"),
+                           ("decode", "decode_s")):
+            d = rec.get(key)
+            if isinstance(d, (int, float)) and not isinstance(d, bool) \
+                    and d > 0:
+                events.append({
+                    "name": phase, "ph": "X", "cat": "request",
+                    "ts": t * 1e6, "dur": max(float(d), 0.0) * 1e6,
+                    "pid": pid, "tid": tid, "args": {}})
+                t += d
+    for lane in sorted(used_lanes):
+        meta.append({
+            "ph": "M", "name": "thread_name", "pid": pid,
+            "tid": REQUEST_TID + lane, "ts": 0,
+            "args": {"name": "serving requests" if lane == 0
+                     else f"serving requests ({lane})"}})
     # structured anomalies: the events ring is their ONE home —
     # record_event rings them here and exports the JSONL line itself
     # (monitor.export_step _ring=False), so the records ring never

@@ -267,6 +267,50 @@ def emit_workload():
             f"compiled {sorted(steady - warmed)} beyond the warmed set "
             f"(warm summary: {summary})")
 
+    # the serving observatory contract: every request submitted to
+    # either engine lands EXACTLY ONE schema-valid kind:"request"
+    # record whose token counts reconcile with the engine counters,
+    # and the generation engine snapshots its page pool
+    # (kind:"kvcache") — in the same tier-1-exercised ledger the
+    # compile gates read, so the lint sees real instances
+    import json as _json
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import check_metrics_schema as _cms
+    from paddle_tpu.profiler import monitor as _pmon
+    mfile = os.environ["PADDLE_TPU_METRICS_FILE"]
+    reqs = _load_kind(mfile, "request")
+    kvs = _load_kind(mfile, "kvcache")
+    schema_errs = [e for r in reqs + kvs
+                   for e in _cms.validate_line(_json.dumps(r))]
+    if schema_errs:
+        raise AssertionError(
+            f"serving observatory records violate the schema: "
+            f"{schema_errs[:5]}")
+    by_engine = {}
+    for r in reqs:
+        by_engine.setdefault(r["engine"], []).append(r)
+    if sorted(by_engine) != ["canonical", "canonical_gen"] or \
+            any(len(v) != 1 for v in by_engine.values()):
+        raise AssertionError(
+            "expected exactly one request record per submitted request "
+            f"(one per engine), got {[(k, len(v)) for k, v in sorted(by_engine.items())]}")
+    if any(r["outcome"] != "completed" for r in reqs):
+        raise AssertionError(
+            f"canonical requests must complete, got "
+            f"{[(r['engine'], r['outcome']) for r in reqs]}")
+    gen_total = _pmon.get_metric("serve.generated_tokens")
+    gen_total = int(gen_total.value) if gen_total else 0
+    rec_total = sum(r["generated_tokens"] for r in reqs)
+    if rec_total != gen_total or rec_total != 3:  # max_new_tokens=3
+        raise AssertionError(
+            "request-record token counts do not reconcile with the "
+            f"engine counters: records {rec_total}, "
+            f"serve.generated_tokens {gen_total}, expected 3")
+    if not kvs or any(r["engine"] != "canonical_gen" for r in kvs):
+        raise AssertionError(
+            f"expected kind:'kvcache' snapshots from canonical_gen, "
+            f"got {[(r.get('engine'), r.get('kind')) for r in kvs][:5]}")
+
 
 def format_row(tag, parts):
     return f"  {tag:<28} " + "  ".join(parts)

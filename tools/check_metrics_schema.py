@@ -33,6 +33,11 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        step's wall time)
   kind == "serve" (one record per dispatched serving batch —
                   paddle_tpu/inference/serving.py) additionally requires:
+                  engine       str     emitting engine's name (non-empty;
+                                       the per-engine key that keeps
+                                       multi-engine JSONL attributable —
+                                       bench.py --serve runs several
+                                       engines in one process)
                   requests     int     requests fused into the batch (>= 1)
                   batch_size   int     real rows dispatched (>= 1)
                   bucket_batch int     ladder bucket the batch padded to
@@ -44,9 +49,6 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        decode batches: mean in-flight
                                        request age at the step)
                   and optionally:
-                  engine       str     emitting engine's name (non-empty;
-                                       the per-engine key that keeps
-                                       multi-engine JSONL attributable)
                   pad_token_fraction number  in [0, 1] — measured
                                        fraction of the step's attention
                                        score slots outside any causal
@@ -116,6 +118,53 @@ Schema (documented in docs/OBSERVABILITY.md):
                   cache_dir       str  seeded cache dir (non-empty)
                   entries_seeded  int  entries copied in (>= 0)
                   entries_skipped int  already present (>= 0)
+  kind == "request" (ONE record per request at its terminal state —
+                  the serving observatory's lifecycle ledger,
+                  profiler/serve_observatory.py) additionally requires:
+                  engine       str     emitting engine (non-empty)
+                  request_id   str     unique per request (non-empty)
+                  outcome      str     completed | expired | rejected |
+                                       error | cancelled
+                  rows         int     batch rows (>= 1; generation: 1)
+                  prompt_tokens int    >= 0 (inference requests: 0)
+                  prefix_hit_tokens int  >= 0, <= prompt_tokens
+                  generated_tokens int >= 0; MUST be 0 for outcome
+                                       rejected/expired (those die
+                                       before decoding — nonzero means
+                                       the accounting lies)
+                  queue_s      number  submit -> claimed (>= 0)
+                  latency_s    number  submit -> terminal (>= 0, and
+                                       >= queue_s + prefill_s +
+                                       decode_s up to rounding)
+                  and optionally:
+                  prefill_s / decode_s number >= 0 phase splits
+                  prefill_chunks int   >= 0 chunked-prefill steps
+                  peak_pages_held int  >= 0 KV pages high-water mark
+                  max_new_tokens int   >= 1; generated_tokens <= it
+                  deadline_s   number  >= 0 allotted budget (seconds;
+                                       0 = already expired at submit)
+                  deadline_met bool    completed within deadline_s
+                  error        str     exception repr (outcome error)
+  kind == "kvcache" (periodic KV page-pool snapshot —
+                  PagedKVCache.pool_stats via serve_observatory)
+                  additionally requires:
+                  engine       str     emitting engine (non-empty)
+                  n_pages      int     pool size (>= 1)
+                  free_pages   int     >= 0
+                  held_pages   int     >= 0 pages with >= 1 holder;
+                                       free + held <= n_pages (page 0
+                                       is the reserved pad page)
+                  shared_pages int     >= 0, <= held_pages
+                  registered_pages int >= 0, <= held_pages (prefix
+                                       registry holds)
+                  pages_drawn  int     >= 0 cumulative pool draws
+                  cow_copies   int     >= 0 cumulative copy-on-writes
+                  lru_reclaims int     >= 0 cumulative registry evicts
+                  and optionally:
+                  evictable_pages int  >= 0, <= registered_pages
+                  refcounts    dict    {refcount: n_pages >= 0}
+                  page_size / prefix_nodes / sequences / queue_depth /
+                  active       int     >= 0 (page_size >= 1)
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
@@ -138,9 +187,9 @@ STEP_REQUIRED = {"step": int, "step_time_s": (int, float),
                  "compile_s": (int, float), "cache_hit": bool,
                  "peak_bytes": int, "flops": (int, float),
                  "mfu": (int, float)}
-SERVE_REQUIRED = {"requests": int, "batch_size": int, "bucket_batch": int,
-                  "queue_depth": int, "pad_tokens": int,
-                  "latency_s": (int, float)}
+SERVE_REQUIRED = {"engine": str, "requests": int, "batch_size": int,
+                  "bucket_batch": int, "queue_depth": int,
+                  "pad_tokens": int, "latency_s": (int, float)}
 HEALTH_REQUIRED = {"step": int, "loss": (int, float, str),
                    "grad_norm": (int, float, str),
                    "param_norm": (int, float, str),
@@ -158,11 +207,34 @@ WARM_REQUIRED = {"n_executables": int, "compiled_now": int,
                  "sum_s": (int, float)}
 SEED_REQUIRED = {"source": str, "cache_dir": str, "entries_seeded": int,
                  "entries_skipped": int}
+REQUEST_REQUIRED = {"engine": str, "request_id": str, "outcome": str,
+                    "rows": int, "prompt_tokens": int,
+                    "prefix_hit_tokens": int, "generated_tokens": int,
+                    "queue_s": (int, float), "latency_s": (int, float)}
+REQUEST_OUTCOMES = {"completed", "expired", "rejected", "error",
+                    "cancelled"}
+KVCACHE_REQUIRED = {"engine": str, "n_pages": int, "free_pages": int,
+                    "held_pages": int, "shared_pages": int,
+                    "registered_pages": int, "pages_drawn": int,
+                    "cow_copies": int, "lru_reclaims": int}
 # a persistent-cache HIT deserializes an artifact instead of compiling;
 # spending more than this on one is a mislabeled cold compile
 CACHE_HIT_COMPILE_S_MAX = 10.0
 # repr strings a non-finite health scalar may export as
 _NONFINITE_STRS = {"nan", "inf", "-inf"}
+
+
+def _int_val(rec, key):
+    """rec[key] as an int (bools excluded), else None."""
+    v = rec.get(key)
+    return v if isinstance(v, int) and not isinstance(v, bool) else None
+
+
+def _num_val(rec, key):
+    """rec[key] as a number (bools excluded), else None."""
+    v = rec.get(key)
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
 
 
 def _check_types(rec, required, where, errors):
@@ -211,11 +283,10 @@ def validate_line(line, where="<line>"):
                     f"[0, 1], got {v!r}")
     elif rec.get("kind") == "serve":
         _check_types(rec, SERVE_REQUIRED, where, errors)
-        # engine (the emitting engine's name) is optional for forward
-        # compat, but when present it must be a non-empty string —
-        # it is the only key that keeps multi-engine JSONL attributable
-        if "engine" in rec and (not isinstance(rec["engine"], str)
-                                or not rec["engine"]):
+        # engine is REQUIRED and non-empty: it is the only key that
+        # keeps multi-engine JSONL attributable (bench.py --serve runs
+        # both engine paths in one process)
+        if isinstance(rec.get("engine"), str) and not rec["engine"]:
             errors.append(
                 f"{where}: engine must be a non-empty string, "
                 f"got {rec['engine']!r}")
@@ -352,6 +423,124 @@ def validate_line(line, where="<line>"):
                     not isinstance(t, str) or not t for t in tags):
                 errors.append(f"{where}: tags must be a list of "
                               f"non-empty strings, got {tags!r}")
+    elif rec.get("kind") == "request":
+        _check_types(rec, REQUEST_REQUIRED, where, errors)
+
+        def _rint(key):
+            return _int_val(rec, key)
+
+        def _rnum(key):
+            return _num_val(rec, key)
+
+        for key in ("engine", "request_id"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+        outcome = rec.get("outcome")
+        if isinstance(outcome, str) and outcome not in REQUEST_OUTCOMES:
+            errors.append(
+                f"{where}: outcome {outcome!r} not one of "
+                f"{sorted(REQUEST_OUTCOMES)}")
+        if _rint("rows") is not None and rec["rows"] < 1:
+            errors.append(f"{where}: rows must be >= 1, got "
+                          f"{rec['rows']}")
+        for key in ("prompt_tokens", "prefix_hit_tokens",
+                    "generated_tokens", "prefill_chunks",
+                    "peak_pages_held"):
+            v = _rint(key) if key in rec else None
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        for key in ("queue_s", "prefill_s", "decode_s", "latency_s",
+                    "deadline_s"):
+            v = _rnum(key) if key in rec else None
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        # cross-field: token counts must be consistent with the outcome
+        hit, prompt = _rint("prefix_hit_tokens"), _rint("prompt_tokens")
+        if hit is not None and prompt is not None and hit > prompt:
+            errors.append(
+                f"{where}: prefix_hit_tokens {hit} > prompt_tokens "
+                f"{prompt} — the cache cannot serve tokens the prompt "
+                "does not have")
+        gen = _rint("generated_tokens")
+        if gen is not None and outcome in ("rejected", "expired") \
+                and gen != 0:
+            errors.append(
+                f"{where}: outcome {outcome!r} with generated_tokens "
+                f"{gen} — a request that died before admission cannot "
+                "have decoded")
+        mx = _rint("max_new_tokens") if "max_new_tokens" in rec else None
+        if mx is not None:
+            if mx < 1:
+                errors.append(
+                    f"{where}: max_new_tokens must be >= 1, got {mx}")
+            elif gen is not None and gen > mx:
+                errors.append(
+                    f"{where}: generated_tokens {gen} > max_new_tokens "
+                    f"{mx}")
+        lat = _rnum("latency_s")
+        phases = [_rnum(k) for k in ("queue_s", "prefill_s", "decode_s")
+                  if k in rec]
+        if lat is not None and all(p is not None for p in phases) and \
+                sum(phases) > lat + 1e-3:
+            errors.append(
+                f"{where}: phase seconds {sum(phases):.6f} exceed "
+                f"latency_s {lat} — the lifecycle clock math is broken")
+        if "deadline_met" in rec and not isinstance(
+                rec["deadline_met"], bool):
+            errors.append(
+                f"{where}: deadline_met must be bool, got "
+                f"{rec['deadline_met']!r}")
+    elif rec.get("kind") == "kvcache":
+        _check_types(rec, KVCACHE_REQUIRED, where, errors)
+
+        def _kint(key):
+            return _int_val(rec, key)
+
+        if isinstance(rec.get("engine"), str) and not rec["engine"]:
+            errors.append(f"{where}: engine must be non-empty")
+        if _kint("n_pages") is not None and rec["n_pages"] < 1:
+            errors.append(
+                f"{where}: n_pages must be >= 1, got {rec['n_pages']}")
+        for key in ("free_pages", "held_pages", "shared_pages",
+                    "registered_pages", "pages_drawn", "cow_copies",
+                    "lru_reclaims", "evictable_pages", "page_size",
+                    "prefix_nodes", "sequences", "queue_depth",
+                    "active"):
+            v = _kint(key) if key in rec else None
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        n, free, held = _kint("n_pages"), _kint("free_pages"), \
+            _kint("held_pages")
+        if n is not None and free is not None and held is not None \
+                and free + held > n:
+            errors.append(
+                f"{where}: free_pages {free} + held_pages {held} > "
+                f"n_pages {n} — pages are being double-counted")
+        for key in ("shared_pages", "registered_pages"):
+            v = _kint(key)
+            if v is not None and held is not None and v > held:
+                errors.append(
+                    f"{where}: {key} {v} > held_pages {held}")
+        ev = _kint("evictable_pages") if "evictable_pages" in rec \
+            else None
+        reg = _kint("registered_pages")
+        if ev is not None and reg is not None and ev > reg:
+            errors.append(
+                f"{where}: evictable_pages {ev} > registered_pages "
+                f"{reg} — only registry-held pages are evictable")
+        rc = rec.get("refcounts")
+        if rc is not None:
+            if not isinstance(rc, dict):
+                errors.append(f"{where}: refcounts must be a dict, got "
+                              f"{type(rc).__name__}")
+            else:
+                for k, v in rc.items():
+                    if not isinstance(k, str) or not isinstance(v, int) \
+                            or isinstance(v, bool) or v < 0:
+                        errors.append(
+                            f"{where}: refcounts entry {k!r}: {v!r} "
+                            "must be str -> int >= 0")
+                        break
     elif rec.get("kind") == "seed":
         _check_types(rec, SEED_REQUIRED, where, errors)
         for key in ("source", "cache_dir"):

@@ -53,11 +53,22 @@ HOT_REGIONS = {
         "_run_scheduler",
         "InferenceEngine._take_batch", "InferenceEngine._scan_matching",
         "InferenceEngine._loop_once", "InferenceEngine._dispatch_batch",
-        "InferenceEngine._resolve_batch",
+        "InferenceEngine._resolve_batch", "InferenceEngine._fail_batch",
+        "InferenceEngine._flush_expired", "InferenceEngine.load_report",
         "GenerationEngine._loop_once", "GenerationEngine._admit",
         "GenerationEngine._decode_step", "GenerationEngine._emit",
         "GenerationEngine._admit_ragged",
-        "GenerationEngine._ragged_step"],
+        "GenerationEngine._ragged_step",
+        "GenerationEngine._pop_doomed_head",
+        "GenerationEngine._close_doomed",
+        "GenerationEngine._note_kv_step", "GenerationEngine.load_report"],
+    # the serving observatory: request traces mutate on the scheduler
+    # hot loop and kvcache snapshots run per step — the whole module
+    # must stay pure host arithmetic (no device reads, ever)
+    "paddle_tpu/profiler/serve_observatory.py": ["*"],
+    # the pool snapshot is called from the decode loop: dict/len math
+    # only, never a device read of the page pools
+    "paddle_tpu/ops/paged_attention.py": ["PagedKVCache.pool_stats"],
 }
 
 PATTERNS = [
