@@ -258,6 +258,35 @@ def _compile_ledger_table():
         return {}
 
 
+def _timed_checkpoint(step_obj):
+    """One timed save of the bench model through the production
+    checkpoint path: returns {"ckpt_snapshot_s", "ckpt_write_s",
+    "ckpt_bytes", "ckpt_total_s"} from the save's kind:"ckpt" record,
+    or {} when checkpointing failed (never costs the bench record).
+    The checkpoint lands in a throwaway temp dir and is deleted."""
+    import shutil
+    d = None
+    try:
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+        d = tempfile.mkdtemp(prefix="bench_ckpt_")
+        mgr = CheckpointManager(d, keep_last=1)
+        handle = mgr.save(step_obj)
+        handle.result(300)
+        rec = handle.record
+        mgr.close()
+        return {"ckpt_snapshot_s": round(float(rec["snapshot_s"]), 4),
+                "ckpt_write_s": round(float(rec["write_s"]), 4),
+                "ckpt_bytes": int(rec["bytes"]),
+                "ckpt_total_s": round(float(rec["total_s"]), 4)}
+    except Exception as e:
+        print(f"bench: timed checkpoint unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return {}
+    finally:
+        if d:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def _peak_flops(jax_mod):
     """bf16 peak for the attached chip generation (MFU denominator) —
     the framework's single table (paddle_tpu/profiler/cost.py), with
@@ -515,6 +544,24 @@ def _run():
     if os.environ.get("BENCH_HOLD_AFTER_PRINT"):
         # test hook: prove the headline survives a kill after measurement
         time.sleep(float(os.environ["BENCH_HOLD_AFTER_PRINT"]))
+
+    # ---- checkpoint latency side metric (AFTER the headline line so a
+    # slow disk can never cost the throughput record): ONE timed
+    # snapshot-then-write save of the bench model through the real
+    # fault-tolerance path (distributed/checkpoint.py), phases from its
+    # kind:"ckpt" record, persisted into bench_state.json so
+    # checkpoint-latency regressions show up in the trajectory
+    ck = _timed_checkpoint(step)
+    if ck:
+        headline.update(ck)
+        state = _load_state()
+        hist = state.get("ckpt_history", [])
+        hist.append(dict(ck, recorded_utc=time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()), on_tpu=on_tpu,
+            n_params=n_params))
+        state["ckpt_history"] = hist[-10:]
+        _save_state(state)
+        print(json.dumps(headline), flush=True)
 
     # calibrate sustained matmul rate (the realistic MXU ceiling for this
     # chip/tunnel) with a 100-iter chained bf16 matmul, one scalar fetch.

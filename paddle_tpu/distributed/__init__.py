@@ -19,7 +19,9 @@ from .auto_parallel import shard_tensor, shard_op, ProcessMesh
 from . import meta_parallel
 from .fleet.utils.recompute import recompute
 from . import checkpoint
-from .checkpoint import save_sharded, load_sharded
+from .checkpoint import (save_sharded, load_sharded, CheckpointManager,
+                         AsyncSaveHandle)
+from .elastic import ElasticController
 from . import launch as launch_module
 
 

@@ -1,88 +1,122 @@
-"""Elastic / fault-tolerant training. Parity:
-python/paddle/distributed/elastic/ (+ fleet elastic agent).
+"""Elastic / fault-tolerant training controller.
 
+Parity: python/paddle/distributed/elastic/ (+ the fleet elastic agent).
 The reference's agent watches etcd for scale events and restarts ranks.
 TPU-native failure model: a preempted/evicted host kills the whole SPMD
-program; recovery = restart the job and resume from the latest sharded
-checkpoint. ElasticController packages that contract: periodic async
-checkpoints + automatic resume + a watchdog that detects a wedged device
-(no step progress) and raises for the scheduler to restart.
+program, so recovery = the scheduler relaunches the job and the job
+resumes from the newest COMMITTED checkpoint. `ElasticController`
+packages that contract on top of `distributed.checkpoint.
+CheckpointManager` (snapshot-then-write async saves, atomic commits,
+verified resume — docs/FAULT_TOLERANCE.md):
+
+    ctl = ElasticController(step, ckpt_dir, save_every_steps=500)
+    start = ctl.maybe_resume()          # newest VERIFIED checkpoint
+    ctl.start_watchdog()
+    for batch in loader[start:]:
+        loss = step(*batch)
+        ctl.on_step()                   # never blocks the step loop
+
+`on_step()` feeds the watchdog and, on the save cadence, snapshots the
+training state on device and hands it to the background writer — the
+step loop never waits on the previous write (the writer serializes
+queued saves itself; a still-busy writer SKIPS the new save rather
+than stacking snapshots). Step 0 is never saved (there is nothing to
+resume to that a fresh init doesn't give).
+
+The watchdog detects a wedged step (no `on_step()` progress within
+`watchdog_timeout_s`): it first dumps a full flight-recorder debug
+bundle — all-thread stacks, telemetry rings, registered HLO, and the
+checkpoint manager's `ckpt_state.json` — and only THEN raises SIGTERM
+for the scheduler to restart the process, so the hang is diagnosable
+post-mortem.
 """
 import os
+import signal
 import threading
 import time
+
+from .checkpoint import CheckpointManager
+from ..profiler import flight_recorder as _flight
+from ..profiler import monitor as _monitor
 
 __all__ = ["ElasticController"]
 
 
 class ElasticController:
     def __init__(self, train_step, ckpt_dir, save_every_steps=500,
-                 watchdog_timeout_s=1800):
+                 watchdog_timeout_s=1800, keep_last=3, keep_every=None):
         self.step_obj = train_step
         self.ckpt_dir = ckpt_dir
-        self.save_every = save_every_steps
+        self.save_every = max(1, int(save_every_steps))
         self.timeout = watchdog_timeout_s
+        self.manager = CheckpointManager(ckpt_dir, keep_last=keep_last,
+                                         keep_every=keep_every)
         self._last_progress = time.time()
+        self._last_saved = None
         self._watchdog = None
         self._stop = threading.Event()
-        self._async_handle = None
 
     # -- resume --------------------------------------------------------
     def maybe_resume(self):
-        """Restore the newest checkpoint if one exists; returns step."""
-        from .checkpoint import load_train_state
-        latest = self._latest()
-        if latest is not None:
-            load_train_state(self.step_obj, latest)
-            self._last_progress = time.time()
-            return self.step_obj._step_i
+        """Restore the newest VERIFIED checkpoint if one exists
+        (falling back past partial/corrupt ones); returns the resumed
+        step (0 when starting fresh)."""
+        restored = self.manager.restore(self.step_obj)
+        self._last_progress = time.time()
+        if restored is not None:
+            # resuming exactly onto a save boundary must not re-save it
+            self._last_saved = restored
+            return restored
         return 0
 
-    def _latest(self):
-        if not os.path.isdir(self.ckpt_dir):
-            return None
-        cands = [d for d in os.listdir(self.ckpt_dir)
-                 if d.startswith("step_")]
-        if not cands:
-            return None
-        best = max(cands, key=lambda d: int(d.split("_")[1]))
-        return os.path.join(self.ckpt_dir, best)
+    def latest(self):
+        """Path of the newest committed checkpoint, or None."""
+        return self.manager.latest()
 
-    # -- per-step hook -------------------------------------------------
+    # -- per-step hook (hot path: must never block) ---------------------
     def on_step(self):
-        """Call after each train step: checkpoints + feeds the watchdog."""
+        """Call after each train step: feeds the watchdog and saves on
+        the cadence. Non-blocking — the snapshot is an async on-device
+        copy and the write happens on the background writer thread; a
+        writer still busy with the previous checkpoint skips this save
+        instead of queueing snapshots."""
         self._last_progress = time.time()
-        s = self.step_obj._step_i
-        if s % self.save_every == 0:
-            self._save(s)
+        s = int(self.step_obj._step_i)
+        if s > 0 and s % self.save_every == 0 and s != self._last_saved:
+            self._last_saved = s
+            self.manager.save(self.step_obj, step=s, skip_if_busy=True)
 
-    def _save(self, step):
-        from .checkpoint import save_train_state
-        if self._async_handle is not None:
-            try:
-                self._async_handle.wait_until_finished()
-            except Exception:
-                pass
-        path = os.path.join(self.ckpt_dir, f"step_{step}")
-        self._async_handle = save_train_state(self.step_obj, path,
-                                              use_async=True)
+    def wait(self, timeout=None):
+        """Drain pending checkpoint writes (tests / clean shutdown)."""
+        self.manager.wait(timeout)
 
     # -- watchdog ------------------------------------------------------
     def start_watchdog(self):
+        """Arm the wedged-step detector: when no on_step() lands within
+        `watchdog_timeout_s`, dump a debug bundle (stacks + rings + HLO
+        + ckpt_state.json, flight_recorder.dump) and SIGTERM this
+        process so the scheduler restarts it — which resumes from the
+        last committed checkpoint via maybe_resume()."""
         def run():
             while not self._stop.wait(min(self.timeout / 4, 60)):
-                if time.time() - self._last_progress > self.timeout:
-                    # surface to the main thread via os-level signal
-                    import signal
+                hang = time.time() - self._last_progress
+                if hang > self.timeout:
+                    _flight.record_event(
+                        "elastic_watchdog_expired",
+                        hang_s=round(hang, 3),
+                        step=int(getattr(self.step_obj, "_step_i", -1)),
+                        timeout_s=self.timeout)
+                    _monitor.counter("ckpt.watchdog_fired").inc()
+                    # diagnosis BEFORE the kill: the bundle (when
+                    # PADDLE_TPU_DEBUG_DUMP is set) carries the stacks
+                    # and checkpoint state of the wedged process
+                    _flight.dump("elastic_watchdog")
                     os.kill(os.getpid(), signal.SIGTERM)
                     return
-        self._watchdog = threading.Thread(target=run, daemon=True)
+        self._watchdog = threading.Thread(target=run, daemon=True,
+                                          name="elastic-watchdog")
         self._watchdog.start()
 
     def stop(self):
         self._stop.set()
-        if self._async_handle is not None:
-            try:
-                self._async_handle.wait_until_finished()
-            except Exception:
-                pass
+        self.manager.wait()

@@ -50,7 +50,24 @@ __all__ = ["init", "Fleet", "DistributedStrategy", "distributed_model",
            "HybridTrainStep", "worker_index", "worker_num", "is_worker",
            "barrier_worker", "recompute", "utils", "UtilBase", "Role",
            "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
-           "MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+           "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+           "elastic_controller"]
+
+
+def elastic_controller(train_step, ckpt_dir, **kwargs):
+    """Fault-tolerance wiring for a fleet train loop: an
+    `ElasticController` (distributed/elastic.py) over the hybrid step —
+    verified resume from the newest committed checkpoint, async
+    snapshot-then-write saves on a step cadence, and a watchdog that
+    dumps a debug bundle before SIGTERM. See docs/FAULT_TOLERANCE.md.
+
+        step = fleet.build_train_step(model, loss_fn, opt)
+        ctl = fleet.elastic_controller(step, "ckpts", save_every_steps=500)
+        start = ctl.maybe_resume()
+        ctl.start_watchdog()
+    """
+    from ..elastic import ElasticController
+    return ElasticController(train_step, ckpt_dir, **kwargs)
 
 
 def init(role_maker=None, is_collective=True, strategy=None):

@@ -24,9 +24,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...framework.core import Tensor, no_grad, _Slot
 from ...framework.random import split_key
 from ...framework.jax_compat import shard_map
+from ...framework import fault_injection as _fault
 from ...jit.api import (functional_call, state_arrays, aot_compile,
                         count_train_use, export_step_metrics,
-                        HealthMonitorMixin, _step_arg_names,
+                        HealthMonitorMixin, CheckpointSnapshotMixin,
+                        fire_step_faults, _step_arg_names,
                         epilogue_leaf_meta)
 from ...jit import warm as _warm
 from ...jit.deferred import DeferredLoss
@@ -89,7 +91,7 @@ def _zero_spec(pspec, mesh, arr):
     return pspec
 
 
-class HybridTrainStep(HealthMonitorMixin):
+class HybridTrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
     """Build once, call per batch. See module docstring."""
 
     def __init__(self, model, loss_fn, optimizer, mesh, recompute=False,
@@ -501,8 +503,29 @@ class HybridTrainStep(HealthMonitorMixin):
         sig, args = self._prep(batch, self._step_i + 1)
         return self._warm_submit(sig, args, len(batch))
 
+    def set_tree_state(self, params=None, opt_state=None):
+        """Load per-leaf state back into the step (checkpoint restore:
+        distributed/checkpoint.py) — the sharded counterpart of
+        TrainStep.set_tree_state: every array is device_put DIRECTLY
+        onto its storage sharding (params to `param_shardings`,
+        optimizer state to its live leaf's ZeRO placement), so a
+        resume lands dp/mp-sharded without materializing the full
+        tree on one host."""
+        if params is not None:
+            self.params = {
+                k: jax.device_put(v, self.param_shardings[k])
+                for k, v in params.items()}
+        if opt_state is not None:
+            self.opt_state = {
+                k: jax.tree.map(
+                    lambda new, cur: jax.device_put(new, cur.sharding),
+                    opt_state[k], self.opt_state[k])
+                for k in self.opt_state}
+
     def __call__(self, *batch):
         self._step_i += 1
+        if _fault.active():  # fault drills only; two dict reads when off
+            batch = fire_step_faults(self, batch)
         sig, args = self._prep(batch, self._step_i)
         _flight.heartbeat(self._step_i)  # watchdog liveness pulse
         _stat.begin_span("fleet.hybrid_step")

@@ -163,7 +163,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            resume=None):
         """The async step loop: every iteration dispatches work and keeps
         going — the loss lands in `logs` as a deferred handle that
         ProgBarLogger resolves only at `log_freq` boundaries and this
@@ -173,11 +174,39 @@ class Model:
         callback round per update; `num_iters` counts updates). A loader
         built with `prefetch_to_device=` stages upcoming batches onto the
         device (with this model's step input shardings) while the
-        current step computes."""
+        current step computes.
+
+        `resume` wires the fault-tolerance subsystem
+        (docs/FAULT_TOLERANCE.md) into the loop:
+
+        - a directory path: a `distributed.checkpoint.CheckpointManager`
+          restores the newest VERIFIED checkpoint into the train step
+          before the first batch (params + optimizer state + scaler +
+          step counter; partial/corrupt checkpoints are skipped), then
+          saves asynchronously at every epoch end — the step loop never
+          blocks on the write;
+        - a `CheckpointManager`: same, with the caller's retention
+          policy;
+        - an `ElasticController`: `maybe_resume()` runs up front and
+          `on_step()` feeds the watchdog + step-cadence saves after
+          every optimizer update."""
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
         k = max(1, int(accumulate_grad_batches or 1))
+
+        ctl = mgr = None
+        if resume is not None:
+            from ..distributed.elastic import ElasticController
+            from ..distributed.checkpoint import CheckpointManager
+            self._ensure_train_step()
+            if isinstance(resume, ElasticController):
+                ctl = resume
+                ctl.maybe_resume()
+            else:
+                mgr = resume if isinstance(resume, CheckpointManager) \
+                    else CheckpointManager(str(resume))
+                mgr.restore(self._train_step)
 
         def _bind_prefetch_sharding():
             # (re)bind the CURRENT step for the device prefetch ring — a
@@ -205,10 +234,22 @@ class Model:
         try:
             self._fit_epochs(loader, eval_data, batch_size, epochs,
                              eval_freq, save_dir, save_freq, num_workers,
-                             cbks, k, num_iters, _bind_prefetch_sharding)
+                             cbks, k, num_iters, _bind_prefetch_sharding,
+                             ctl=ctl, mgr=mgr)
         finally:
             # a loader that outlives this fit must not pin the step
             _unbind_fit_sharding(loader)
+            # pending async checkpoint writes must commit before fit
+            # returns — the ONE deliberate checkpoint wait of the loop.
+            # (Step 0 is never worth a checkpoint: a fit that died
+            # before its first update resumes from init anyway.)
+            if mgr is not None and self._train_step is not None and \
+                    self._train_step._step_i > 0:
+                mgr.save(self._train_step)
+            if mgr is not None:
+                mgr.wait()
+            if ctl is not None:
+                ctl.wait()
             # on_end in the finally: callbacks that buffer until train
             # end (VisualDL's deferred scalars) still drain when an
             # epoch dies mid-flight
@@ -216,7 +257,7 @@ class Model:
 
     def _fit_epochs(self, loader, eval_data, batch_size, epochs,
                     eval_freq, save_dir, save_freq, num_workers, cbks, k,
-                    num_iters, bind_sharding):
+                    num_iters, bind_sharding, ctl=None, mgr=None):
         steps_done = 0
         ragged_warned = False
         for epoch in range(epochs):
@@ -241,6 +282,11 @@ class Model:
                 det = getattr(self._train_step, "anomalies", None)
                 if det is not None and det.events:
                     logs["anomalies"] = det.drain()
+                if ctl is not None:
+                    # elastic hook: watchdog feed + cadence saves; the
+                    # snapshot is async and the write is backgrounded,
+                    # so the loop keeps dispatching
+                    ctl.on_step()
                 cbks.on_batch_end("train", step, logs)
                 step += 1
                 steps_done += 1
@@ -302,6 +348,10 @@ class Model:
                                      verbose=0, num_workers=num_workers)
                 logs.update({"eval_" + k2: v for k2, v in eres.items()})
             cbks.on_epoch_end(epoch, logs)
+            if mgr is not None and self._train_step is not None:
+                # async epoch-boundary checkpoint: snapshot now, write
+                # in the background while the next epoch trains
+                mgr.save(self._train_step, skip_if_busy=True)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(os.path.join(save_dir, str(epoch)))
             if self.stop_training:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor, Parameter, no_grad, _Slot
 from ..framework.random import rng_scope, split_key
+from ..framework import fault_injection as _fault
 from ..profiler import statistic as _stat
 from ..profiler import monitor as _monitor
 from ..profiler import cost as _cost
@@ -33,7 +34,8 @@ from . import warm as _warm
 
 __all__ = ["functional_call", "to_static", "TrainStep", "not_to_static",
            "aot_compile", "count_train_use", "export_step_metrics",
-           "DeferredLoss", "HealthMonitorMixin"]
+           "DeferredLoss", "HealthMonitorMixin",
+           "CheckpointSnapshotMixin"]
 
 # Tracing binds tracer values into SHARED layer state (_bind swaps
 # Parameter slots, dy2static swaps layer.forward, aux-loss records live
@@ -596,7 +598,57 @@ class HealthMonitorMixin:
         return self.last_health
 
 
-class TrainStep(HealthMonitorMixin):
+class CheckpointSnapshotMixin:
+    """The checkpoint surface TrainStep and HybridTrainStep share —
+    what `distributed.checkpoint.CheckpointManager` saves and restores.
+
+    `tree_state()` is the canonical state tree: per-leaf params and
+    optimizer-state VIEWS plus the GradScaler's jit state ({} when no
+    scaler rides the step). `snapshot_state()` returns ON-DEVICE buffer
+    copies of that tree: the copies are dispatched asynchronously (the
+    host returns immediately) and are detached from the donated
+    buffers, so the step loop can keep dispatching while the
+    checkpoint writer streams the snapshot to disk — the core of the
+    snapshot-then-write save path (docs/FAULT_TOLERANCE.md). The
+    restore inverse is `set_tree_state` (layout-aware on both the
+    fused-flat-store and hybrid-sharded layouts) plus a `scaler_state`
+    assignment."""
+
+    def tree_state(self):
+        return {"params": self.params,
+                "opt_state": self.opt_state,
+                "scaler_state": self.scaler_state}
+
+    def snapshot_state(self):
+        return jax.tree.map(jnp.copy, self.tree_state())
+
+
+def fire_step_faults(step_obj, batch):
+    """The `train.step` fault-injection site every train-step dispatch
+    passes through (framework/fault_injection.py): hard actions
+    (kill-at-step-k, delay) execute inside fire(); the soft `nan`
+    action is implemented here by NaN-filling the first floating batch
+    leaf, so the whole gradient goes non-finite (the GradScaler /
+    health path must catch it). Returns the (possibly poisoned)
+    batch."""
+    acts = _fault.fire("train.step")
+    if not acts or "nan" not in acts:
+        return batch
+    out = list(batch)
+    for i, b in enumerate(out):
+        v = b.value if isinstance(b, Tensor) else jnp.asarray(b)
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            poisoned = jnp.full_like(v, jnp.nan)
+            out[i] = Tensor(poisoned) if isinstance(b, Tensor) \
+                else poisoned
+            return tuple(out)
+    raise ValueError(
+        "nan@train.step fault needs at least one floating-point batch "
+        "input to poison (integer-id models: inject at the loss level "
+        "or use a float-input model in the drill)")
+
+
+class TrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
     """One fully-jitted training step: forward + loss + grads + optimizer.
 
     The TPU-native analogue of the reference's whole-program executor path:
@@ -1215,6 +1267,8 @@ class TrainStep(HealthMonitorMixin):
 
     def __call__(self, *batch):
         self._step_i += 1
+        if _fault.active():  # fault drills only; two dict reads when off
+            batch = fire_step_faults(self, batch)
         sig, args = self._prep(batch, self._step_i)
         out, info, compiled_now, dispatch_s = self._dispatch(
             self._exec, sig, lambda: self._jitted, args, "train.step",

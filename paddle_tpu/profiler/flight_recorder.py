@@ -40,7 +40,10 @@ XLA cost analysis of every registered AOT executable — `jit/api.py`
 registers each train-step/serving compile), `requests_tail.jsonl` +
 `serve_state.json` (the serving observatory's recent terminal request
 records and every live engine's load_report/pool_stats —
-`serve_observatory.py`), `env.json` (argv/versions/PADDLE*/JAX* env),
+`serve_observatory.py`), one `<name>.json` per registered state
+provider (e.g. `ckpt_state.json` — the checkpoint manager's
+committed/in-flight view, `distributed/checkpoint.py`), `env.json`
+(argv/versions/PADDLE*/JAX* env),
 and `stacks.txt` (faulthandler all-thread stacks). Writing never
 raises: a dump is diagnostics, not a second crash. See
 docs/OBSERVABILITY.md "The flight recorder".
@@ -61,7 +64,8 @@ import traceback
 import weakref
 
 __all__ = ["record_span_event", "record_sample", "record_record",
-           "record_event", "register_executable", "heartbeat",
+           "record_event", "register_executable",
+           "register_state_provider", "heartbeat",
            "snapshot", "reset", "dump", "install", "auto_install",
            "Watchdog", "perf_to_wall"]
 
@@ -89,6 +93,14 @@ _exec_lock = threading.Lock()
 _beat = {"ts": None, "step": None, "count": 0}
 _installed = {"hooks": False}
 _watchdog = [None]
+# name -> list of weakref-wrapped zero-arg callables returning a
+# JSON-serializable payload; a debug bundle writes each name as
+# <name>.json from the NEWEST LIVE provider (e.g. the checkpoint
+# manager's ckpt_state.json — distributed/checkpoint.py registers it).
+# Weak references: registration must not keep a dead manager (a
+# bench/gate throwaway) alive, and once it's collected the previously
+# registered live one shows through again.
+_state_providers = {}
 
 
 def perf_to_wall(t_perf):
@@ -149,6 +161,35 @@ def register_executable(tag, compiled):
         _execs[tag] = ref
         while len(_execs) > EXEC_REGISTRY:
             _execs.popitem(last=False)
+
+
+def register_state_provider(name, fn):
+    """Register a zero-arg callable whose JSON-serializable return
+    value a debug bundle writes as `<name>.json` (e.g. "ckpt_state" →
+    the checkpoint manager's committed/queued/last-error view). Held
+    via weakref (a bound method pins neither its owner nor the
+    registry); per name the newest LIVE registration wins, and dead
+    ones are pruned at dump time. Providers must never raise for the
+    bundle to matter, but dump() guards them anyway."""
+    try:
+        ref = weakref.WeakMethod(fn)
+    except TypeError:  # plain function/lambda: module-lived, hold it
+        ref = (lambda f=fn: f)
+    lst = _state_providers.setdefault(str(name), [])
+    lst.append(ref)
+    del lst[:-8]  # bounded per name
+
+
+def _resolve_state_providers():
+    """{name: newest live provider}, pruning dead weakrefs."""
+    out = {}
+    for name, lst in list(_state_providers.items()):
+        lst[:] = [r for r in lst if r() is not None]
+        if lst:
+            out[name] = lst[-1]()
+        else:
+            _state_providers.pop(name, None)
+    return out
 
 
 def _live_executables():
@@ -313,6 +354,22 @@ def dump(reason="manual", exc=None, base_dir=None):
                 _write_json(os.path.join(d, "serve_state.json"), payload)
         except Exception:
             pass
+
+        # registered state providers (ckpt_state.json, ...): subsystem
+        # snapshots a post-mortem needs that no ring carries — e.g.
+        # which checkpoints are committed vs in-flight when a wedged
+        # step gets SIGTERMed (distributed/elastic.py watchdog)
+        provided = []
+        for name, fn in _resolve_state_providers().items():
+            safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                           for c in name)[:80]
+            try:
+                if _write_json(os.path.join(d, safe + ".json"), fn()):
+                    provided.append(name)
+            except Exception:
+                continue
+        if provided:
+            manifest["state_providers"] = provided
 
         # env / versions / argv
         envkeys = ("PADDLE", "JAX", "XLA", "TPU", "BENCH", "FLAGS_")

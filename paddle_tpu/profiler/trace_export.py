@@ -18,7 +18,11 @@ Track layout (what you see in Perfetto):
 - one thread track per real host thread (named: MainThread,
   serve-dispatch, prefetch producer, ...), duration events from spans;
 - synthetic tracks "train steps" / "serve batches" rendering the
-  exported step/serve records with their metadata as args;
+  exported step/serve records with their metadata as args, and a
+  "checkpoint" track with one slice per save/restore/GC (phase
+  sub-slices: snapshot → serialize → write → commit) from the
+  `kind:"ckpt"` records, so async-save overlap with training is
+  visible next to the step slices;
 - "serving requests" lanes: one slice per request LIFETIME (submit ->
   terminal, labelled engine/id/outcome, the full `kind:"request"`
   record in its args) with queued/prefill/decode phase sub-slices
@@ -43,7 +47,7 @@ from . import monitor
 
 __all__ = ["chrome_trace_events", "write_chrome_trace",
            "TRAIN_TID", "SERVE_TID", "EVENT_TID", "COMPILE_TID",
-           "REQUEST_TID", "REQUEST_LANES"]
+           "REQUEST_TID", "REQUEST_LANES", "CKPT_TID"]
 
 # synthetic track ids for record-derived events; real thread idents are
 # pointer-sized on linux, so small ints can never collide with them
@@ -53,6 +57,7 @@ EVENT_TID = 3
 COMPILE_TID = 4
 REQUEST_TID = 5     # first "serving requests" lane
 REQUEST_LANES = 12  # concurrent-request lanes before reuse
+CKPT_TID = 20       # "checkpoint" track (after the request lanes)
 
 
 def _sanitize(obj):
@@ -94,6 +99,8 @@ def chrome_trace_events(snap=None, rank=None):
          "ts": 0, "args": {"name": "events"}},
         {"ph": "M", "name": "thread_name", "pid": pid, "tid": COMPILE_TID,
          "ts": 0, "args": {"name": "compilation"}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": CKPT_TID,
+         "ts": 0, "args": {"name": "checkpoint"}},
     ]
     events = []
 
@@ -179,6 +186,38 @@ def chrome_trace_events(snap=None, rank=None):
                         "name": f"kv.{eng}.{key}", "ph": "C",
                         "cat": "kvcache", "ts": ts * 1e6, "pid": pid,
                         "tid": 0, "args": {"value": _sanitize(v)}})
+        elif kind == "ckpt":
+            # the checkpoint track: one slice per save (reconstructed
+            # backwards from the commit-time stamp) with the
+            # snapshot/serialize/write/commit phases as sub-slices, one
+            # per restore/gc — next to the train steps they overlap
+            # with, which is the visual proof the async writer is off
+            # the critical path (docs/FAULT_TOLERANCE.md)
+            op = rec.get("op", "?")
+            dur = rec.get("total_s", 0.0)
+            dur = max(float(dur), 0.0) if isinstance(dur, (int, float)) \
+                and not isinstance(dur, bool) else 0.0
+            name = f"ckpt {op} step {rec.get('step', '?')}"
+            if op == "restore" and not rec.get("verified", True):
+                name += " [no valid checkpoint]"
+            if op == "save" and not rec.get("committed", True):
+                name += " [FAILED]"
+            events.append({
+                "name": name, "ph": "X", "cat": "ckpt",
+                "ts": (ts - dur) * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": CKPT_TID, "args": _sanitize(rec)})
+            if op == "save":
+                t = ts - dur
+                for phase in ("snapshot", "serialize", "write",
+                              "commit"):
+                    d = rec.get(phase + "_s")
+                    if isinstance(d, (int, float)) and \
+                            not isinstance(d, bool) and d > 0:
+                        events.append({
+                            "name": phase, "ph": "X", "cat": "ckpt",
+                            "ts": t * 1e6, "dur": float(d) * 1e6,
+                            "pid": pid, "tid": CKPT_TID, "args": {}})
+                        t += d
         elif kind == "health":
             for key in ("grad_norm", "param_norm", "update_ratio",
                         "loss"):

@@ -66,10 +66,9 @@ def main():
         ctl.on_step()
         losses[i] = loss
         if mode == "crash" and i >= arg:
-            # let the async checkpoint land, then die like a preempted
-            # host — no cleanup, no stop()
-            if ctl._async_handle is not None:
-                ctl._async_handle.wait_until_finished()
+            # let the async checkpoint writer drain, then die like a
+            # preempted host — no cleanup, no stop()
+            ctl.wait()
             os._exit(17)
     ctl.stop()
     with open(out_path, "w") as f:

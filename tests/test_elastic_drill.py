@@ -52,10 +52,13 @@ def test_crash_restart_exact_resume(tmp_path):
     assert any(d.startswith("step_") for d in saved), saved
 
     # 3. restart: a fresh process resumes from the newest checkpoint and
-    #    finishes the run
+    #    finishes the run. The save cadence hits 2 and 4; a save that
+    #    lands while the writer is still busy is skipped (the step loop
+    #    never queues behind the disk), so the newest COMMITTED step is
+    #    4 or, rarely, 2 — either resumes exactly.
     _run("resume", 8, tmp_path / "ckpt", res_out)
     resumed = json.load(open(res_out))
-    assert resumed["start"] == 4, resumed["start"]  # newest async ckpt
+    assert resumed["start"] in (2, 4), resumed["start"]
 
     # 4. the resumed trajectory must REPLAY the baseline exactly
     for s, loss in resumed["losses"].items():

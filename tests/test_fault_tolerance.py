@@ -1,0 +1,531 @@
+"""Fault-tolerant training: snapshot-then-write async checkpointing,
+atomic commits, verified resume, and the fault-injection harness
+(docs/FAULT_TOLERANCE.md).
+
+The headline drill is subprocess kill-and-resume: train under
+ElasticController, SIGKILL the process MID-ASYNC-SAVE via an injected
+fault (`kill@ckpt.commit#2` / `kill@ckpt.write#15`), relaunch, and
+assert the continuation is BIT-IDENTICAL (sha256 over every state
+leaf: params + opt state + scaler + step counter) to an uninterrupted
+run — on both the TrainStep and HybridTrainStep (dp/mp mesh) paths.
+The calibrated overlap test proves the async save is off the critical
+path: an injected 0.8 s write delay must not stretch the step loop.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+from paddle_tpu.framework import fault_injection as fi
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                               COMMIT_NAME,
+                                               MANIFEST_NAME)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_ckpt_worker.py")
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No fault spec may leak across tests (or in from the env)."""
+    os.environ.pop("PADDLE_TPU_FAULT_SPEC", None)
+    fi.configure("")
+    yield
+    os.environ.pop("PADDLE_TPU_FAULT_SPEC", None)
+    fi.configure("")
+
+
+def _loss_fn(out, y):
+    return paddle.mean(paddle.nn.functional.square_error_cost(out, y))
+
+
+def _build_step(seed=0, **kw):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    return TrainStep(m, _loss_fn, o, **kw)
+
+
+def _batch(n=16):
+    rs = np.random.RandomState(0)
+    return (paddle.to_tensor(rs.randn(n, 8).astype("float32")),
+            paddle.to_tensor(rs.randn(n, 1).astype("float32")))
+
+
+# ---------------------------------------------------------------- spec
+
+def test_fault_spec_parsing():
+    faults = fi.parse_spec(
+        "kill@ckpt.write#2; eio@ckpt.write, delay@ckpt.serialize=0.25,"
+        "truncate@ckpt.write=100; nan@train.step#3")
+    assert [(f.action, f.site, f.nth, f.arg) for f in faults] == [
+        ("kill", "ckpt.write", 2, None),
+        ("eio", "ckpt.write", None, None),
+        ("delay", "ckpt.serialize", None, 0.25),
+        ("truncate", "ckpt.write", None, 100),
+        ("nan", "train.step", 3, None)]
+    for bad in ("frob@x", "kill@", "killckpt", "kill@x#0"):
+        with pytest.raises(ValueError):
+            fi.parse_spec(bad)
+
+
+def test_fault_fire_counts_and_eio(tmp_path):
+    fi.configure("eio@t.site#2")
+    assert fi.fire("t.site") is None          # hit 1: no match
+    with pytest.raises(OSError):
+        fi.fire("t.site")                     # hit 2: injected EIO
+    assert fi.fire("t.site") is None          # hit 3: past the match
+    assert fi.hit_counts()["t.site"] == 3
+    fi.configure("nan@t.soft")
+    assert fi.fire("t.soft") == ["nan"]       # soft: reported, not run
+
+
+# ----------------------------------------------------- save + restore
+
+def test_checkpoint_roundtrip_and_commit_layout(tmp_path):
+    step = _build_step()
+    x, y = _batch()
+    for _ in range(3):
+        float(step(x, y))
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    handle = mgr.save(step)
+    path = handle.result(60)
+    rec = handle.record
+    assert rec["committed"] and rec["bytes"] > 0 and rec["n_leaves"] >= 12
+    assert rec["snapshot_s"] + rec["serialize_s"] + rec["write_s"] + \
+        rec["commit_s"] <= rec["total_s"] + 1e-3
+    # commit protocol on disk: manifest + COMMIT marker, no temp dirs
+    assert os.path.isfile(os.path.join(path, MANIFEST_NAME))
+    assert os.path.isfile(os.path.join(path, COMMIT_NAME))
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp-")]
+    cont = [float(step(x, y)) for _ in range(2)]
+
+    fresh = _build_step(seed=123)   # different init: must be overwritten
+    restored = CheckpointManager(str(tmp_path)).restore(fresh)
+    assert restored == 3 and fresh._step_i == 3
+    assert [float(fresh(x, y)) for _ in range(2)] == cont
+    for k in step.params:
+        np.testing.assert_array_equal(np.asarray(step.params[k]),
+                                      np.asarray(fresh.params[k]))
+    mgr.close()
+
+
+def test_restore_falls_back_past_corrupt_and_truncated(tmp_path):
+    step = _build_step()
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path), keep_last=5)
+    float(step(x, y)), float(step(x, y))
+    mgr.save(step).result(60)              # step 2 (good)
+    float(step(x, y)), float(step(x, y))
+    mgr.save(step).result(60)              # step 4 (will be truncated)
+    float(step(x, y)), float(step(x, y))
+    p6 = mgr.save(step).result(60)         # step 6 (will be corrupted)
+    float(step(x, y)), float(step(x, y))
+    p8 = mgr.save(step).result(60)         # step 8 (byte-flipped)
+
+    # damage: truncate a shard of step 4, garbage the manifest of 6,
+    # and flip one byte (same size — only the checksum can tell) in 8
+    p4 = os.path.join(tmp_path, "step_00000004")
+    shard = os.path.join(p4, sorted(
+        f for f in os.listdir(p4) if f.startswith("shard_"))[0])
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    with open(os.path.join(p6, MANIFEST_NAME), "w") as f:
+        f.write("{not json")
+    shard8 = os.path.join(p8, "shard_00000.bin")
+    with open(shard8, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ok4, problem4, _ = mgr.verify(p4)
+    assert not ok4 and "truncated" in problem4
+    ok6, problem6, _ = mgr.verify(p6)
+    assert not ok6
+    ok8, problem8, _ = mgr.verify(p8)      # full-crc verify catches it
+    assert not ok8 and "checksum" in problem8
+
+    fresh = _build_step(seed=9)
+    m2 = CheckpointManager(str(tmp_path))
+    restored = m2.restore(fresh)
+    assert restored == 2, "must fall back past ALL damaged checkpoints"
+    assert m2.last_restore_record["fell_back"] == 3
+    assert m2.last_restore_record["verified"] is True
+    mgr.close()
+
+
+def test_uncommitted_dir_is_skipped(tmp_path):
+    """A step_N dir without a COMMIT marker (non-atomic copy, torn
+    publish) must not be restorable."""
+    step = _build_step()
+    x, y = _batch()
+    float(step(x, y)), float(step(x, y))
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(step).result(60)
+    float(step(x, y)), float(step(x, y))
+    p4 = mgr.save(step).result(60)
+    os.remove(os.path.join(p4, COMMIT_NAME))
+    fresh = _build_step(seed=5)
+    assert CheckpointManager(str(tmp_path)).restore(fresh) == 2
+    mgr.close()
+
+
+def test_injected_eio_fails_save_but_not_the_manager(tmp_path):
+    step = _build_step()
+    x, y = _batch()
+    float(step(x, y))
+    mgr = CheckpointManager(str(tmp_path))
+    fi.configure("eio@ckpt.write#1")
+    h = mgr.save(step)
+    with pytest.raises(OSError):
+        h.result(60)
+    assert h.record["committed"] is False
+    assert mgr.all_steps() == []
+    assert not [d for d in os.listdir(tmp_path)
+                if d.startswith(".tmp-")], "failed save must clean up"
+    fi.configure("")
+    assert mgr.save(step).result(60)       # manager still functional
+    assert mgr.all_steps() == [1]
+    mgr.close()
+
+
+def test_retention_gc_keep_last_and_keep_every(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=2, keep_every=4)
+    w = {"w": paddle.to_tensor(np.ones(4, np.float32)).value}
+    for s in (2, 4, 6, 8, 10):
+        mgr.save(w, step=s).result(60)
+    # keep_last=2 -> {8, 10}; keep_every=4 -> {4, 8}
+    assert mgr.all_steps() == [4, 8, 10]
+    mgr.close()
+
+
+def test_plain_dict_tree_restores_in_place(tmp_path):
+    """save()/restore() of a bare pytree (no train step): the dict is
+    restored IN PLACE, not silently left at its pre-restore values."""
+    mgr = CheckpointManager(str(tmp_path))
+    tree = {"a": paddle.to_tensor(np.arange(4, dtype=np.float32)).value,
+            "b": {"c": paddle.to_tensor(
+                np.ones((2, 2), np.float32)).value}}
+    mgr.save(tree, step=3).result(60)
+    import jax.numpy as jnp
+    mutated = {"a": jnp.zeros(4, jnp.float32),
+               "b": {"c": jnp.full((2, 2), 7.0, jnp.float32)}}
+    assert CheckpointManager(str(tmp_path)).restore(mutated) == 3
+    np.testing.assert_array_equal(np.asarray(mutated["a"]),
+                                  np.arange(4, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(mutated["b"]["c"]),
+                                  np.ones((2, 2), np.float32))
+    mgr.close()
+
+
+def test_latest_ignores_nonconforming_names(tmp_path):
+    """Satellite: stray files / step_123.tmp / partials must not crash
+    the newest-checkpoint scan."""
+    mgr = CheckpointManager(str(tmp_path))
+    os.makedirs(tmp_path / "step_123.tmp")
+    os.makedirs(tmp_path / ".tmp-step_00000099-partial")
+    (tmp_path / "stray.txt").write_text("x")
+    os.makedirs(tmp_path / "step_00000007")   # committed-looking name,
+    assert mgr.all_steps() == [7]             # (verify() rejects it)
+    assert mgr.latest().endswith("step_00000007")
+    step = _build_step()
+    assert mgr.restore(step) is None          # unverifiable: skipped
+
+
+# ------------------------------------------------ async overlap proof
+
+def test_async_save_off_the_critical_path(tmp_path):
+    """Calibrated: with an injected 0.8 s delay in the WRITE phase, the
+    step loop dispatched during the background write must finish in a
+    fraction of that — and the record's snapshot phase must be an
+    order of magnitude shorter than its write phase."""
+    step = _build_step()
+    x, y = _batch()
+    for _ in range(3):
+        float(step(x, y))
+    mgr = CheckpointManager(str(tmp_path), keep_last=2)
+    mgr.save(step).result(60)       # warm the snapshot/copy programs
+    fi.configure("delay@ckpt.write#1=0.8")
+    t0 = time.perf_counter()
+    h = mgr.save(step)
+    enqueue_s = time.perf_counter() - t0
+    losses = [step(x, y) for _ in range(6)]
+    float(losses[-1])               # resolve: all 6 steps done
+    loop_s = time.perf_counter() - t0
+    rec_path = h.result(60)
+    fi.configure("")
+    rec = h.record
+    assert rec_path and rec["committed"]
+    assert rec["write_s"] >= 0.8, rec
+    assert enqueue_s < 0.4, f"save() blocked the caller: {enqueue_s}"
+    assert loop_s < 0.56, \
+        f"step loop waited on the background write: {loop_s:.3f}s " \
+        f"vs write_s {rec['write_s']:.3f}s"
+    assert rec["snapshot_s"] * 10 <= rec["write_s"], rec
+    mgr.close()
+
+
+# ----------------------------------------------- telemetry + schema
+
+def test_ckpt_records_validate_and_trace_track(tmp_path):
+    mfile = tmp_path / "metrics.jsonl"
+    os.environ["PADDLE_TPU_METRICS_FILE"] = str(mfile)
+    try:
+        step = _build_step()
+        x, y = _batch()
+        float(step(x, y)), float(step(x, y))
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=1)
+        mgr.save(step).result(60)
+        float(step(x, y)), float(step(x, y))
+        mgr.save(step).result(60)            # triggers GC of step 2
+        fresh = _build_step(seed=3)
+        CheckpointManager(str(tmp_path / "ck")).restore(fresh)
+        mgr.close()
+    finally:
+        os.environ.pop("PADDLE_TPU_METRICS_FILE", None)
+
+    sys.path.insert(0, TOOLS)
+    try:
+        import check_metrics_schema as cms
+    finally:
+        sys.path.pop(0)
+    assert cms.validate_file(str(mfile)) == []
+    recs = [json.loads(l) for l in mfile.read_text().splitlines() if l]
+    ckpt = [r for r in recs if r.get("kind") == "ckpt"]
+    ops = [r["op"] for r in ckpt]
+    assert ops.count("save") == 2 and "restore" in ops and "gc" in ops
+    restore_rec = [r for r in ckpt if r["op"] == "restore"][-1]
+    assert restore_rec["verified"] is True and restore_rec["step"] == 4
+
+    # the Perfetto "checkpoint" track renders the records
+    from paddle_tpu.profiler import trace_export
+    tf = trace_export.write_chrome_trace(str(tmp_path / "trace.json"))
+    payload = json.load(open(tf))
+    names = [e.get("name") for e in payload["traceEvents"]]
+    assert "checkpoint" in [
+        e["args"]["name"] for e in payload["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    assert any(n and n.startswith("ckpt save step") for n in names)
+    assert cms.validate_file(tf) == []
+
+
+def test_nan_injection_trips_scaler_and_health():
+    """nan@train.step poisons a float batch leaf -> the whole gradient
+    goes non-finite -> the in-step GradScaler skips the update and the
+    health vector reports found_inf."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 8)
+    step = TrainStep(m, _loss_fn, o, scaler=scaler, monitor_health=True)
+    x, y = _batch()
+    fi.configure("nan@train.step#2")         # hits count from here
+    float(step(x, y))                        # hit 1: clean
+    before = {k: np.asarray(v) for k, v in step.params.items()}
+    float(step(x, y))                        # hit 2: poisoned step
+    fi.configure("")
+    h = step.flush_health()
+    assert h["step"] == 2 and h["found_inf"] == 1.0
+    for k, v in step.params.items():         # found_inf: update skipped
+        np.testing.assert_array_equal(before[k], np.asarray(v))
+
+
+def test_watchdog_dumps_bundle_with_ckpt_state_before_sigterm(tmp_path):
+    from paddle_tpu.distributed.elastic import ElasticController
+    fired = []
+    prev = signal.signal(signal.SIGTERM, lambda *a: fired.append(True))
+    os.environ["PADDLE_TPU_DEBUG_DUMP"] = str(tmp_path / "dump")
+    try:
+        step = _build_step()
+        ctl = ElasticController(step, str(tmp_path / "ck"),
+                                watchdog_timeout_s=0.4)
+        ctl.start_watchdog()
+        deadline = time.time() + 10
+        while not fired and time.time() < deadline:
+            time.sleep(0.05)
+        ctl.stop()
+        assert fired, "watchdog did not fire on a stalled step loop"
+        bundle = tmp_path / "dump" / "elastic_watchdog"
+        assert (bundle / "MANIFEST.json").is_file()
+        state = json.load(open(bundle / "ckpt_state.json"))
+        assert state["directory"] == str(tmp_path / "ck")
+        assert state["committed_steps"] == []
+    finally:
+        os.environ.pop("PADDLE_TPU_DEBUG_DUMP", None)
+        signal.signal(signal.SIGTERM, prev)
+
+
+# --------------------------------------------- hybrid sharded resume
+
+def _hybrid_mlp_step(seed):
+    from paddle_tpu.distributed import fleet
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc_in = nn.Linear(16, 32)    # P(None, 'mp')
+            self.fc_out = nn.Linear(32, 8)    # P('mp', None)
+            self.act = nn.Tanh()
+
+        def forward(self, x):
+            return self.fc_out(self.act(self.fc_in(x)))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs["dp_degree"] = 2
+    strategy.hybrid_configs["mp_degree"] = 2
+    strategy.hybrid_configs["sharding_degree"] = 2
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    m = MLP()
+    o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    return fleet.build_train_step(m, _loss_fn, o)
+
+
+def test_sharded_roundtrip_lands_in_placement(tmp_path):
+    """Satellite: load_train_state must pass the shardings it builds,
+    so a dp/mp (+ZeRO) resume restores each array DIRECTLY into its
+    distributed placement — and the CheckpointManager path must match."""
+    from paddle_tpu.distributed.checkpoint import (save_train_state,
+                                                   load_train_state)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(16, 16).astype("float32"))
+    y = paddle.to_tensor(rs.randn(16, 8).astype("float32"))
+    step = _hybrid_mlp_step(0)
+    for _ in range(2):
+        float(step(x, y))
+    assert "mp" in str(step.params["fc_in.weight"].sharding.spec)
+    save_train_state(step, str(tmp_path / "orbax"))
+    mgr = CheckpointManager(str(tmp_path / "native"))
+    mgr.save(step).result(60)
+    cont = [float(step(x, y)) for _ in range(2)]
+
+    for flavor in ("orbax", "native"):
+        fresh = _hybrid_mlp_step(seed=42)
+        if flavor == "orbax":
+            load_train_state(fresh, str(tmp_path / "orbax"))
+        else:
+            assert CheckpointManager(
+                str(tmp_path / "native")).restore(fresh) == 2
+        assert fresh._step_i == 2
+        # arrays landed in their dp/mp/ZeRO placement, not unsharded
+        assert fresh.params["fc_in.weight"].sharding == \
+            step.param_shardings["fc_in.weight"], flavor
+        opt_leaf = jax.tree.leaves(fresh.opt_state["fc_in.weight"])[0]
+        assert "sharding" in str(opt_leaf.sharding.spec), \
+            (flavor, opt_leaf.sharding)
+        assert [float(fresh(x, y)) for _ in range(2)] == cont, flavor
+    mgr.close()
+
+
+# --------------------------------------------------- hapi fit resume
+
+def test_model_fit_resume_continues_step_counter(tmp_path):
+    from paddle_tpu.hapi.model import Model
+
+    def make():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        model = Model(net)
+        model.prepare(
+            optimizer=opt.AdamW(learning_rate=1e-2,
+                                parameters=net.parameters()),
+            loss=_loss_fn)
+        return model
+
+    rs = np.random.RandomState(0)
+    data = [(rs.randn(4).astype("float32"),
+             rs.randn(1).astype("float32")) for _ in range(8)]
+    ckdir = str(tmp_path / "fit_ck")
+
+    model = make()
+    model.fit(data, batch_size=4, epochs=2, verbose=0, shuffle=False,
+              resume=ckdir)
+    assert model._train_step._step_i == 4       # 2 epochs x 2 updates
+    mgr = CheckpointManager(ckdir)
+    assert mgr.all_steps(), "fit must have committed a checkpoint"
+
+    resumed = make()
+    resumed.fit(data, batch_size=4, epochs=1, verbose=0, shuffle=False,
+                resume=ckdir)
+    # restored at step 4, then one more epoch of 2 updates
+    assert resumed._train_step._step_i == 6
+
+
+# --------------------------------------- kill-and-resume (subprocess)
+
+def _run_worker(flavor, target, ckpt, out, fault=None, expect_rc=0,
+                save_every=2):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["CKPT_SAVE_EVERY"] = str(save_every)
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env.pop("PADDLE_TPU_METRICS_FILE", None)
+    if fault:
+        env["PADDLE_TPU_FAULT_SPEC"] = fault
+    p = subprocess.run(
+        [sys.executable, WORKER, flavor, str(target), str(ckpt),
+         str(out)],
+        env=env, cwd=REPO, capture_output=True, timeout=300)
+    assert p.returncode == expect_rc, \
+        f"rc={p.returncode} (expected {expect_rc})\n" \
+        f"{p.stdout.decode()[-2000:]}\n{p.stderr.decode()[-2000:]}"
+
+
+@pytest.mark.heavy
+@pytest.mark.parametrize("flavor,fault", [
+    # die at the START of the 2nd checkpoint's commit (pre-rename):
+    # shards + manifest written, never published
+    ("single", "kill@ckpt.commit#2"),
+    # die while streaming the 2nd checkpoint's shard files (the first
+    # save writes 12 shards, so hit 15 is mid-second-write)
+    ("hybrid", "kill@ckpt.write#15"),
+])
+def test_kill_mid_save_then_resume_bit_identical(tmp_path, flavor,
+                                                 fault):
+    """SIGKILL mid-async-save -> relaunch -> resume from the last
+    COMMITTED checkpoint (partial temp dir skipped and GC'd) ->
+    continuation bit-identical to an uninterrupted run (params + opt
+    state + scaler + step counter, via sha256 digest)."""
+    base_out = tmp_path / "baseline.json"
+    res_out = tmp_path / "resumed.json"
+    ckpt = tmp_path / "ckpt"
+
+    # 1. uninterrupted baseline to step 8
+    _run_worker(flavor, 8, tmp_path / "ckpt_base", base_out)
+    baseline = json.load(open(base_out))
+    assert baseline["start"] == 0 and baseline["step"] == 8
+
+    # 2. train under the controller; the injected fault SIGKILLs the
+    #    process while the background writer saves a checkpoint
+    _run_worker(flavor, 8, ckpt, tmp_path / "unused.json",
+                fault=fault, expect_rc=-signal.SIGKILL)
+    committed = [d for d in os.listdir(ckpt) if d.startswith("step_")]
+    partials = [d for d in os.listdir(ckpt) if d.startswith(".tmp-")]
+    assert committed, "at least one checkpoint must have committed"
+    assert partials, \
+        "the kill mid-save must leave a partial temp dir behind"
+
+    # 3. relaunch: resume past the partial, finish the run
+    _run_worker(flavor, 8, ckpt, res_out)
+    resumed = json.load(open(res_out))
+    assert resumed["start"] > 0, "must resume from a committed step"
+    assert resumed["step"] == 8
+    assert not [d for d in os.listdir(ckpt) if d.startswith(".tmp-")], \
+        "resume must GC the partial temp dir"
+
+    # 4. bit-identical continuation: every replayed loss equal, and the
+    #    full final state digest equal to the uninterrupted run's
+    for s, loss in resumed["losses"].items():
+        assert baseline["losses"][s] == loss, (s, flavor)
+    assert resumed["digest"] == baseline["digest"], \
+        "resumed state is not bit-identical to the uninterrupted run"

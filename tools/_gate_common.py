@@ -311,6 +311,44 @@ def emit_workload():
             f"expected kind:'kvcache' snapshots from canonical_gen, "
             f"got {[(r.get('engine'), r.get('kind')) for r in kvs][:5]}")
 
+    # the fault-tolerance contract: one snapshot-then-write checkpoint
+    # save + verified resume on the canonical train step, so tier-1
+    # lints REAL kind:"ckpt" records (schema: phases sum <= total,
+    # bytes > 0, verified flag) in the same ledger the gates read
+    import shutil as _shutil
+    import tempfile as _tempfile
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    ck_dir = _tempfile.mkdtemp(prefix="gate_ckpt_")
+    try:
+        mgr = CheckpointManager(ck_dir, keep_last=2)
+        step_before = step._step_i
+        handle = mgr.save(step)
+        handle.result(120)  # committed
+        restored = CheckpointManager(ck_dir).restore(step)
+        if restored != step_before:
+            raise AssertionError(
+                f"checkpoint resume restored step {restored}, expected "
+                f"{step_before}")
+        ckpts = _load_kind(mfile, "ckpt")
+        saves = [r for r in ckpts if r.get("op") == "save"]
+        restores = [r for r in ckpts if r.get("op") == "restore"]
+        if not saves or not restores:
+            raise AssertionError(
+                f"expected kind:'ckpt' save+restore records, got "
+                f"{[(r.get('op'), r.get('step')) for r in ckpts]}")
+        errs = [e for r in ckpts
+                for e in _cms.validate_line(_json.dumps(r))]
+        if errs:
+            raise AssertionError(
+                f"ckpt records violate the schema: {errs[:5]}")
+        if not saves[-1]["committed"] or not restores[-1]["verified"]:
+            raise AssertionError(
+                f"canonical checkpoint must commit and verify: "
+                f"{saves[-1]}, {restores[-1]}")
+        mgr.close()
+    finally:
+        _shutil.rmtree(ck_dir, ignore_errors=True)
+
 
 def format_row(tag, parts):
     return f"  {tag:<28} " + "  ".join(parts)

@@ -118,6 +118,36 @@ Schema (documented in docs/OBSERVABILITY.md):
                   cache_dir       str  seeded cache dir (non-empty)
                   entries_seeded  int  entries copied in (>= 0)
                   entries_skipped int  already present (>= 0)
+  kind == "ckpt" (one record per checkpoint save/restore/GC —
+                  distributed/checkpoint.py CheckpointManager;
+                  docs/FAULT_TOLERANCE.md) additionally requires:
+                  op           str     save | restore | gc
+                  step         int     >= 0 optimizer step
+                  dir          str     non-empty checkpoint directory
+  op == "save"    additionally:
+                  snapshot_s   number  >= 0 on-device snapshot phase
+                  serialize_s  number  >= 0 device->host reads (writer)
+                  write_s      number  >= 0 shard-file + manifest IO
+                  commit_s     number  >= 0 COMMIT + atomic rename
+                  total_s      number  >= sum of the four phases (up to
+                                       1 ms rounding: the phases run
+                                       inside the save's wall window)
+                  bytes        int     payload bytes; MUST be > 0 when
+                                       committed (an empty committed
+                                       checkpoint is a lie)
+                  n_leaves     int     >= 1 when committed
+                  committed    bool    the atomic rename happened
+                  and across one file, committed save steps must be
+                  NON-DECREASING per rank (a step counter running
+                  backwards means resume restored the wrong thing)
+  op == "restore" additionally:
+                  verified     bool    manifest+checksums validated
+                  fell_back    int     >= 0 partial/corrupt checkpoints
+                                       skipped on the way
+                  bytes        int     >= 0 payload read
+                  total_s      number  >= 0
+  op == "gc"      additionally:
+                  removed      int     >= 1 checkpoints deleted
   kind == "request" (ONE record per request at its terminal state —
                   the serving observatory's lifecycle ledger,
                   profiler/serve_observatory.py) additionally requires:
@@ -207,6 +237,16 @@ WARM_REQUIRED = {"n_executables": int, "compiled_now": int,
                  "sum_s": (int, float)}
 SEED_REQUIRED = {"source": str, "cache_dir": str, "entries_seeded": int,
                  "entries_skipped": int}
+CKPT_REQUIRED = {"op": str, "step": int, "dir": str}
+CKPT_OPS = {"save", "restore", "gc"}
+CKPT_SAVE_REQUIRED = {"snapshot_s": (int, float),
+                      "serialize_s": (int, float),
+                      "write_s": (int, float), "commit_s": (int, float),
+                      "total_s": (int, float), "bytes": int,
+                      "n_leaves": int, "committed": bool}
+CKPT_RESTORE_REQUIRED = {"verified": bool, "fell_back": int,
+                         "bytes": int, "total_s": (int, float)}
+CKPT_PHASES = ("snapshot_s", "serialize_s", "write_s", "commit_s")
 REQUEST_REQUIRED = {"engine": str, "request_id": str, "outcome": str,
                     "rows": int, "prompt_tokens": int,
                     "prefix_hit_tokens": int, "generated_tokens": int,
@@ -541,6 +581,62 @@ def validate_line(line, where="<line>"):
                             f"{where}: refcounts entry {k!r}: {v!r} "
                             "must be str -> int >= 0")
                         break
+    elif rec.get("kind") == "ckpt":
+        _check_types(rec, CKPT_REQUIRED, where, errors)
+        op = rec.get("op")
+        if isinstance(op, str) and op not in CKPT_OPS:
+            errors.append(f"{where}: ckpt op {op!r} not one of "
+                          f"{sorted(CKPT_OPS)}")
+        if isinstance(rec.get("dir"), str) and not rec["dir"]:
+            errors.append(f"{where}: dir must be non-empty")
+        step = _int_val(rec, "step")
+        if step is not None and step < 0:
+            errors.append(f"{where}: step must be >= 0, got {step}")
+        if op == "save":
+            _check_types(rec, CKPT_SAVE_REQUIRED, where, errors)
+            for key in CKPT_PHASES + ("total_s",):
+                v = _num_val(rec, key)
+                if v is not None and v < 0:
+                    errors.append(f"{where}: {key} must be >= 0, got {v}")
+            phases = [_num_val(rec, k) for k in CKPT_PHASES]
+            total = _num_val(rec, "total_s")
+            if total is not None and all(p is not None for p in phases) \
+                    and sum(phases) > total + 1e-3:
+                errors.append(
+                    f"{where}: ckpt phase seconds {sum(phases):.6f} "
+                    f"exceed total_s {total} — the phases run inside "
+                    "the save's wall window, the clock math is broken")
+            b = _int_val(rec, "bytes")
+            n = _int_val(rec, "n_leaves")
+            if rec.get("committed") is True:
+                if b is not None and b <= 0:
+                    errors.append(
+                        f"{where}: committed save with bytes {b} — an "
+                        "empty committed checkpoint is a lie")
+                if n is not None and n < 1:
+                    errors.append(
+                        f"{where}: committed save with n_leaves {n}")
+            elif b is not None and b < 0:
+                errors.append(f"{where}: bytes must be >= 0, got {b}")
+        elif op == "restore":
+            _check_types(rec, CKPT_RESTORE_REQUIRED, where, errors)
+            for key, lo in (("fell_back", 0), ("bytes", 0)):
+                v = _int_val(rec, key)
+                if v is not None and v < lo:
+                    errors.append(
+                        f"{where}: {key} must be >= {lo}, got {v}")
+            v = _num_val(rec, "total_s")
+            if v is not None and v < 0:
+                errors.append(f"{where}: total_s must be >= 0, got {v}")
+        elif op == "gc":
+            v = _int_val(rec, "removed")
+            if v is None:
+                errors.append(f"{where}: gc record missing int "
+                              "'removed'")
+            elif v < 1:
+                errors.append(
+                    f"{where}: gc record with removed {v} — a GC that "
+                    "deleted nothing must not emit a record")
     elif rec.get("kind") == "seed":
         _check_types(rec, SEED_REQUIRED, where, errors)
         for key in ("source", "cache_dir"):
@@ -642,10 +738,31 @@ def validate_file(path):
     lines = text.splitlines()
     if not any(line.strip() for line in lines):
         return [f"{path}: empty file (no records emitted)"]
+    last_save_step = {}  # rank -> last committed ckpt save step
     for lineno, line in enumerate(lines, 1):
         if not line.strip():
             continue
-        errors.extend(validate_line(line, f"{path}:{lineno}"))
+        where = f"{path}:{lineno}"
+        errors.extend(validate_line(line, where))
+        # cross-line: committed checkpoint save steps must be
+        # non-decreasing per rank (a backwards step counter means the
+        # process resumed from the wrong checkpoint)
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("kind") == "ckpt" and \
+                rec.get("op") == "save" and rec.get("committed") is True:
+            step = _int_val(rec, "step")
+            rank = rec.get("rank")
+            if step is not None:
+                prev = last_save_step.get(rank)
+                if prev is not None and step < prev:
+                    errors.append(
+                        f"{where}: ckpt save step {step} < previous "
+                        f"committed save step {prev} for rank {rank} — "
+                        "the step counter must be monotonic")
+                last_save_step[rank] = step
     return errors
 
 

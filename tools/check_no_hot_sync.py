@@ -36,11 +36,28 @@ import sys
 HOT_REGIONS = {
     "paddle_tpu/jit/api.py": [
         "TrainStep.__call__", "TrainStep._prep", "TrainStep._dispatch",
-        "TrainStep.accumulate", "TrainStep.run_steps"],
+        "TrainStep.accumulate", "TrainStep.run_steps",
+        # the checkpoint snapshot hook: on-device buffer copies only —
+        # the blocking device read belongs to the background writer
+        # (distributed/checkpoint.py _write_one), never the step loop
+        "CheckpointSnapshotMixin.tree_state",
+        "CheckpointSnapshotMixin.snapshot_state"],
     "paddle_tpu/hapi/model.py": [
         "Model.fit", "Model._fit_epochs", "Model._dispatch_micro"],
     "paddle_tpu/distributed/fleet/hybrid_train.py": [
         "HybridTrainStep.__call__", "HybridTrainStep._prep"],
+    # the async checkpoint enqueue path: save() snapshots on device and
+    # hands off to the writer thread — any host<->device sync here
+    # would put checkpointing back on the step loop's critical path.
+    # (_write_one / the writer loop are deliberately NOT fenced: the
+    # writer thread's whole job is the blocking device_get + file IO.)
+    "paddle_tpu/distributed/checkpoint.py": [
+        "CheckpointManager.save", "CheckpointManager._snapshot",
+        "CheckpointManager.busy", "AsyncSaveHandle.done"],
+    "paddle_tpu/distributed/elastic.py": [
+        "ElasticController.on_step"],
+    # fault sites fire inside train-step dispatch: pure host dict math
+    "paddle_tpu/framework/fault_injection.py": ["fire", "active"],
     "paddle_tpu/io/device_prefetch.py": ["*"],
     # the serving engine's scheduler core: the only legitimate blocks
     # are the queue wait and the ONE device read per dispatched batch /
