@@ -443,6 +443,15 @@ def _run():
     tokens_per_sec = batch * seq * iters / dt
     loss_val = round(float(loss.item()), 4)
 
+    # measured device time (the distributed observatory's sampled
+    # probe, PADDLE_TPU_DEVICE_TIME_EVERY — default cadence 16 fires
+    # inside the 30-iter steady loop): median measured step time,
+    # cost-analysis-FLOPs-over-MEASURED-time MFU, and the
+    # collective-overlap fraction — the headline's measured companion
+    # to the two analytic MFU numbers below
+    from paddle_tpu.profiler import dist_observatory as _pdobs
+    device_probe = _pdobs.device_time_summary()
+
     # training-health tail + unified Perfetto trace (ring snapshot —
     # milliseconds; both before the headline print so they ride in it)
     health = step.flush_health() or {}
@@ -511,6 +520,18 @@ def _run():
         "flops_per_step": flops_per_step,
         "mfu_cost_analysis": round(
             flops_per_step * iters / dt / peak, 4) if on_tpu else 0.0,
+        # measured device time (dist_observatory sampled probe): the
+        # first MFU in this repo derived from MEASURED device seconds
+        # instead of XLA cost analysis or 6ND; overlap_fraction is the
+        # share of the measured window not spent in host-visible
+        # collective waits. 0/absent-sample values when the probe never
+        # fired (PADDLE_TPU_DEVICE_TIME_EVERY=0).
+        "step_time_device_s": round(
+            device_probe.get("step_time_device_s", 0.0), 6),
+        "mfu_measured": round(device_probe.get("mfu_measured", 0.0), 4),
+        "overlap_fraction": round(
+            device_probe.get("overlap_fraction", 0.0), 4),
+        "device_probe_samples": int(device_probe.get("samples", 0)),
         # fused multi-tensor update epilogue (ops/pallas/
         # fused_update.py): analytic HBM bytes of the two update passes
         # and their share of the executable's cost-analysis bytes — the
@@ -540,6 +561,25 @@ def _run():
         "phases": dict(_PHASES),
     }
     print(json.dumps(headline), flush=True)
+
+    # persist the measured-device-time trajectory across rounds
+    # (bench_state.json, like ckpt_history) so a probe regression —
+    # measured time drifting away from the throughput-implied time, or
+    # overlap collapsing — shows up in the history, not just one round
+    if device_probe:
+        state = _load_state()
+        hist = state.get("device_time_history", [])
+        hist.append({
+            "step_time_device_s": device_probe["step_time_device_s"],
+            "mfu_measured": device_probe["mfu_measured"],
+            "overlap_fraction": device_probe["overlap_fraction"],
+            "samples": device_probe["samples"],
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "on_tpu": on_tpu,
+            "recorded_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())})
+        state["device_time_history"] = hist[-10:]
+        _save_state(state)
 
     if os.environ.get("BENCH_HOLD_AFTER_PRINT"):
         # test hook: prove the headline survives a kill after measurement

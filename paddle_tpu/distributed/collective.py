@@ -17,6 +17,7 @@ from jax import lax
 from ..framework.core import Tensor, apply_op
 from ..profiler import statistic as _stat
 from ..profiler import monitor as _monitor
+from ..profiler import dist_observatory as _dobs
 from .env import get_mesh
 
 
@@ -42,11 +43,49 @@ def _payload_bytes(args):
     return nbytes
 
 
+def _any_traced(args):
+    """Whether any Tensor/array (or list of them) in `args` is a jax
+    tracer — i.e. this collective call is a trace-time INSERTION, not
+    an eager execution (its host wall time is trace overhead, not
+    communication)."""
+    stack = list(args)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (list, tuple)):
+            stack.extend(t)
+            continue
+        a = t.value if isinstance(t, Tensor) else t
+        if isinstance(a, jax.core.Tracer):
+            return True
+    return False
+
+
+def _group_label(args, kwargs):
+    """The process-group label of one collective call: an explicit
+    Group's axis wins, else the first string/tuple positional (the SPMD
+    functional collectives pass the mesh axis name there), else the
+    default 'dp' axis."""
+    g = kwargs.get("group")
+    for cand in ([g] if g is not None else []) + list(args):
+        if isinstance(cand, Group):
+            return str(cand.axis)
+        if isinstance(cand, str):
+            return cand
+        if isinstance(cand, tuple) and cand and all(
+                isinstance(c, str) for c in cand):
+            return "+".join(cand)
+    return "dp"
+
+
 def _instrumented(fn=None, *, payload=None):
     """Telemetry wrapper for a collective: per-kind call + payload-bytes
-    counters and a host span. Called under trace (inside jit/shard_map)
-    this tallies collectives INSERTED per traced program — once per
-    compile, not per execution; eager calls count one-for-one.
+    counters, a host span, and the distributed observatory's rollup +
+    sampled `kind:"collective"` record (op, group, bytes, wall_s,
+    bus-bandwidth GB/s — profiler/dist_observatory.py). Called under
+    trace (inside jit/shard_map) this tallies collectives INSERTED per
+    traced program — once per compile, not per execution (the record is
+    flagged `traced`); eager calls count one-for-one with real wall
+    time.
 
     `payload` selects which positional args carry the transferred data
     (args -> sequence) for APIs that also take an output placeholder
@@ -62,15 +101,19 @@ def _instrumented(fn=None, *, payload=None):
     def wrapper(*args, **kwargs):
         # bytes BEFORE the call: all_gather/alltoall mutate their list
         # arguments, so counting afterwards would tally outputs too
-        nbytes = _payload_bytes(payload(args) if payload else args)
+        sel = payload(args) if payload else args
+        nbytes = _payload_bytes(sel)
+        traced = _any_traced(sel)
         t0 = time.perf_counter()
         try:
             return fn(*args, **kwargs)
         finally:
-            _stat.record_span(f"collective.{kind}",
-                              time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _stat.record_span(f"collective.{kind}", dt)
             _monitor.counter(f"collective.{kind}.calls").inc()
             _monitor.counter(f"collective.{kind}.bytes").inc(nbytes)
+            _dobs.record_collective(kind, _group_label(args, kwargs),
+                                    nbytes, dt, traced=traced)
     return wrapper
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce",

@@ -83,6 +83,15 @@ def init_parallel_env():
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=int(nproc),
                                        process_id=int(pid or 0))
+        if int(nproc) > 1:
+            # coordinator time-sync handshake (the distributed
+            # observatory): estimate this rank's wall-clock offset vs
+            # rank 0 through the KV store so every exported
+            # trace/record is clock-alignable by tools/merge_traces.py.
+            # Never raises; a failed handshake leaves offset 0.
+            from ..profiler import dist_observatory as _dobs
+            _dobs.clock_sync(client=_jdist.global_state.client,
+                             rank=int(pid or 0), world=int(nproc))
     _state["initialized"] = True
     get_mesh()
     return ParallelEnv()

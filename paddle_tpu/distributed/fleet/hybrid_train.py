@@ -29,7 +29,8 @@ from ...jit.api import (functional_call, state_arrays, aot_compile,
                         count_train_use, export_step_metrics,
                         HealthMonitorMixin, CheckpointSnapshotMixin,
                         fire_step_faults, _step_arg_names,
-                        epilogue_leaf_meta)
+                        epilogue_leaf_meta, device_probe_open,
+                        device_probe_close)
 from ...jit import warm as _warm
 from ...jit.deferred import DeferredLoss
 from ...profiler import statistic as _stat
@@ -527,6 +528,7 @@ class HybridTrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
         if _fault.active():  # fault drills only; two dict reads when off
             batch = fire_step_faults(self, batch)
         sig, args = self._prep(batch, self._step_i)
+        probe = device_probe_open(self, self._step_i)
         _flight.heartbeat(self._step_i)  # watchdog liveness pulse
         _stat.begin_span("fleet.hybrid_step")
         try:
@@ -571,6 +573,8 @@ class HybridTrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
                 loss, self.params, self.opt_state, self.scaler_state = out
         finally:
             dispatch_s = _stat.end_span()
+        device_probe_close(self, self._step_i, probe, loss, info,
+                           compiled_now=compiled_now)
         export_step_metrics(self, dispatch_s, info, compiled_now)
         # non-blocking handle (see jit/deferred.py): the fit loop keeps
         # dispatching while the loss streams back

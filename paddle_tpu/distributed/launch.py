@@ -139,6 +139,13 @@ def _rank_env(args, coordinator, local_rank, restart_count):
     # and an operator-set PADDLE_TPU_DEBUG_DUMP fans out to a per-rank
     # subdirectory so concurrent crash bundles never clobber each other
     env.setdefault("PADDLE_TPU_SIGQUIT_STACKS", "1")
+    # the distributed observatory's rank-skew gather: every rank
+    # snapshots its periodic rankstat into this shared directory and
+    # rank 0 reads the peers to detect stragglers
+    # (profiler/dist_observatory.py); an operator-set dir wins
+    if args.log_dir:
+        env.setdefault("PADDLE_TPU_RANKSTAT_DIR",
+                       os.path.join(args.log_dir, "rankstat"))
     if env.get("PADDLE_TPU_DEBUG_DUMP"):
         env["PADDLE_TPU_DEBUG_DUMP"] = os.path.join(
             env["PADDLE_TPU_DEBUG_DUMP"], f"rank{rank}")

@@ -329,6 +329,15 @@ class Model:
                 micro = []
             if "loss" in logs:  # epoch boundary: the deliberate sync
                 logs["loss"] = _resolve_scalars(logs["loss"])
+            if step > 0:
+                # epoch boundary: publish this rank's skew telemetry
+                # even when the epoch was shorter than the rankstat
+                # cadence (kind:"rankstat" + the rank-0 straggler
+                # gather — profiler/dist_observatory.py); host-side
+                # dict math, never a device read
+                from ..profiler import dist_observatory as _dobs
+                _dobs.emit_rankstat(
+                    step=getattr(self._train_step, "_step_i", steps_done))
             if getattr(self._train_step, "monitor_health", False):
                 # epoch boundary: blocking drain of the pending health
                 # vectors; detectors observe the tail before on_epoch_end

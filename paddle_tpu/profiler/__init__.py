@@ -28,6 +28,7 @@ from . import trace_export
 from . import health
 from . import compile_observatory
 from . import serve_observatory
+from . import dist_observatory
 from .statistic import SortedKeys
 from .health import AnomalyDetector
 
@@ -41,7 +42,7 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "load_profiler_result", "ProfilerResult", "SortedKeys",
            "statistic", "monitor", "cost", "flight_recorder",
            "trace_export", "health", "compile_observatory",
-           "serve_observatory", "AnomalyDetector"]
+           "serve_observatory", "dist_observatory", "AnomalyDetector"]
 
 
 class ProfilerTarget:
@@ -155,7 +156,10 @@ class Profiler:
                    "step_times_s": list(self._step_times),
                    "spans": statistic.snapshot(),
                    "metrics": monitor.metrics_snapshot(),
-                   "compiles": compile_observatory.ledger()}
+                   "compiles": compile_observatory.ledger(),
+                   "collectives": dist_observatory.collectives_tail(),
+                   "rankstats": dist_observatory.rankstats_tail(),
+                   "clock_offset_s": dist_observatory.clock_offset_s()}
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with open(path, "w") as f:
@@ -265,18 +269,24 @@ class RecordEvent:
 class ProfilerResult:
     """Queryable view over exported telemetry: host-span aggregates
     (`spans`, `get`, `total_s`), per-step metric records (`steps`), the
-    metrics registry snapshot (`metrics`), and the compilation ledger
+    metrics registry snapshot (`metrics`), the compilation ledger
     (`compiles` — the raw `kind:"compile"` records; `compile_ledger()`
-    rolls them up per executable tag)."""
+    rolls them up per executable tag), and the distributed
+    observatory's records (`collectives` — sampled `kind:"collective"`
+    timing records; `rankstats` — per-rank `kind:"rankstat"` skew
+    records)."""
 
     def __init__(self, spans=None, metrics=None, steps=None,
-                 step_times_s=None, source=None, compiles=None):
+                 step_times_s=None, source=None, compiles=None,
+                 collectives=None, rankstats=None):
         self.span_tree = spans or []
         self.spans = statistic.flatten(self.span_tree)
         self.metrics = metrics or {}
         self.steps = steps or []
         self.step_times_s = step_times_s or []
         self.compiles = compiles or []
+        self.collectives = collectives or []
+        self.rankstats = rankstats or []
         self.source = source
 
     def get(self, name):
@@ -300,6 +310,8 @@ class ProfilerResult:
                 f"{'...' if len(names) > 8 else ''}), "
                 f"{len(self.steps)} step records, "
                 f"{len(self.compiles)} compile records, "
+                f"{len(self.collectives)} collective records, "
+                f"{len(self.rankstats)} rankstat records, "
                 f"{len(self.metrics)} metrics")
 
     def __repr__(self):
@@ -312,7 +324,9 @@ def load_profiler_result(filename):
     Accepts: a profiler directory (reads its host_stats.json), the
     host_stats.json itself, or a metrics JSONL file written via
     PADDLE_TPU_METRICS_FILE (one JSON object per line; `kind == "step"`
-    records land in `.steps`, `kind == "compile"` in `.compiles`)."""
+    records land in `.steps`, `kind == "compile"` in `.compiles`,
+    `kind == "collective"` in `.collectives`, `kind == "rankstat"` in
+    `.rankstats`)."""
     path = filename
     if os.path.isdir(path):
         path = os.path.join(path, "host_stats.json")
@@ -327,9 +341,13 @@ def load_profiler_result(filename):
                               metrics=payload.get("metrics"),
                               step_times_s=payload.get("step_times_s"),
                               compiles=payload.get("compiles"),
+                              collectives=payload.get("collectives"),
+                              rankstats=payload.get("rankstats"),
                               source=path)
     # JSONL metrics export: one object per line
-    steps, compiles, other = [], [], []
+    by_kind = {"step": [], "compile": [], "collective": [],
+               "rankstat": []}
+    other = []
     for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
         if not line:
@@ -340,13 +358,11 @@ def load_profiler_result(filename):
             raise ValueError(
                 f"{path}:{lineno}: not a host_stats.json export and not "
                 f"valid JSONL ({e})") from None
-        kind = rec.get("kind")
-        if kind == "step":
-            steps.append(rec)
-        elif kind == "compile":
-            compiles.append(rec)
-        else:
-            other.append(rec)
-    result = ProfilerResult(steps=steps, compiles=compiles, source=path)
-    result.records = steps + compiles + other
+        by_kind.get(rec.get("kind"), other).append(rec)
+    result = ProfilerResult(steps=by_kind["step"],
+                            compiles=by_kind["compile"],
+                            collectives=by_kind["collective"],
+                            rankstats=by_kind["rankstat"], source=path)
+    result.records = (by_kind["step"] + by_kind["compile"] +
+                      by_kind["collective"] + by_kind["rankstat"] + other)
     return result

@@ -21,10 +21,16 @@ Detectors (all windowed, all O(1) per step):
   updates (scale is collapsing faster than it can adapt);
 - **retrace_storm** — ≥ `retrace_threshold` fresh compiles within the
   last `retrace_window` observed steps (shape instability: every
-  retrace is a multi-second stall and a new executable).
+  retrace is a multi-second stall and a new executable);
+- **straggler** — `observe_ranks()` (fed by the distributed
+  observatory's rank-0 gather, `dist_observatory.py`): a rank whose
+  step-time p50 exceeds `straggler_factor` × the group median by more
+  than `straggler_min_lag_s` emits an event naming the rank and its
+  lag — the cross-rank skew alarm a synchronous SPMD program turns
+  into everyone's slowdown.
 
-Spike events re-arm only after the signal returns below threshold, so a
-level shift emits ONE event, not one per step.
+Spike and straggler events re-arm only after the signal returns below
+threshold, so a level shift emits ONE event, not one per step.
 """
 import collections
 import math
@@ -46,7 +52,8 @@ class AnomalyDetector:
 
     def __init__(self, window=64, spike_factor=10.0, min_history=8,
                  found_inf_streak=4, retrace_window=20,
-                 retrace_threshold=3):
+                 retrace_threshold=3, straggler_factor=1.5,
+                 straggler_min_lag_s=0.05):
         self.window = int(window)
         self.spike_factor = float(spike_factor)
         self.min_history = int(min_history)
@@ -59,6 +66,9 @@ class AnomalyDetector:
         self._inf_streak = 0
         self._retraces = collections.deque(maxlen=self.retrace_window)
         self._storming = False
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_min_lag_s = float(straggler_min_lag_s)
+        self._rank_straggling = {}  # rank -> bool (edge-triggering)
         self.events = []
 
     # -- emission --------------------------------------------------------
@@ -98,6 +108,41 @@ class AnomalyDetector:
         self._spiking[key] = spiking
         if not spiking:  # a spike must not poison its own baseline
             hist.append(float(value))
+
+    def observe_ranks(self, step, rank_times):
+        """Feed one gathered view of per-rank step times ({rank:
+        step-time p50 seconds} — the distributed observatory's rank-0
+        gather calls this at rankstat cadence). A rank whose time
+        exceeds `straggler_factor` × the group median by more than
+        `straggler_min_lag_s` emits ONE edge-triggered
+        `event:"straggler"` naming the rank, its time, the median, and
+        the lag; the event re-arms only after the rank returns below
+        threshold. Returns the events emitted now."""
+        out = []
+        vals = sorted(v for v in rank_times.values() if _finite(v))
+        if len(vals) < 2:
+            return out
+        # TRUE median (middle pair averaged for even counts): the
+        # upper-middle pick would hand a 2-rank world's straggler its
+        # own time as the baseline, making it structurally undetectable
+        mid = len(vals) // 2
+        med = vals[mid] if len(vals) % 2 else \
+            0.5 * (vals[mid - 1] + vals[mid])
+        floor = max(med * self.straggler_factor,
+                    med + self.straggler_min_lag_s)
+        for rank, v in sorted(rank_times.items()):
+            lagging = _finite(v) and v > floor
+            if lagging and not self._rank_straggling.get(rank, False):
+                # field name straggler_rank, NOT rank: the exported
+                # event record's `rank` is the EMITTING process (rank
+                # 0, the gatherer) and must not be clobbered
+                out.append(self._emit(
+                    "straggler", step, straggler_rank=int(rank),
+                    step_time_s=float(v), median_s=float(med),
+                    lag_s=float(v - med),
+                    world=len(rank_times)))
+            self._rank_straggling[rank] = lagging
+        return out
 
     def observe(self, step, values, retraces=None):
         """Feed one step's resolved health scalars (dict with any of

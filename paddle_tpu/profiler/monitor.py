@@ -12,6 +12,19 @@ actually blocked on a device read, recorded by DeferredLoss; sum via
 the device by the prefetch ring), `prefetch.depth` (gauge — ring fill
 level; pinned at 0 means the step loop is data-bound).
 
+Distributed signals (the distributed observatory,
+profiler/dist_observatory.py — docs/OBSERVABILITY.md "The distributed
+observatory"): `collective.<kind>.calls` / `collective.<kind>.bytes`
+counters (every collective call site), `train.step_time_device_s` /
+`train.mfu_measured` / `train.overlap_fraction` gauges (the sampled
+device-time probe: measured step time, cost-analysis-FLOPs-over-
+MEASURED-time MFU, and the non-collective-wait share of the window),
+`dist.rankstats` counter (per-rank `kind:"rankstat"` records emitted)
+and `dist.stragglers` counter (rank-0 `event:"straggler"` detections).
+The sampled per-collective detail (`kind:"collective"`: op, group,
+bytes, wall_s, bus-bandwidth GB/s) and the periodic `kind:"rankstat"`
+records ride the JSONL exporter below.
+
 Serving signals (the continuous-batching engines, docs/SERVING.md):
 `serve.queue_depth` / `serve.shared_pages` / `serve.kv_free_pages` /
 `serve.kv_held_pages` / `serve.kv_registered_pages` /
@@ -55,7 +68,23 @@ from . import flight_recorder
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "get_metric", "metrics_snapshot", "reset_metrics",
-           "rank", "metrics_file", "export_step", "host_blocked_s"]
+           "rank", "metrics_file", "export_step", "host_blocked_s",
+           "set_clock_offset", "clock_offset"]
+
+# this rank's estimated wall-clock offset vs rank 0 (seconds), set by
+# the distributed observatory's coordinator handshake
+# (dist_observatory.clock_sync at init_parallel_env); stamped onto
+# every exported record when nonzero so tools/merge_traces.py can
+# clock-align per-rank artifacts
+_clock_offset = [0.0]
+
+
+def set_clock_offset(offset_s):
+    _clock_offset[0] = float(offset_s)
+
+
+def clock_offset():
+    return _clock_offset[0]
 
 _lock = threading.RLock()
 _export_lock = threading.Lock()  # file appends only: registry ops must
@@ -243,6 +272,8 @@ def export_step(record, kind="step", _ring=True):
     Returns False when the env var is unset or the write failed; never
     raises — telemetry must not take down a train loop."""
     rec = {"ts": time.time(), "rank": rank(), "kind": kind}
+    if _clock_offset[0]:
+        rec["clock_offset_s"] = _clock_offset[0]
     rec.update(record)
     if _ring:  # events ring-record themselves (flight_recorder)
         flight_recorder.record_record(rec)

@@ -47,7 +47,7 @@ from . import monitor
 
 __all__ = ["chrome_trace_events", "write_chrome_trace",
            "TRAIN_TID", "SERVE_TID", "EVENT_TID", "COMPILE_TID",
-           "REQUEST_TID", "REQUEST_LANES", "CKPT_TID"]
+           "REQUEST_TID", "REQUEST_LANES", "CKPT_TID", "COLLECTIVE_TID"]
 
 # synthetic track ids for record-derived events; real thread idents are
 # pointer-sized on linux, so small ints can never collide with them
@@ -58,6 +58,9 @@ COMPILE_TID = 4
 REQUEST_TID = 5     # first "serving requests" lane
 REQUEST_LANES = 12  # concurrent-request lanes before reuse
 CKPT_TID = 20       # "checkpoint" track (after the request lanes)
+COLLECTIVE_TID = 21  # "collectives" track (sampled kind:"collective"
+                     # records — the cross-rank lane a merged,
+                     # clock-aligned timeline lines up across pids)
 
 
 def _sanitize(obj):
@@ -101,6 +104,9 @@ def chrome_trace_events(snap=None, rank=None):
          "ts": 0, "args": {"name": "compilation"}},
         {"ph": "M", "name": "thread_name", "pid": pid, "tid": CKPT_TID,
          "ts": 0, "args": {"name": "checkpoint"}},
+        {"ph": "M", "name": "thread_name", "pid": pid,
+         "tid": COLLECTIVE_TID, "ts": 0,
+         "args": {"name": "collectives"}},
     ]
     events = []
 
@@ -218,6 +224,35 @@ def chrome_trace_events(snap=None, rank=None):
                             "ts": t * 1e6, "dur": float(d) * 1e6,
                             "pid": pid, "tid": CKPT_TID, "args": {}})
                         t += d
+        elif kind == "collective":
+            # sampled per-collective slices (the distributed
+            # observatory): one X-slice per record on the "collectives"
+            # track, reconstructed backwards from the post-call stamp.
+            # After merge_traces' clock alignment these lanes line up
+            # across rank pids — the cross-rank overlap evidence.
+            dur = rec.get("wall_s", 0.0)
+            dur = max(float(dur), 0.0) if isinstance(dur, (int, float)) \
+                and not isinstance(dur, bool) else 0.0
+            name = f"{rec.get('op', '?')}@{rec.get('group', '?')}"
+            if rec.get("traced"):
+                name += " [traced]"
+            events.append({
+                "name": name, "ph": "X", "cat": "collective",
+                "ts": (ts - dur) * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": COLLECTIVE_TID,
+                "args": _sanitize(rec)})
+        elif kind == "rankstat":
+            # per-rank skew telemetry as counter tracks: step-time
+            # p50/p99 + collective-wait share next to the step slices
+            for key in ("step_time_p50_s", "step_time_p99_s",
+                        "collective_wait_share", "host_blocked_s"):
+                v = rec.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    events.append({
+                        "name": f"rankstat.{key}", "ph": "C",
+                        "cat": "rankstat", "ts": ts * 1e6, "pid": pid,
+                        "tid": 0, "args": {"value": _sanitize(v)}})
         elif kind == "health":
             for key in ("grad_norm", "param_norm", "update_ratio",
                         "loss"):
@@ -298,11 +333,18 @@ def chrome_trace_events(snap=None, rank=None):
 
 def write_chrome_trace(path, snap=None, rank=None, extra=None):
     """Write the trace JSON to `path` and return it. Chrome trace JSON
-    object format: {"traceEvents": [...], "displayTimeUnit": "ms"}."""
+    object format: {"traceEvents": [...], "displayTimeUnit": "ms"}.
+    `otherData.clock_offset_s` carries this rank's estimated wall-clock
+    offset vs rank 0 (the coordinator handshake at init_parallel_env —
+    profiler/dist_observatory.py); `tools/merge_traces.py` subtracts it
+    per input file so a merged multi-rank timeline is clock-aligned."""
+    from . import dist_observatory
     payload = {"traceEvents": chrome_trace_events(snap=snap, rank=rank),
                "displayTimeUnit": "ms",
                "otherData": dict(extra or {},
                                  exporter="paddle_tpu.profiler",
+                                 clock_offset_s=
+                                 dist_observatory.clock_offset_s(),
                                  rank=monitor.rank()
                                  if rank is None else rank)}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

@@ -311,6 +311,42 @@ def emit_workload():
             f"expected kind:'kvcache' snapshots from canonical_gen, "
             f"got {[(r.get('engine'), r.get('kind')) for r in kvs][:5]}")
 
+    # the distributed-observatory contract: the canonical workload must
+    # land ≥1 schema-valid kind:"collective" record (an eager
+    # all_reduce + wait — the first call per op is always sampled) and
+    # ≥1 kind:"rankstat" record (the train steps above emitted one at
+    # the first-step cadence) in the same tier-1-exercised ledger, so
+    # the lint sees real instances of both new kinds
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.profiler import dist_observatory as _dobs
+    ct = paddle.to_tensor(np.ones(1024, np.float32))
+    dist.all_reduce(ct)
+    dist.wait(ct)
+    rs = _dobs.emit_rankstat(force=True)
+    if rs is None:
+        raise AssertionError("emit_rankstat produced no record")
+    colls = _load_kind(mfile, "collective")
+    rstats = _load_kind(mfile, "rankstat")
+    if not colls or not rstats:
+        raise AssertionError(
+            f"expected >=1 kind:'collective' and >=1 kind:'rankstat' "
+            f"record, got {len(colls)} / {len(rstats)}")
+    errs = [e for r in colls + rstats
+            for e in _cms.validate_line(_json.dumps(r))]
+    if errs:
+        raise AssertionError(
+            f"distributed-observatory records violate the schema: "
+            f"{errs[:5]}")
+    ops = {r["op"] for r in colls}
+    if "all_reduce" not in ops:
+        raise AssertionError(
+            f"expected an all_reduce collective record, got ops {ops}")
+    roll = _dobs.collective_rollup()
+    if roll.get("all_reduce", {}).get("bytes", 0) < 4096:
+        raise AssertionError(
+            f"collective rollup did not fold the all_reduce payload: "
+            f"{roll}")
+
     # the fault-tolerance contract: one snapshot-then-write checkpoint
     # save + verified resume on the canonical train step, so tier-1
     # lints REAL kind:"ckpt" records (schema: phases sum <= total,

@@ -71,6 +71,59 @@ Schema (documented in docs/OBSERVABILITY.md):
                   update_ratio  number|str     bare NaN is not JSON;
                   found_inf     number|str     numeric values must be
                                                >= 0 (found_inf: 0 or 1)
+  kind == "collective" (sampled per-collective timing — the
+                  distributed observatory,
+                  profiler/dist_observatory.py, fed by every
+                  paddle.distributed collective wrapper) additionally
+                  requires:
+                  op           str     collective kind (psum,
+                                       all_reduce, ...; non-empty)
+                  group        str     process group / mesh axis label
+                                       (non-empty)
+                  bytes        int     payload bytes (>= 0)
+                  wall_s       number  host wall seconds of the call
+                                       (>= 0)
+                  bw_gbps      number  derived bus bandwidth GB/s
+                                       (>= 0 and FINITE — an infinite
+                                       bandwidth means the zero-time
+                                       guard upstream broke); 0 for
+                                       traced insertions
+                  and optionally:
+                  traced       bool    trace-time insertion, not an
+                                       eager execution
+                  calls        int     >= 1 cumulative calls of this op
+  kind == "rankstat" (periodic per-rank skew telemetry —
+                  profiler/dist_observatory.py emit_rankstat)
+                  additionally requires:
+                  step         int     >= 0 optimizer step at emission
+                  world_size   int     >= 1; the record's rank MUST be
+                                       < world_size (a rank outside
+                                       the world is a launch-env bug)
+                  step_time_p50_s number >= 0 (train.step_s reservoir)
+                  step_time_p99_s number >= p50 (up to rounding)
+                  host_blocked_s  number >= 0
+                  collective_wait_s number >= 0 cumulative eager
+                                       collective wall
+                  collective_wait_share number in [0, 1] — the share
+                                       of stepped wall time spent
+                                       waiting at eager collectives
+                                       (cross-field: the share is
+                                       capped by the step time it is
+                                       measured against)
+                  peak_bytes   int     >= 0 device memory high-water
+                  and optionally:
+                  clock_offset_s number  this rank's clock offset vs
+                                       rank 0 (any sign)
+                  steps_observed int   >= 0
+  kind == "step" optional measured-device-time fields (the sampled
+                  probe, PADDLE_TPU_DEVICE_TIME_EVERY):
+                  step_time_device_s number >= 0 measured drain->ready
+                                       window
+                  mfu_measured number  >= 0, finite — cost-analysis
+                                       FLOPs over MEASURED device time
+                  overlap_fraction number in [0, 1] — share of the
+                                       window not spent in eager
+                                       collective waits
   kind == "event" (structured anomaly/lifecycle events —
                   profiler/flight_recorder.record_event) additionally
                   requires:
@@ -210,6 +263,7 @@ Usage: python tools/check_metrics_schema.py FILE [FILE...]
 Exit 0 when every line of every file validates, 1 otherwise.
 """
 import json
+import math
 import sys
 
 BASE_REQUIRED = {"ts": (int, float), "rank": int, "kind": str}
@@ -257,6 +311,15 @@ KVCACHE_REQUIRED = {"engine": str, "n_pages": int, "free_pages": int,
                     "held_pages": int, "shared_pages": int,
                     "registered_pages": int, "pages_drawn": int,
                     "cow_copies": int, "lru_reclaims": int}
+COLLECTIVE_REQUIRED = {"op": str, "group": str, "bytes": int,
+                       "wall_s": (int, float), "bw_gbps": (int, float)}
+RANKSTAT_REQUIRED = {"step": int, "world_size": int,
+                     "step_time_p50_s": (int, float),
+                     "step_time_p99_s": (int, float),
+                     "host_blocked_s": (int, float),
+                     "collective_wait_s": (int, float),
+                     "collective_wait_share": (int, float),
+                     "peak_bytes": int}
 # a persistent-cache HIT deserializes an artifact instead of compiling;
 # spending more than this on one is a mislabeled cold compile
 CACHE_HIT_COMPILE_S_MAX = 10.0
@@ -320,6 +383,24 @@ def validate_line(line, where="<line>"):
                     or not (0.0 <= v <= 1.0):
                 errors.append(
                     f"{where}: epilogue_share must be a number in "
+                    f"[0, 1], got {v!r}")
+        # measured-device-time probe fields (optional — the sampled
+        # probe stamps them on the step it measured)
+        for key in ("step_time_device_s", "mfu_measured"):
+            if key in rec:
+                v = rec[key]
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool) or v < 0 or \
+                        not math.isfinite(v):
+                    errors.append(
+                        f"{where}: {key} must be a finite number >= 0, "
+                        f"got {v!r}")
+        if "overlap_fraction" in rec:
+            v = rec["overlap_fraction"]
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not (0.0 <= v <= 1.0):
+                errors.append(
+                    f"{where}: overlap_fraction must be a number in "
                     f"[0, 1], got {v!r}")
     elif rec.get("kind") == "serve":
         _check_types(rec, SERVE_REQUIRED, where, errors)
@@ -581,6 +662,79 @@ def validate_line(line, where="<line>"):
                             f"{where}: refcounts entry {k!r}: {v!r} "
                             "must be str -> int >= 0")
                         break
+    elif rec.get("kind") == "collective":
+        _check_types(rec, COLLECTIVE_REQUIRED, where, errors)
+        for key in ("op", "group"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+        b = _int_val(rec, "bytes")
+        if b is not None and b < 0:
+            errors.append(f"{where}: bytes must be >= 0, got {b}")
+        w = _num_val(rec, "wall_s")
+        if w is not None and w < 0:
+            errors.append(f"{where}: wall_s must be >= 0, got {w}")
+        bw = _num_val(rec, "bw_gbps")
+        if bw is not None:
+            if not math.isfinite(bw):
+                errors.append(
+                    f"{where}: bw_gbps must be FINITE, got {bw!r} — an "
+                    "infinite bandwidth means the zero-time guard "
+                    "upstream broke")
+            elif bw < 0:
+                errors.append(f"{where}: bw_gbps must be >= 0, got {bw}")
+        if "traced" in rec and not isinstance(rec["traced"], bool):
+            errors.append(f"{where}: traced must be bool, got "
+                          f"{rec['traced']!r}")
+        c = _int_val(rec, "calls") if "calls" in rec else None
+        if c is not None and c < 1:
+            errors.append(f"{where}: calls must be >= 1, got {c}")
+    elif rec.get("kind") == "rankstat":
+        _check_types(rec, RANKSTAT_REQUIRED, where, errors)
+        step = _int_val(rec, "step")
+        if step is not None and step < 0:
+            errors.append(f"{where}: step must be >= 0, got {step}")
+        world = _int_val(rec, "world_size")
+        if world is not None and world < 1:
+            errors.append(
+                f"{where}: world_size must be >= 1, got {world}")
+        # cross-field: the emitting rank must exist in the world
+        rk = _int_val(rec, "rank")
+        if rk is not None and world is not None and rk >= world:
+            errors.append(
+                f"{where}: rank {rk} >= world_size {world} — a rank "
+                "outside the world means the launch env lies")
+        for key in ("step_time_p50_s", "step_time_p99_s",
+                    "host_blocked_s", "collective_wait_s"):
+            v = _num_val(rec, key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        p50, p99 = _num_val(rec, "step_time_p50_s"), \
+            _num_val(rec, "step_time_p99_s")
+        if p50 is not None and p99 is not None and p99 < p50 - 1e-9:
+            errors.append(
+                f"{where}: step_time_p99_s {p99} < step_time_p50_s "
+                f"{p50} — percentiles cannot invert")
+        share = _num_val(rec, "collective_wait_share")
+        if share is not None and not (0.0 <= share <= 1.0):
+            errors.append(
+                f"{where}: collective_wait_share must be in [0, 1], "
+                f"got {share} — the share is capped by the step time "
+                "it is measured against")
+        pb = _int_val(rec, "peak_bytes")
+        if pb is not None and pb < 0:
+            errors.append(f"{where}: peak_bytes must be >= 0, got {pb}")
+        so = _int_val(rec, "steps_observed") \
+            if "steps_observed" in rec else None
+        if so is not None and so < 0:
+            errors.append(
+                f"{where}: steps_observed must be >= 0, got {so}")
+        if "clock_offset_s" in rec:
+            v = rec["clock_offset_s"]
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not math.isfinite(v):
+                errors.append(
+                    f"{where}: clock_offset_s must be a finite number, "
+                    f"got {v!r}")
     elif rec.get("kind") == "ckpt":
         _check_types(rec, CKPT_REQUIRED, where, errors)
         op = rec.get("op")

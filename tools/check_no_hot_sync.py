@@ -37,6 +37,11 @@ HOT_REGIONS = {
     "paddle_tpu/jit/api.py": [
         "TrainStep.__call__", "TrainStep._prep", "TrainStep._dispatch",
         "TrainStep.accumulate", "TrainStep.run_steps",
+        # the device-time probe (distributed observatory): its TWO
+        # blocking reads are the measurement itself — cadence-gated
+        # (PADDLE_TPU_DEVICE_TIME_EVERY) and explicitly hot-sync-ok
+        # marked; fencing the functions keeps anything else out
+        "device_probe_open", "device_probe_close",
         # the checkpoint snapshot hook: on-device buffer copies only —
         # the blocking device read belongs to the background writer
         # (distributed/checkpoint.py _write_one), never the step loop
@@ -83,6 +88,17 @@ HOT_REGIONS = {
     # hot loop and kvcache snapshots run per step — the whole module
     # must stay pure host arithmetic (no device reads, ever)
     "paddle_tpu/profiler/serve_observatory.py": ["*"],
+    # the distributed observatory: collective rollups fold on every
+    # collective call and the rankstat cadence check runs per step —
+    # the whole module must stay pure host arithmetic (the device-time
+    # probe's two deliberate syncs live in jit/api.py, fenced +
+    # allowlisted there, NOT here)
+    "paddle_tpu/profiler/dist_observatory.py": ["*"],
+    # eager collectives are host-visible waits by design, but the
+    # instrumentation AROUND them must never add a sync of its own
+    "paddle_tpu/distributed/collective.py": [
+        "_instrumented", "_payload_bytes", "_any_traced",
+        "_group_label"],
     # the pool snapshot is called from the decode loop: dict/len math
     # only, never a device read of the page pools
     "paddle_tpu/ops/paged_attention.py": ["PagedKVCache.pool_stats"],

@@ -1,14 +1,24 @@
 #!/usr/bin/env python
-"""Merge per-rank Chrome trace files into ONE timeline.
+"""Merge per-rank Chrome trace files into ONE clock-aligned timeline.
 
 A `paddle_tpu.distributed.launch` run yields one trace file per rank
 (each rank calls `Profiler.export_chrome_tracing(...)`, or the operator
 pulls them from per-rank debug bundles). Every file's events are
-pid-tagged with that rank, and timestamps are unix-epoch microseconds
-(same host ⇒ same clock), so merging is: concatenate, de-conflict pids,
-sort. The merged file opens in Perfetto with one process group per rank
-— the standard way to see a multi-process stall: which rank's step track
-stretched while the others waited at the collective.
+pid-tagged with that rank and timestamps are unix-epoch microseconds,
+so merging is: concatenate, de-conflict pids, CLOCK-ALIGN, sort. The
+merged file opens in Perfetto with one process group per rank — the
+standard way to see a multi-process stall: which rank's step track (or
+collective lane) stretched while the others waited.
+
+Clock alignment: each exported trace carries
+`otherData.clock_offset_s` — the rank's estimated wall-clock offset vs
+rank 0, measured by the coordinator time-sync handshake at
+`init_parallel_env` (profiler/dist_observatory.py clock_sync). The
+merge SUBTRACTS each file's offset from its event timestamps, mapping
+every rank onto rank 0's clock, so cross-rank collective slices that
+really overlapped render overlapped instead of skewed by clock drift.
+`--no-align` keeps the raw per-rank clocks (pre-observatory behavior);
+files without the key merge unshifted either way.
 
 Usage:
     python tools/merge_traces.py -o merged.json rank0.json rank1.json ...
@@ -26,25 +36,43 @@ import sys
 def load_events(path):
     """A trace file's event list (object format {"traceEvents": [...]}
     or the bare-array format chrome also accepts)."""
+    return load_trace(path)[0]
+
+
+def load_trace(path):
+    """(events, clock_offset_s) of one trace file. The offset comes
+    from `otherData.clock_offset_s` (0.0 when absent — bare-array
+    traces and pre-observatory exports merge unshifted)."""
     with open(path) as f:
         payload = json.load(f)
     if isinstance(payload, dict):
         events = payload.get("traceEvents")
         if not isinstance(events, list):
             raise ValueError(f"{path}: no traceEvents array")
-        return events
+        other = payload.get("otherData")
+        off = other.get("clock_offset_s", 0.0) \
+            if isinstance(other, dict) else 0.0
+        if not isinstance(off, (int, float)) or isinstance(off, bool):
+            off = 0.0
+        return events, float(off)
     if isinstance(payload, list):
-        return payload
+        return payload, 0.0
     raise ValueError(f"{path}: not a Chrome trace (object or array)")
 
 
-def merge(event_lists, labels=None):
+def merge(event_lists, labels=None, offsets=None):
     """One sorted event list; colliding pids across files are remapped
     (two single-process traces both claim pid 0 = rank 0) and every
-    process keeps/gains a process_name so tracks stay attributable."""
+    process keeps/gains a process_name so tracks stay attributable.
+    `offsets[i]` (seconds, this file's clock ahead of rank 0) is
+    SUBTRACTED from file i's event timestamps — the clock alignment
+    that makes cross-rank slices comparable. Metadata events (ph "M",
+    ts 0) are never shifted."""
     used_pids = set()
     merged = []
     for i, events in enumerate(event_lists):
+        shift_us = (offsets[i] if offsets and i < len(offsets)
+                    else 0.0) * 1e6
         pids = {e.get("pid", 0) for e in events}
         remap = {}
         for p in sorted(pids, key=lambda x: str(x)):
@@ -57,8 +85,14 @@ def merge(event_lists, labels=None):
         for e in events:
             e = dict(e)
             e["pid"] = remap.get(e.get("pid", 0), e.get("pid", 0))
-            if e.get("ph") == "M" and e.get("name") == "process_name":
-                named.add(e["pid"])
+            if e.get("ph") == "M":
+                # NO metadata event is ever shifted (they carry ts 0,
+                # outside the timeline)
+                if e.get("name") == "process_name":
+                    named.add(e["pid"])
+            elif shift_us and isinstance(e.get("ts"), (int, float)) \
+                    and not isinstance(e.get("ts"), bool):
+                e["ts"] = e["ts"] - shift_us
             merged.append(e)
         for p in sorted(remap.values(), key=str):
             if p not in named:
@@ -86,8 +120,12 @@ def expand_inputs(inputs):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        "merge_traces", description="merge per-rank Chrome trace files")
+        "merge_traces", description="merge per-rank Chrome trace files "
+                                    "into one clock-aligned timeline")
     ap.add_argument("-o", "--output", required=True)
+    ap.add_argument("--no-align", action="store_true",
+                    help="keep raw per-rank clocks (skip the "
+                         "otherData.clock_offset_s correction)")
     ap.add_argument("inputs", nargs="+",
                     help="trace files, or directories of *.json")
     args = ap.parse_args(argv)
@@ -95,21 +133,28 @@ def main(argv=None):
     if not paths:
         print("merge_traces: no input trace files", file=sys.stderr)
         return 2
-    lists = []
+    lists, offsets = [], []
     for p in paths:
         try:
-            lists.append(load_events(p))
+            events, off = load_trace(p)
         except (OSError, ValueError, json.JSONDecodeError) as e:
             print(f"merge_traces: {e}", file=sys.stderr)
             return 2
-    merged = merge(lists, labels=[os.path.basename(p) for p in paths])
+        lists.append(events)
+        offsets.append(0.0 if args.no_align else off)
+    merged = merge(lists, labels=[os.path.basename(p) for p in paths],
+                   offsets=offsets)
     out = os.path.abspath(args.output)
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
         json.dump({"traceEvents": merged, "displayTimeUnit": "ms",
-                   "otherData": {"merged_from": paths}}, f)
-    print(f"merged {len(paths)} trace(s), {len(merged)} events -> "
-          f"{args.output}")
+                   "otherData": {"merged_from": paths,
+                                 "clock_offsets_s": offsets,
+                                 "clock_aligned": not args.no_align}},
+                  f)
+    aligned = sum(1 for o in offsets if o)
+    print(f"merged {len(paths)} trace(s), {len(merged)} events "
+          f"({aligned} clock-shifted) -> {args.output}")
     return 0
 
 

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""obs_report — the one-command answer to "what happened in this run".
+
+Renders a human summary from a paddle_tpu metrics JSONL file (the
+PADDLE_TPU_METRICS_FILE export — docs/OBSERVABILITY.md): training step
+rollup (+ measured device time when the probe sampled), the compile
+ledger per executable, the serving SLO/goodput rollup, the distributed
+observatory's collective top-k by wall time and per-rank skew table,
+and every anomaly event (stragglers, spikes, retraces, NaNs) in order.
+
+Plain json + arithmetic — no framework import, so it runs anywhere the
+JSONL landed (a laptop holding a pulled rank log included).
+
+Usage: python tools/obs_report.py METRICS.jsonl [--top N]
+Exit 0 on a rendered report, 2 on unreadable input.
+"""
+import argparse
+import json
+import sys
+
+
+def load_records(path):
+    recs = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a torn tail line must not kill the report
+            if isinstance(rec, dict):
+                recs.append(rec)
+    return recs
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _fmt_s(v):
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def section_steps(recs, out):
+    steps = [r for r in recs if r.get("kind") == "step"]
+    scans = [r for r in recs if r.get("kind") == "scan"]
+    if not steps and not scans:
+        return
+    out.append("== training ==")
+    if steps:
+        times = sorted(float(r.get("step_time_s", 0.0)) for r in steps)
+        compile_s = sum(float(r.get("compile_s", 0.0)) for r in steps)
+        mfus = [float(r.get("mfu", 0.0)) for r in steps
+                if r.get("mfu", 0.0)]
+        out.append(
+            f"  {len(steps)} steps  wall {sum(times):.2f}s  "
+            f"p50 {_fmt_s(_pct(times, 50))}  p99 {_fmt_s(_pct(times, 99))}"
+            f"  compile {compile_s:.2f}s")
+        if mfus:
+            out.append(f"  mfu (cost analysis, last): {mfus[-1]:.4f}")
+        probes = [r for r in steps if "step_time_device_s" in r]
+        if probes:
+            dts = sorted(float(r["step_time_device_s"]) for r in probes)
+            mm = [float(r.get("mfu_measured", 0.0)) for r in probes]
+            ov = [float(r.get("overlap_fraction", 0.0)) for r in probes]
+            out.append(
+                f"  measured device time ({len(probes)} probes): "
+                f"p50 {_fmt_s(_pct(dts, 50))}  "
+                f"mfu_measured {_pct(sorted(mm), 50):.4f}  "
+                f"overlap {_pct(sorted(ov), 50):.3f}")
+    if scans:
+        n = sum(int(r.get("steps", 0)) for r in scans)
+        out.append(f"  {len(scans)} scanned segments ({n} steps)")
+    out.append("")
+
+
+def section_compiles(recs, out, top):
+    comps = [r for r in recs if r.get("kind") == "compile"]
+    if not comps:
+        return
+    by_tag = {}
+    for r in comps:
+        t = by_tag.setdefault(r.get("tag", "?"),
+                              {"n": 0, "s": 0.0, "hits": 0})
+        t["n"] += 1
+        t["s"] += float(r.get("lower_s", 0.0)) + \
+            float(r.get("compile_s", 0.0))
+        t["hits"] += 1 if r.get("cache_hit") else 0
+    out.append(f"== compiles ==  ({len(comps)} records, "
+               f"{sum(t['s'] for t in by_tag.values()):.2f}s total)")
+    rows = sorted(by_tag.items(), key=lambda kv: -kv[1]["s"])[:top]
+    for tag, t in rows:
+        out.append(f"  {tag:<28} {t['s']:>8.2f}s  "
+                   f"x{t['n']}  cache hits {t['hits']}/{t['n']}")
+    out.append("")
+
+
+def section_serve(recs, out):
+    reqs = [r for r in recs if r.get("kind") == "request"]
+    if not reqs:
+        return
+    outcomes = {}
+    for r in reqs:
+        outcomes[r.get("outcome", "?")] = \
+            outcomes.get(r.get("outcome", "?"), 0) + 1
+    gen = sum(int(r.get("generated_tokens", 0)) for r in reqs)
+    good = sum(int(r.get("generated_tokens", 0)) for r in reqs
+               if r.get("outcome") == "completed")
+    dl = [r for r in reqs if "deadline_met" in r]
+    met = sum(1 for r in dl if r.get("deadline_met"))
+    lats = sorted(float(r.get("latency_s", 0.0)) for r in reqs)
+    out.append(f"== serving ==  ({len(reqs)} requests)")
+    out.append("  outcomes: " + "  ".join(
+        f"{k}={v}" for k, v in sorted(outcomes.items())))
+    out.append(f"  latency p50 {_fmt_s(_pct(lats, 50))}  "
+               f"p99 {_fmt_s(_pct(lats, 99))}")
+    waste = gen - good
+    out.append(f"  tokens: goodput {good}  wasted {waste}")
+    if dl:
+        out.append(f"  slo attainment: {met}/{len(dl)} "
+                   f"({met / len(dl):.3f})")
+    out.append("")
+
+
+def section_collectives(recs, out, top):
+    colls = [r for r in recs if r.get("kind") == "collective"]
+    if not colls:
+        return
+    by_op = {}
+    for r in colls:
+        t = by_op.setdefault(r.get("op", "?"),
+                             {"n": 0, "s": 0.0, "b": 0, "bw": []})
+        t["n"] += 1
+        t["s"] += float(r.get("wall_s", 0.0))
+        t["b"] += int(r.get("bytes", 0))
+        bw = float(r.get("bw_gbps", 0.0))
+        if bw > 0:
+            t["bw"].append(bw)
+    out.append(f"== collectives ==  ({len(colls)} sampled records; "
+               f"top {top} by sampled wall time)")
+    rows = sorted(by_op.items(), key=lambda kv: -kv[1]["s"])[:top]
+    for op, t in rows:
+        bw = sorted(t["bw"])
+        bw_txt = f"  bw p50 {_pct(bw, 50):.2f} GB/s" if bw else ""
+        out.append(f"  {op:<16} {t['s'] * 1e3:>9.3f}ms sampled  "
+                   f"x{t['n']}  {t['b']} bytes{bw_txt}")
+    out.append("")
+
+
+def section_ranks(recs, out):
+    rstats = [r for r in recs if r.get("kind") == "rankstat"]
+    if not rstats:
+        return
+    latest = {}
+    for r in rstats:
+        latest[r.get("rank", 0)] = r  # file order: last wins
+    out.append(f"== ranks ==  ({len(rstats)} rankstat records, "
+               f"{len(latest)} rank(s))")
+    for rank in sorted(latest):
+        r = latest[rank]
+        out.append(
+            f"  rank {rank}: step p50 "
+            f"{_fmt_s(float(r.get('step_time_p50_s', 0.0)))}  "
+            f"p99 {_fmt_s(float(r.get('step_time_p99_s', 0.0)))}  "
+            f"coll wait {float(r.get('collective_wait_share', 0.0)):.3f}"
+            f"  blocked {_fmt_s(float(r.get('host_blocked_s', 0.0)))}  "
+            f"clock {float(r.get('clock_offset_s', 0.0)) * 1e3:+.1f}ms")
+    out.append("")
+
+
+def section_events(recs, out, top):
+    evs = [r for r in recs if r.get("kind") == "event"]
+    if not evs:
+        return
+    stragglers = [e for e in evs if e.get("event") == "straggler"]
+    out.append(f"== events ==  ({len(evs)} total, "
+               f"{len(stragglers)} straggler(s))")
+    for e in stragglers:
+        out.append(
+            f"  STRAGGLER rank {e.get('straggler_rank', '?')} at step "
+            f"{e.get('step', '?')}: "
+            f"{_fmt_s(float(e.get('step_time_s', 0.0)))} vs median "
+            f"{_fmt_s(float(e.get('median_s', 0.0)))} "
+            f"(lag {_fmt_s(float(e.get('lag_s', 0.0)))})")
+    others = [e for e in evs if e.get("event") != "straggler"]
+    counts = {}
+    for e in others:
+        counts[e.get("event", "?")] = counts.get(e.get("event", "?"), 0) + 1
+    if counts:
+        out.append("  other: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(counts.items())))
+    out.append("")
+
+
+def render(recs, top=5):
+    out = []
+    ranks = sorted({r.get("rank", 0) for r in recs})
+    kinds = {}
+    for r in recs:
+        kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+    out.append(f"run summary: {len(recs)} records, rank(s) "
+               f"{','.join(str(r) for r in ranks)}  [" + "  ".join(
+                   f"{k}:{v}" for k, v in sorted(kinds.items())) + "]")
+    out.append("")
+    section_steps(recs, out)
+    section_compiles(recs, out, top)
+    section_serve(recs, out)
+    section_collectives(recs, out, top)
+    section_ranks(recs, out)
+    section_events(recs, out, top)
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        "obs_report", description="human run summary from a paddle_tpu "
+                                  "metrics JSONL")
+    ap.add_argument("files", nargs="+", help="metrics JSONL file(s) — "
+                    "several rank files render as one run")
+    ap.add_argument("--top", type=int, default=5,
+                    help="rows per top-k table (default 5)")
+    args = ap.parse_args(argv)
+    recs = []
+    for path in args.files:
+        try:
+            recs.extend(load_records(path))
+        except OSError as e:
+            print(f"obs_report: {e}", file=sys.stderr)
+            return 2
+    if not recs:
+        print("obs_report: no records in input", file=sys.stderr)
+        return 2
+    sys.stdout.write(render(recs, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
