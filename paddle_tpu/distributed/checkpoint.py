@@ -145,9 +145,9 @@ class AsyncSaveHandle:
         return self.result(timeout)
 
     def _resolve(self, path=None, record=None, error=None):
-        self.path = path
+        self.path = path  # lint-ok[unlocked-shared-state]: published before _done.set(); result() reads only after _done.wait() — Event happens-before
         self.record = record
-        self.error = error
+        self.error = error  # lint-ok[unlocked-shared-state]: same Event happens-before as path: set before _done.set(), read after wait()
         self._done.set()
 
 
@@ -237,7 +237,7 @@ class CheckpointManager:
 
     def busy(self):
         """True while the writer has queued or in-flight work."""
-        return self._pending > 0
+        return self._pending > 0  # lint-ok[unlocked-shared-state]: GIL-atomic int read of a gate-guarded counter; busy()/wait() poll, staleness only extends the poll by one tick
 
     def wait(self, timeout=None):
         """Block until every queued write has committed (or failed).
@@ -278,7 +278,7 @@ class CheckpointManager:
             finally:
                 self._writing = False
                 with self._writer_gate:
-                    self._pending -= 1
+                    self._pending -= 1  # lint-ok[unlocked-shared-state]: busy()/wait() read _pending WITHOUT the gate on purpose — they sit on the step loop's hot path (hot-sync fenced) and a GIL-atomic int read tolerates staleness; writes stay serialized under the gate
 
     def _write_one(self, tree, step, t0, snapshot_s, handle):
         from jax.tree_util import tree_flatten_with_path, keystr
@@ -396,7 +396,7 @@ class CheckpointManager:
                    "bytes": int(total_bytes),
                    "n_leaves": int(n_leaves),
                    "committed": True}
-            self.last_save_record = rec
+            self.last_save_record = rec  # lint-ok[unlocked-shared-state]: atomic reference publish of a fresh dict; debug_state is the watchdog's diagnosis path and must never wait on the writer's locks
             _monitor.export_step(rec, kind="ckpt")
             _monitor.counter("ckpt.saves").inc()
             _monitor.counter("ckpt.bytes").inc(int(total_bytes))
@@ -408,7 +408,7 @@ class CheckpointManager:
         except BaseException as e:
             if tmp:
                 shutil.rmtree(tmp, ignore_errors=True)
-            self.last_error = e
+            self.last_error = e  # lint-ok[unlocked-shared-state]: atomic reference publish, never cleared; the lock-free debug_state read sees the old or the new error, both valid
             rec = {"op": "save", "step": int(step),
                    "dir": self.directory, "path": tmp or self.directory,
                    "snapshot_s": round(snapshot_s, 6),
@@ -420,7 +420,7 @@ class CheckpointManager:
                    "n_leaves": int(n_leaves),
                    "committed": False,
                    "error": f"{type(e).__name__}: {e}"[:300]}
-            self.last_save_record = rec
+            self.last_save_record = rec  # lint-ok[unlocked-shared-state]: atomic reference publish of a fresh dict (failure branch), same as the success-path publish above
             _monitor.export_step(rec, kind="ckpt")
             _monitor.counter("ckpt.save_failures").inc()
             _flight.record_event("ckpt_save_failed", step=int(step),

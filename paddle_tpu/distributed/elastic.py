@@ -62,7 +62,7 @@ class ElasticController:
         (falling back past partial/corrupt ones); returns the resumed
         step (0 when starting fresh)."""
         restored = self.manager.restore(self.step_obj)
-        self._last_progress = time.time()
+        self._last_progress = time.time()  # lint-ok[unlocked-shared-state]: GIL-atomic float heartbeat; the watchdog thread tolerates a stale read by design (it re-checks every timeout/4)
         if restored is not None:
             # resuming exactly onto a save boundary must not re-save it
             self._last_saved = restored
@@ -80,7 +80,7 @@ class ElasticController:
         copy and the write happens on the background writer thread; a
         writer still busy with the previous checkpoint skips this save
         instead of queueing snapshots."""
-        self._last_progress = time.time()
+        self._last_progress = time.time()  # lint-ok[unlocked-shared-state]: GIL-atomic float heartbeat, same contract as the maybe_resume stamp — the watchdog tolerates staleness
         s = int(self.step_obj._step_i)
         if s > 0 and s % self.save_every == 0 and s != self._last_saved:
             self._last_saved = s

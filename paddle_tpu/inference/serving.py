@@ -332,10 +332,9 @@ class _SchedulerLifecycle:
         self._thread.join(timeout=10)
 
     def __del__(self):
-        cv = getattr(self, "_cv", None)  # __init__ may have raised early
-        if cv is None:
-            return
-        with cv:
+        if getattr(self, "_cv", None) is None:
+            return  # __init__ raised before the lock existed
+        with self._cv:
             self._stopping = True
             # weakrefs were cleared before __del__, so the scheduler
             # thread is exiting (or already gone) and will never claim
@@ -343,7 +342,7 @@ class _SchedulerLifecycle:
             # callers blocked in Future.result() fail loudly instead
             # of hanging forever
             doomed = self._take_outstanding()
-            cv.notify_all()
+            self._cv.notify_all()
         self._reject_detached(
             doomed, EngineStopped("engine abandoned without shutdown()"))
 
@@ -553,7 +552,7 @@ class InferenceEngine(_SchedulerLifecycle):
                     self.retraces += 1
                     _monitor.counter("serve.retraces").inc()
 
-        return _warm.submit_cached(self._exec, sig, tag, thunk,
+        return _warm.submit_cached(self._exec, sig, tag, thunk,  # lint-ok[unlocked-shared-state]: GIL-atomic attribute load passes the dict reference; membership changes stay under _compile_lock in install
                                    install=install, inline=inline)
 
     def _bucket_specs(self, arrays, b):
@@ -816,7 +815,7 @@ class InferenceEngine(_SchedulerLifecycle):
         _monitor.export_step(
             {"engine": self.name, "requests": len(batch),
              "batch_size": rows, "bucket_batch": b,
-             "queue_depth": len(self._buf), "pad_tokens": int(pad_elems),
+             "queue_depth": len(self._buf), "pad_tokens": int(pad_elems),  # lint-ok[unlocked-shared-state]: GIL-atomic len() for telemetry; the deque object is never replaced, staleness is one request
              "latency_s": lat_sum / len(batch)}, kind="serve")
 
     # -- lifecycle -------------------------------------------------------
@@ -1280,7 +1279,7 @@ class GenerationEngine(_SchedulerLifecycle):
                 _monitor.histogram("serve.ttft_s").observe(
                     time.perf_counter() - handle.t_submit)
                 self._sync_retraces()
-                self._active.append(seq)
+                self._active.append(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; other threads only take GIL-atomic list()/len() snapshots (load_report, _note_kv_step extras)
                 self._emit(seq, tok)
             finally:
                 with self._cv:
@@ -1317,14 +1316,14 @@ class GenerationEngine(_SchedulerLifecycle):
         width = self._pow2(max(self.cache.pages_held(s) for s in sids))
         computed = int(pad_to) * width * self.cache.page_size
         useful = sum(l + 1 for l in lens)
-        self._attn_computed += computed
-        self._attn_useful += useful
+        self._attn_computed += computed  # lint-ok[unlocked-shared-state]: loop-thread-owned monotonic counter; pad_token_fraction's lock-free read tolerates a one-step-stale ratio
+        self._attn_useful += useful  # lint-ok[unlocked-shared-state]: paired with _attn_computed above — same single-writer telemetry counter
         _monitor.histogram("serve.batch_size").observe(b)
         _monitor.counter("serve.pad_tokens").inc(int(pad_to - b))
         _monitor.export_step(
             {"engine": self.name, "requests": b, "batch_size": b,
              "bucket_batch": int(pad_to),
-             "queue_depth": len(self._pending),
+             "queue_depth": len(self._pending),  # lint-ok[unlocked-shared-state]: GIL-atomic len() in the loop thread's telemetry export; worst case one submit of staleness
              "pad_tokens": int(pad_to - b),
              "pad_token_fraction": max(0.0, 1.0 - useful / computed),
              "prefix_hits": 0, "shared_pages": 0,
@@ -1420,7 +1419,7 @@ class GenerationEngine(_SchedulerLifecycle):
                     self._step_prefix_hits += cached
                     if handle.trace is not None:
                         handle.trace.note_prefix(cached)
-                self._prefilling.append(
+                self._prefilling.append(  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers snapshot via GIL-atomic list() (load_report) or len()
                     _ActiveSeq(sid, handle, need, cached=cached))
             finally:
                 with self._cv:
@@ -1438,7 +1437,7 @@ class GenerationEngine(_SchedulerLifecycle):
         for s in list(self._prefilling):  # cancelled mid-prefill: evict
             if s.handle.future.cancelled():
                 self.cache.free_sequence(s.sid)
-                self._prefilling.remove(s)
+                self._prefilling.remove(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers take GIL-atomic list() snapshots, remove() is C-level atomic
                 if s.handle.trace is not None:
                     s.handle.trace.finish("cancelled")
                 s.handle._close()
@@ -1482,8 +1481,8 @@ class GenerationEngine(_SchedulerLifecycle):
              for sid, toks in rows])
         computed = int(ragged_work_plan(bounds, P).sum()) * P
         useful = int(bounds.sum())
-        self._attn_computed += computed
-        self._attn_useful += useful
+        self._attn_computed += computed  # lint-ok[unlocked-shared-state]: loop-thread-owned monotonic counter (ragged site), same contract as the bucketed decode site
+        self._attn_useful += useful  # lint-ok[unlocked-shared-state]: paired with _attn_computed above — same single-writer telemetry counter
         _, nxt = self.model.paged_ragged_step(
             self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b)
         nxt.copy_to_host_async()  # overlap with the bookkeeping below
@@ -1531,10 +1530,10 @@ class GenerationEngine(_SchedulerLifecycle):
             # still-generating sequence registering its partial tail
             # page would copy-on-write its own next decode token, an
             # extra page draw its admission reservation never counted)
-            self._prefilling.remove(s)
+            self._prefilling.remove(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; promote-to-active handoff stays on the one loop thread
             _monitor.histogram("serve.ttft_s").observe(
                 now - s.handle.t_submit)
-            self._active.append(s)
+            self._active.append(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers take GIL-atomic list() snapshots (load_report)
             self._emit(s, tok)
         self._note_kv_step()
 
@@ -1552,7 +1551,7 @@ class GenerationEngine(_SchedulerLifecycle):
         live = self.cache.n_pages - 1 - self.cache.n_free_pages() \
             - self.cache.n_evictable_pages()
         if live > self._kv_peak_held:
-            self._kv_peak_held = live
+            self._kv_peak_held = live  # lint-ok[unlocked-shared-state]: loop-thread-owned peak watermark; kv_peak_occupancy's lock-free read tolerates one stale step
         if (self._step_i - 1) % self.kv_snapshot_every == 0:
             _obs.record_pool_stats(
                 self.name, self.cache,
@@ -1671,7 +1670,7 @@ class GenerationEngine(_SchedulerLifecycle):
         h = seq.handle
         if h.future.cancelled():
             self.cache.free_sequence(seq.sid)
-            self._active.remove(seq)
+            self._active.remove(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (cancel eviction); remove() is C-level atomic under the GIL
             if h.trace is not None:  # tokens already generated = waste
                 h.trace.finish("cancelled")
             h._close()
@@ -1696,7 +1695,7 @@ class GenerationEngine(_SchedulerLifecycle):
             if self.prefix_cache and seq.filled >= h.prompt.size:
                 self.cache.register_prefix(seq.sid, h.prompt)
             self.cache.free_sequence(seq.sid)
-            self._active.remove(seq)
+            self._active.remove(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (completion retirement); remove() is C-level atomic under the GIL
             _monitor.histogram("serve.latency_s").observe(
                 time.perf_counter() - h.t_submit)
             if h.trace is not None:  # record exists before result lands

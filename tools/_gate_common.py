@@ -385,6 +385,40 @@ def emit_workload():
     finally:
         _shutil.rmtree(ck_dir, ignore_errors=True)
 
+    # the static-analysis contract: the canonical workload runs
+    # paddlelint (tools/paddlelint.py — docs/STATIC_ANALYSIS.md) over
+    # the repo and lands its findings as `kind:"lint"` records in the
+    # same tier-1-exercised ledger the gates read. The repo must be
+    # CLEAN (zero unsuppressed findings) and the ledger must carry >=1
+    # schema-valid lint record (the suppressed findings with their
+    # reasons — an empty lint section would mean the linter silently
+    # stopped looking)
+    import paddlelint as _plint
+    lint_findings, _ = _plint.run_passes(REPO)
+    unsup = [f for f in lint_findings if not f.suppressed]
+    if unsup:
+        raise AssertionError(
+            f"paddlelint found {len(unsup)} unsuppressed finding(s) "
+            f"at HEAD; first: {unsup[0].render()}")
+    for lrec in _plint.records(lint_findings):
+        _pmon.export_step(
+            {k: v for k, v in lrec.items()
+             if k not in ("ts", "rank", "kind")}, kind="lint")
+    lints = _load_kind(mfile, "lint")
+    if not lints:
+        raise AssertionError(
+            "expected >=1 kind:'lint' record in the canonical ledger "
+            "(paddlelint emitted none — did the fileset walk break?)")
+    errs = [e for r in lints for e in _cms.validate_line(_json.dumps(r))]
+    if errs:
+        raise AssertionError(
+            f"lint records violate the schema: {errs[:5]}")
+    if not any(r.get("suppressed") and r.get("reason") for r in lints):
+        raise AssertionError(
+            "expected at least one suppressed lint finding carrying "
+            "its reason (the hot-sync allowlist alone guarantees "
+            "several at HEAD)")
+
 
 def format_row(tag, parts):
     return f"  {tag:<28} " + "  ".join(parts)

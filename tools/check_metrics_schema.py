@@ -164,6 +164,28 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        under sum_s is the overlap proof
                   and optionally:
                   tags         list    executable tags (non-empty strs)
+  kind == "lint" (one record per static-analysis finding —
+                  tools/paddlelint.py, docs/STATIC_ANALYSIS.md;
+                  suppressed findings are exported too: the ledger
+                  accounts for every deliberate exemption)
+                  additionally requires:
+                  pass         str     pass name from the KNOWN set
+                                       (lock-order, blocking-under-
+                                       lock, unlocked-shared-state,
+                                       use-after-donate, hot-sync,
+                                       suppression)
+                  rule         str     non-empty violated-rule slug
+                  file         str     non-empty repo-relative path
+                  line         int     >= 0 (0 = whole-file finding)
+                  severity     str     error | warning
+                  message      str     non-empty human verdict
+                  suppressed   bool    exempted via lint-ok /
+                                       hot-sync-ok / a pass region
+                                       table; suppressed=true REQUIRES
+                                       a non-empty `reason` string (a
+                                       reasonless suppression is the
+                                       exact failure mode the linter
+                                       exists to prevent)
   kind == "seed" (one record per compile-cache seeding —
                   framework/compile_cache.seed_from) additionally
                   requires:
@@ -291,6 +313,15 @@ WARM_REQUIRED = {"n_executables": int, "compiled_now": int,
                  "sum_s": (int, float)}
 SEED_REQUIRED = {"source": str, "cache_dir": str, "entries_seeded": int,
                  "entries_skipped": int}
+LINT_REQUIRED = {"pass": str, "rule": str, "file": str, "line": int,
+                 "severity": str, "message": str, "suppressed": bool}
+# mirror of tools/lint/__init__.py KNOWN_PASS_NAMES (this tool stays a
+# standalone no-import diff; tests/test_static_analysis.py asserts the
+# two sets never drift)
+LINT_PASSES = {"lock-order", "blocking-under-lock",
+               "unlocked-shared-state", "use-after-donate", "hot-sync",
+               "suppression"}
+LINT_SEVERITIES = {"error", "warning"}
 CKPT_REQUIRED = {"op": str, "step": int, "dir": str}
 CKPT_OPS = {"save", "restore", "gc"}
 CKPT_SAVE_REQUIRED = {"snapshot_s": (int, float),
@@ -791,6 +822,29 @@ def validate_line(line, where="<line>"):
                 errors.append(
                     f"{where}: gc record with removed {v} — a GC that "
                     "deleted nothing must not emit a record")
+    elif rec.get("kind") == "lint":
+        _check_types(rec, LINT_REQUIRED, where, errors)
+        p = rec.get("pass")
+        if isinstance(p, str) and p not in LINT_PASSES:
+            errors.append(f"{where}: lint pass {p!r} not one of "
+                          f"{sorted(LINT_PASSES)}")
+        for key in ("rule", "file", "message"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+        ln = _int_val(rec, "line")
+        if ln is not None and ln < 0:
+            errors.append(f"{where}: line must be >= 0, got {ln}")
+        sev = rec.get("severity")
+        if isinstance(sev, str) and sev not in LINT_SEVERITIES:
+            errors.append(f"{where}: severity {sev!r} not one of "
+                          f"{sorted(LINT_SEVERITIES)}")
+        if rec.get("suppressed") is True:
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r.strip():
+                errors.append(
+                    f"{where}: suppressed lint finding with no reason "
+                    "— a suppression must say WHY (got "
+                    f"{r!r})")
     elif rec.get("kind") == "seed":
         _check_types(rec, SEED_REQUIRED, where, errors)
         for key in ("source", "cache_dir"):

@@ -1,205 +1,34 @@
 #!/usr/bin/env python
-"""Static lint: no host synchronization in the designated hot-loop code.
+"""Static lint: no host synchronization in the designated hot-loop code
+— THIN SHIM over the paddlelint hot-sync pass (tools/lint/hot_sync.py).
 
-The async step pipeline (device prefetch ring, deferred loss handles,
-scanned accumulation — docs/PERFORMANCE.md "Hiding the host") only works
-while the steady-state loop never blocks the host on the device. This
-tool is the regression fence: it fails when a blocking read —
-`.item()`, `float(`, `.numpy()`, `block_until_ready` — appears inside a
-designated hot region. tests/test_async_pipeline.py runs it (like
-tools/check_metrics_schema.py), so a sync can't silently creep back into
-a step path.
-
-Hot regions (file -> function/method names; "*" = whole module):
-
-  paddle_tpu/jit/api.py                       TrainStep dispatch paths
-  paddle_tpu/hapi/model.py                    the fit loop
-  paddle_tpu/distributed/fleet/hybrid_train.py  hybrid dispatch paths
-  paddle_tpu/io/device_prefetch.py            the whole ring
-  paddle_tpu/inference/serving.py             dispatcher + decode loops
-
-Allowlist: a line ending with a `# hot-sync-ok: <why>` comment is
-exempt — for host-side arithmetic that merely *looks* like a sync
-(`float(perf_counter_delta)`), never for an actual device read in a hot
-path. Multi-line string constants (docstrings) are skipped. A region
-name that no longer resolves is itself a violation: renaming a hot
-function must move the fence with it.
+The region table, sync patterns, `# hot-sync-ok: <why>` allowlist and
+check_source/check_repo semantics live in the framework pass now (PR
+"paddlelint": docs/STATIC_ANALYSIS.md has the pass catalog and the
+folded-in region table). This CLI keeps its historical contract
+byte-for-byte — same stdout, same exit codes — so existing callers
+(tests/test_async_pipeline.py and friends, CI scripts) run unchanged:
 
 Usage: python tools/check_no_hot_sync.py [REPO_ROOT]
 Exit 0 clean, 1 violations.
+
+Prefer `python tools/paddlelint.py --select hot-sync` for new
+callers: same verdicts, plus the kind:"lint" findings JSONL and the
+suppression/baseline accounting.
 """
-import ast
 import os
-import re
 import sys
 
-HOT_REGIONS = {
-    "paddle_tpu/jit/api.py": [
-        "TrainStep.__call__", "TrainStep._prep", "TrainStep._dispatch",
-        "TrainStep.accumulate", "TrainStep.run_steps",
-        # the device-time probe (distributed observatory): its TWO
-        # blocking reads are the measurement itself — cadence-gated
-        # (PADDLE_TPU_DEVICE_TIME_EVERY) and explicitly hot-sync-ok
-        # marked; fencing the functions keeps anything else out
-        "device_probe_open", "device_probe_close",
-        # the checkpoint snapshot hook: on-device buffer copies only —
-        # the blocking device read belongs to the background writer
-        # (distributed/checkpoint.py _write_one), never the step loop
-        "CheckpointSnapshotMixin.tree_state",
-        "CheckpointSnapshotMixin.snapshot_state"],
-    "paddle_tpu/hapi/model.py": [
-        "Model.fit", "Model._fit_epochs", "Model._dispatch_micro"],
-    "paddle_tpu/distributed/fleet/hybrid_train.py": [
-        "HybridTrainStep.__call__", "HybridTrainStep._prep"],
-    # the async checkpoint enqueue path: save() snapshots on device and
-    # hands off to the writer thread — any host<->device sync here
-    # would put checkpointing back on the step loop's critical path.
-    # (_write_one / the writer loop are deliberately NOT fenced: the
-    # writer thread's whole job is the blocking device_get + file IO.)
-    "paddle_tpu/distributed/checkpoint.py": [
-        "CheckpointManager.save", "CheckpointManager._snapshot",
-        "CheckpointManager.busy", "AsyncSaveHandle.done"],
-    "paddle_tpu/distributed/elastic.py": [
-        "ElasticController.on_step"],
-    # fault sites fire inside train-step dispatch: pure host dict math
-    "paddle_tpu/framework/fault_injection.py": ["fire", "active"],
-    "paddle_tpu/io/device_prefetch.py": ["*"],
-    # the serving engine's scheduler core: the only legitimate blocks
-    # are the queue wait and the ONE device read per dispatched batch /
-    # decode step (marked hot-sync-ok at the result-slicing sync
-    # points). Sampling is an on-device argmax collected via an async
-    # copy: the prefill path (_admit) and the whole ragged loop carry
-    # NO allowlist entry — int()/device_get of b int32s with the copy
-    # already in flight, never a [vocab]-sized np.asarray
-    "paddle_tpu/inference/serving.py": [
-        "_run_scheduler",
-        "InferenceEngine._take_batch", "InferenceEngine._scan_matching",
-        "InferenceEngine._loop_once", "InferenceEngine._dispatch_batch",
-        "InferenceEngine._resolve_batch", "InferenceEngine._fail_batch",
-        "InferenceEngine._flush_expired", "InferenceEngine.load_report",
-        "GenerationEngine._loop_once", "GenerationEngine._admit",
-        "GenerationEngine._decode_step", "GenerationEngine._emit",
-        "GenerationEngine._admit_ragged",
-        "GenerationEngine._ragged_step",
-        "GenerationEngine._pop_doomed_head",
-        "GenerationEngine._close_doomed",
-        "GenerationEngine._note_kv_step", "GenerationEngine.load_report"],
-    # the serving observatory: request traces mutate on the scheduler
-    # hot loop and kvcache snapshots run per step — the whole module
-    # must stay pure host arithmetic (no device reads, ever)
-    "paddle_tpu/profiler/serve_observatory.py": ["*"],
-    # the distributed observatory: collective rollups fold on every
-    # collective call and the rankstat cadence check runs per step —
-    # the whole module must stay pure host arithmetic (the device-time
-    # probe's two deliberate syncs live in jit/api.py, fenced +
-    # allowlisted there, NOT here)
-    "paddle_tpu/profiler/dist_observatory.py": ["*"],
-    # eager collectives are host-visible waits by design, but the
-    # instrumentation AROUND them must never add a sync of its own
-    "paddle_tpu/distributed/collective.py": [
-        "_instrumented", "_payload_bytes", "_any_traced",
-        "_group_label"],
-    # the pool snapshot is called from the decode loop: dict/len math
-    # only, never a device read of the page pools
-    "paddle_tpu/ops/paged_attention.py": ["PagedKVCache.pool_stats"],
-}
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-PATTERNS = [
-    (re.compile(r"\.item\s*\("), ".item()"),
-    (re.compile(r"(?<![\w.])float\s*\("), "float()"),
-    (re.compile(r"\.numpy\s*\("), ".numpy()"),
-    (re.compile(r"block_until_ready"), "block_until_ready"),
-    # np.asarray of a device array is a blocking D2H read — the serving
-    # dispatcher idiom (jnp.asarray stays device-side and is NOT matched)
-    (re.compile(r"(?<![\w.])np\.asarray\s*\("), "np.asarray()"),
-    # jax.device_get is the other blocking D2H idiom (the ragged decode
-    # loop's one deliberate sync is marked; anything else is a leak)
-    (re.compile(r"device_get\s*\("), "device_get()"),
-]
-
-ALLOW_MARKER = "hot-sync-ok"
-
-
-def _named_spans(tree):
-    """{qualified name: (first line, last line)} for module-level
-    functions and class methods."""
-    spans = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            spans[node.name] = (node.lineno, node.end_lineno)
-        elif isinstance(node, ast.ClassDef):
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    spans[f"{node.name}.{sub.name}"] = (sub.lineno,
-                                                        sub.end_lineno)
-    return spans
-
-
-def _string_lines(tree):
-    """Line numbers covered by multi-line string constants (docstrings
-    and other block strings) — not code, not linted."""
-    lines = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            end = getattr(node, "end_lineno", node.lineno)
-            if end > node.lineno:
-                lines.update(range(node.lineno, end + 1))
-    return lines
-
-
-def check_source(src, names, where):
-    """All violations for one file's source text. `names` is the list of
-    hot region names ("*" = whole module)."""
-    violations = []
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [f"{where}: unparseable ({e})"]
-    lines = src.splitlines()
-    skip = _string_lines(tree)
-    if "*" in names:
-        regions = [("<module>", 1, len(lines))]
-    else:
-        spans = _named_spans(tree)
-        regions = []
-        for name in names:
-            if name not in spans:
-                violations.append(
-                    f"{where}: hot region {name!r} not found — update "
-                    "tools/check_no_hot_sync.py HOT_REGIONS")
-                continue
-            regions.append((name, *spans[name]))
-    for name, start, end in regions:
-        for ln in range(start, min(end, len(lines)) + 1):
-            if ln in skip:
-                continue
-            line = lines[ln - 1]
-            if ALLOW_MARKER in line:
-                continue
-            code = line.split("#", 1)[0]
-            for pat, label in PATTERNS:
-                if pat.search(code):
-                    violations.append(
-                        f"{where}:{ln}: {label} in hot region {name}: "
-                        f"{line.strip()}")
-    return violations
-
-
-def check_repo(repo):
-    errors = []
-    for rel, names in sorted(HOT_REGIONS.items()):
-        path = os.path.join(repo, rel)
-        if not os.path.exists(path):
-            errors.append(f"{rel}: hot file missing")
-            continue
-        with open(path) as f:
-            errors.extend(check_source(f.read(), names, rel))
-    return errors
+from lint.hot_sync import (  # noqa: F401,E402  (the public surface)
+    ALLOW_MARKER, HOT_REGIONS, PATTERNS, check_repo, check_source)
 
 
 def main(argv):
-    repo = argv[0] if argv else \
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = argv[0] if argv else os.path.dirname(_TOOLS)
     errors = check_repo(repo)
     for err in errors:
         print(err)

@@ -1,0 +1,210 @@
+"""blocking-under-lock pass: no slow/blocking work while holding a
+lock another thread needs.
+
+The PR 10 review found `trace.finish()` (a JSONL file append) running
+inside the serving scheduler's condition lock; PR 11/12 hand-reviewed
+the same class in the checkpoint writer and the observatories. This
+pass generalizes that review: inside any `with <lock>:` body — and
+through RESOLVED call chains (the finish()-under-lock shape is a call,
+not an inline open) — these are findings:
+
+  rule                     what it catches
+  file-io-under-lock       builtin open(), os.replace/rename/fsync/
+                           makedirs/remove/unlink/rmdir/listdir,
+                           shutil.*, json.dump (stream form)
+  jsonl-export-under-lock  monitor.export_step / trace-finish export
+                           helpers (the PR 10 bug, generalized)
+  device-read-under-lock   jax.device_get / block_until_ready — a
+                           device sync while peers spin on the lock
+  wait-under-lock          future .result(), thread/process .join()
+                           (receiver-shape heuristic: thread-ish names
+                           or a timeout arg — `", ".join` and
+                           `os.path.join` never match), queue .get()
+                           (queue-ish receiver), time.sleep,
+                           subprocess.run/check_* and Popen(...).wait
+  unbounded-acquire        an explicit `<lock>.acquire()` with NO
+                           timeout/blocking argument. `with lock:` is
+                           the idiomatic unbounded form; explicit
+                           acquire() exists precisely for the timed
+                           variant — diagnosis paths (load_report,
+                           watchdog dumps) using a bare acquire() are
+                           how a hang wedges its own hang-diagnosis
+                           (the PR 10 class). Fires regardless of held
+                           locks.
+
+ALLOWED_BLOCKING is the pass's region table: lock identities whose
+JOB is to serialize blocking work (the monitor's dedicated file-append
+lock, the checkpoint writer gate). Findings under those locks are
+emitted SUPPRESSED with the table's reason — in the ledger, counted by
+the baseline ratchet, never silently dropped. Line-level false
+positives take `# lint-ok[blocking-under-lock]: <why>`.
+"""
+import ast
+
+from .core import Finding, _dotted, _last_attr, transitive_closure
+
+PASS_NAME = "blocking-under-lock"
+
+# lock identities whose job is to hold while blocking: the reason is
+# the suppression reason every finding under them carries
+ALLOWED_BLOCKING = {
+    "paddle_tpu/profiler/monitor.py:_export_lock":
+        "dedicated file-append lock: exists to serialize JSONL writes; "
+        "registry ops never take it",
+    "paddle_tpu/distributed/checkpoint.py:CheckpointManager._writer_gate":
+        "writer gate: serializes background checkpoint writers whose "
+        "whole job is blocking device_get + file IO off the step loop",
+}
+
+_OS_BLOCKING = {"replace", "rename", "fsync", "makedirs", "remove",
+                "unlink", "rmdir", "listdir", "stat", "scandir"}
+_SUBPROCESS_FUNCS = {"run", "check_call", "check_output", "call",
+                     "Popen"}
+_QUEUEISH = ("queue", "_queue", "q", "_q", "inq", "outq", "jobs")
+_THREADISH = ("thread", "_thread", "worker", "writer", "proc",
+              "process", "child", "t", "w")
+_EXPORT_HELPERS = {"export_step", "export_line", "finish",
+                   "record_event"}
+
+
+def _receiver(node):
+    """The receiver expression of an attribute call, else None."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.value
+    return None
+
+
+def classify_blocking(call):
+    """(rule, label) when `call` is a blocking operation by this
+    pass's catalog, else None. Pure shape analysis of one Call node."""
+    func = call.func
+    dotted = _dotted(func) or ""
+    last = _last_attr(func)
+    if isinstance(func, ast.Name):
+        if func.id == "open":
+            return ("file-io-under-lock", "open()")
+        return None
+    if dotted.startswith("os.path."):
+        return None
+    if dotted.startswith("os.") and last in _OS_BLOCKING:
+        return ("file-io-under-lock", f"{dotted}()")
+    if dotted.startswith("shutil."):
+        return ("file-io-under-lock", f"{dotted}()")
+    if dotted.startswith("subprocess.") and last in _SUBPROCESS_FUNCS:
+        return ("wait-under-lock", f"{dotted}()")
+    if dotted in ("json.dump",):
+        return ("file-io-under-lock", "json.dump()")
+    if dotted in ("time.sleep",):
+        return ("wait-under-lock", "time.sleep()")
+    if last == "device_get" or last == "block_until_ready":
+        return ("device-read-under-lock", f"{last}()")
+    if last in _EXPORT_HELPERS:
+        return ("jsonl-export-under-lock", f"{dotted or last}()")
+    recv = _receiver(call)
+    recv_name = (_last_attr(recv) or "").lower() if recv is not None \
+        else ""
+    if last == "result":
+        return ("wait-under-lock", f"{dotted or '.result'}()")
+    if last == "wait":
+        # Condition.wait RELEASES its own lock — condition-ish
+        # receivers are exempt. Event.wait does NOT: it blocks while
+        # holding every enclosing lock (a setter needing that lock
+        # deadlocks), so event-ish receivers are flagged like
+        # process/thread handles; unknowable receivers skipped
+        if "cv" in recv_name or "cond" in recv_name:
+            return None
+        if "event" in recv_name or "stop" in recv_name or \
+                "done" in recv_name or recv_name in _THREADISH or \
+                "proc" in recv_name:
+            return ("wait-under-lock", f"{dotted or '.wait'}()")
+        return None
+    if last == "join":
+        if isinstance(recv, ast.Constant):
+            return None  # "sep".join(...)
+        # thread-ish receiver, an explicit timeout kw, or a single
+        # numeric-literal arg (`t.join(5)`) — `sep.join(parts)` never
+        # matches
+        timeoutish = any(k.arg == "timeout" for k in call.keywords) \
+            or (len(call.args) == 1 and
+                isinstance(call.args[0], ast.Constant) and
+                isinstance(call.args[0].value, (int, float)))
+        if recv_name in _THREADISH or \
+                any(t in recv_name for t in ("thread", "worker",
+                                             "writer", "proc")) or \
+                timeoutish:
+            return ("wait-under-lock", f"{dotted or '.join'}()")
+        return None
+    if last == "get" and recv is not None:
+        if recv_name in _QUEUEISH or "queue" in recv_name:
+            return ("wait-under-lock", f"{dotted or '.get'}()")
+        return None
+    return None
+
+
+class BlockingUnderLockPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        def extractor(sf, node, held):
+            if isinstance(node, ast.Call):
+                got = classify_blocking(node)
+                if got:
+                    return [(got[0], got[1], node.lineno)]
+            return None
+
+        ctx.build_summaries(effect_extractor=extractor)
+        findings = []
+
+        # direct effects under a lexically-held lock
+        for info in ctx.functions.values():
+            for rule, label, line, held in info.effects:
+                if not held:
+                    continue
+                findings.append(self._finding(
+                    rule, label, info.file.rel, line, held))
+
+        # call expansion: transitive blocking effects (fixpoint), then
+        # flag resolved calls made while holding a lock
+        effects = transitive_closure(
+            {key: {(r, lab) for r, lab, _, _ in info.effects}
+             for key, info in ctx.functions.items()},
+            lambda key: (c for c, _, _, _ in
+                         ctx.functions[key].calls))
+        for info in ctx.functions.values():
+            for callee, held, line, label in info.calls:
+                if not callee or not held or not effects.get(callee):
+                    continue
+                for rule, op in sorted(effects[callee]):
+                    findings.append(self._finding(
+                        rule, f"{op} via {label}() -> {callee}",
+                        info.file.rel, line, held))
+
+        # unbounded explicit acquire() — held or not
+        for info in ctx.functions.values():
+            for lid, line, via_with, has_timeout, _held in \
+                    info.acquisitions:
+                if not via_with and not has_timeout:
+                    findings.append(Finding(
+                        PASS_NAME, "unbounded-acquire", info.file.rel,
+                        line,
+                        f"bare {lid}.acquire() without a timeout — "
+                        "explicit acquire() exists for the TIMED "
+                        "variant; an unbounded one on a diagnosis "
+                        "path wedges hang diagnosis (use `with` for "
+                        "plain exclusion)"))
+        return findings
+
+    def _finding(self, rule, label, rel, line, held):
+        """The table suppresses only when EVERY held lock is allowed:
+        `with engine._cv: with _export_lock: open(...)` still blocks
+        the engine lock — the allowed inner lock must not mask the
+        disallowed outer one (the PR 10 class, nested)."""
+        disallowed = [h for h in held if h not in ALLOWED_BLOCKING]
+        if disallowed:
+            return Finding(
+                PASS_NAME, rule, rel, line,
+                f"{label} while holding {disallowed[-1]}")
+        return Finding(
+            PASS_NAME, rule, rel, line,
+            f"{label} while holding {held[-1]}",
+            suppressed=True, reason=ALLOWED_BLOCKING[held[-1]])

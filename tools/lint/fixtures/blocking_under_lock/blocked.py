@@ -1,0 +1,50 @@
+"""Known-bad corpus for the blocking-under-lock pass.
+
+The PR 10 bug class, distilled: JSONL export / file I/O / future
+waits inside an engine lock, an export helper reached through a call
+chain, and the unbounded diagnosis-path acquire()."""
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+_results = {}
+
+
+def export_line(path, rec):
+    with open(path, "a") as f:  # fine here: no lock held
+        f.write(json.dumps(rec) + "\n")
+
+
+def finish_under_lock(path, rec):
+    with _lock:
+        _results["n"] = _results.get("n", 0) + 1
+        # the generalized trace.finish() shape: file append while
+        # every other thread spins on _lock
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def export_via_call(path, rec):
+    with _lock:
+        export_line(path, rec)  # same bug through one call hop
+
+
+def wait_under_lock(fut, worker_thread, done_event):
+    with _lock:
+        out = fut.result()
+        worker_thread.join(timeout=5)
+        time.sleep(0.1)
+        # Event.wait holds every enclosing lock while blocked — the
+        # setter thread needing _lock deadlocks right here
+        done_event.wait()
+    return out
+
+
+def diagnose(engine_lock):
+    # the hang-diagnosis path that wedges on the hang it diagnoses
+    engine_lock.acquire()
+    try:
+        return dict(_results)
+    finally:
+        engine_lock.release()

@@ -1,0 +1,13 @@
+"""Known-bad corpus for the hot-sync pass: a TrainStep whose hot
+dispatch path blocks the host on the device (the exact regression the
+fence exists for). The corpus mirrors the real HOT_REGIONS path so the
+region table resolves against it."""
+
+
+class TrainStep:
+    def __call__(self, *batch):
+        loss = self._jitted(*batch)
+        return float(loss.item())  # blocking read in the step path
+
+    def _prep(self, batch):
+        return [b.numpy() for b in batch]  # D2H inside the hot prep

@@ -1,0 +1,40 @@
+"""Known-bad corpus for the lock-order pass (tests/
+test_static_analysis.py runs the pass over this tree and asserts RED).
+
+Two classic shapes: an AB/BA cross-function inversion (two threads
+deadlock), and a non-reentrant Lock re-entered through a helper call
+(one thread wedges itself)."""
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+_plain = threading.Lock()
+
+
+def drain_then_export():
+    # thread 1 takes a -> b
+    with _a:
+        with _b:
+            pass
+
+
+def export_then_drain():
+    # thread 2 takes b -> a: cycle with drain_then_export
+    with _b:
+        with _a:
+            pass
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}
+
+    def report(self):
+        with self._lock:
+            return self._summarize()
+
+    def _summarize(self):
+        # re-enters the same non-reentrant Lock via the call chain
+        with self._lock:
+            return dict(self._stats)

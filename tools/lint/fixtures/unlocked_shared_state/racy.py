@@ -1,0 +1,44 @@
+"""Known-bad corpus for the unlocked-shared-state pass.
+
+The dict-changed-size-during-unlocked-snapshot class: a scheduler
+thread mutates per-engine state that a caller-thread report method
+iterates with no lock in scope."""
+import threading
+
+
+class RacyEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}
+        self._done = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            # unlocked mutation on the scheduler thread
+            self._stats["steps"] = self._stats.get("steps", 0) + 1
+            self._done.append(self._stats["steps"])
+
+    def load_report(self):
+        # unlocked snapshot from the caller's thread: dict(...) can
+        # throw "dictionary changed size during iteration"
+        return dict(self._stats), len(self._done)
+
+
+class AnnotatedRacy:
+    """The same race spelled with a type annotation: ast.AnnAssign
+    writes must be as visible to the pass as plain assignments."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+        self._thread.start()
+
+    def _tick(self):
+        while True:
+            self._count: int = self._count + 1  # unlocked annotated write
+
+    def snapshot(self):
+        return self._count

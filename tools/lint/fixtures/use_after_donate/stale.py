@@ -1,0 +1,38 @@
+"""Known-bad corpus for the use-after-donate pass.
+
+The deleted-array class: a buffer donated to a jitted dispatch is
+read again through its old binding."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def pool_update(pool, x):
+    return pool + x
+
+
+class Decoder:
+    def __init__(self, fn):
+        self._step_fn = jax.jit(fn, donate_argnums=(1, 2))
+
+    def step(self, state, k_pool, v_pool, tokens):
+        out = self._step_fn(state, k_pool, v_pool, tokens)
+        # k_pool/v_pool storage was handed to XLA at dispatch
+        return out, k_pool.shape, v_pool
+
+
+def bad_linear(pool, x):
+    new = pool_update(pool, x)
+    return new + pool  # pool was donated: deleted-array RuntimeError
+
+
+def good_rebind(pool, x):
+    pool = pool_update(pool, x)  # the correct idiom: rebind
+    return pool * 2
+
+
+def good_annotated_rebind(pool, x):
+    # the annotated spelling of the correct idiom must stay clean
+    pool: object = pool_update(pool, x)
+    return pool * 2

@@ -1,0 +1,162 @@
+"""lock-order pass: the static deadlock detector.
+
+Extracts every `with <lock>:` / `<lock>.acquire()` site across the
+analyzed fileset, attributes each lock to its owning scope
+(`self._lock` in two engines stays two distinct graph nodes; module
+globals bind to their module; function locals to their function), and
+builds the cross-module lock-ACQUISITION graph: an edge A -> B means
+"some code path acquires B while holding A". Two edge sources:
+
+- **lexical nesting** — a `with B:` (or `B.acquire()`) inside the body
+  of a `with A:`;
+- **call expansion** — a call made while holding A whose callee
+  (resolved per core.ProjectContext.resolve_call: self-methods,
+  module functions, imported in-tree modules, unique-definition
+  methods) transitively acquires B. The transitive acquire sets are a
+  fixpoint over the per-function summaries, so recursion and
+  cross-module chains (engine -> monitor -> flight_recorder) converge.
+
+Verdicts:
+
+- `lock-cycle` — a strongly connected component with >= 2 locks: two
+  threads taking the locks in opposite orders can deadlock. The
+  finding names every edge of the cycle with its file:line.
+- `lock-self-cycle` — a non-reentrant `threading.Lock` re-acquired
+  while already held (lexically, or via a resolved call chain): a
+  single thread wedges itself. Reentrant kinds (RLock, Condition —
+  Condition wraps an RLock) are exempt by construction.
+
+False positives (a cycle the runtime provably never interleaves) get a
+`# lint-ok[lock-order]: <why>` on the acquisition line — never a
+weakened rule. See docs/STATIC_ANALYSIS.md.
+"""
+from .core import Finding, REENTRANT_KINDS, transitive_closure
+
+PASS_NAME = "lock-order"
+
+# transitive-acquire set size cap: a runaway summary (pathological
+# generated code) must not wedge the linter
+_MAX_ACQ = 64
+
+
+class LockOrderPass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        ctx.build_summaries()
+        edges = {}  # (a, b) -> (file, line, via_label)
+
+        # 1) direct lexical nesting
+        for a, b, rel, line, _ in ctx.held_at_acquisitions():
+            if a == b and ctx.locks.get(a) in REENTRANT_KINDS:
+                continue
+            edges.setdefault((a, b), (rel, line, None))
+
+        # 2) call expansion: transitive acquires per function (fixpoint)
+        # pseudo-ids ("<recv>": parameter-passed locks the resolver
+        # could not attribute) stay out of the graph — they unify by
+        # receiver NAME, which would fabricate cycles
+        acquires = transitive_closure(
+            {key: {a for a, *_ in info.acquisitions if "<" not in a}
+             for key, info in ctx.functions.items()},
+            lambda key: (c for c, _, _, _ in
+                         ctx.functions[key].calls),
+            cap=_MAX_ACQ)
+        for key, info in ctx.functions.items():
+            for callee, held, line, label in info.calls:
+                if not callee or not held or callee not in acquires:
+                    continue
+                for b in acquires[callee]:
+                    for a in held:
+                        if a == b:
+                            continue  # self via call: handled below
+                        edges.setdefault(
+                            (a, b), (info.file.rel, line,
+                                     f"via {label}() -> {callee}"))
+                # self-cycle via call chain on a plain Lock
+                for a in held:
+                    if a in acquires[callee] and \
+                            ctx.locks.get(a) not in REENTRANT_KINDS:
+                        edges.setdefault(
+                            (a, a), (info.file.rel, line,
+                                     f"via {label}() -> {callee}"))
+
+        return self._verdicts(ctx, edges)
+
+    def _verdicts(self, ctx, edges):
+        findings = []
+        graph = {}
+        for (a, b), site in edges.items():
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # self-cycles first (definite single-thread wedge)
+        for (a, b), (rel, line, via) in sorted(edges.items()):
+            if a == b:
+                kind = ctx.locks.get(a, "Lock")
+                findings.append(Finding(
+                    PASS_NAME, "lock-self-cycle", rel, line,
+                    f"non-reentrant {kind} {a} re-acquired while "
+                    f"already held"
+                    + (f" ({via})" if via else " (lexical nesting)")))
+        # multi-lock cycles: Tarjan SCC
+        for scc in _sccs(graph):
+            if len(scc) < 2:
+                continue
+            cyc_edges = sorted(
+                (a, b) for (a, b) in edges
+                if a in scc and b in scc and a != b)
+            detail = "; ".join(
+                f"{a} -> {b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                + (f" ({edges[(a, b)][2]})" if edges[(a, b)][2] else "")
+                for a, b in cyc_edges)
+            rel, line, _ = edges[cyc_edges[0]]
+            findings.append(Finding(
+                PASS_NAME, "lock-cycle", rel, line,
+                f"lock-acquisition cycle across {len(scc)} locks "
+                f"({', '.join(sorted(scc))}): {detail}"))
+        return findings
+
+
+def _sccs(graph):
+    """Tarjan's strongly connected components (iterative)."""
+    index_counter = [0]
+    stack, lowlink, index, on_stack = [], {}, {}, set()
+    result = []
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(graph.get(v0, ()))))]
+        while work:
+            v, it = work[-1]
+            if v not in index:
+                index[v] = lowlink[v] = index_counter[0]
+                index_counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            advanced = False
+            for w in it:
+                if w not in index:
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    lowlink[v] = min(lowlink[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[v])
+            if lowlink[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                result.append(scc)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return result

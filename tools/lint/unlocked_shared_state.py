@@ -1,0 +1,430 @@
+"""unlocked-shared-state pass: fields mutated on a background thread
+and read elsewhere with no lock in scope.
+
+The PR 10-12 hand-review catalog's "dict changed size during an
+unlocked snapshot" class: the scheduler thread mutates `self._stats`
+while `load_report()` iterates it from the caller's thread. This pass
+finds the shape statically, per class:
+
+1. **Thread contexts** — methods handed to `threading.Thread(target=
+   ...)`, `executor.submit(...)`, or `add_done_callback(...)`
+   anywhere in the file, plus everything reachable from them through
+   same-class `self.m()` / same-module calls (intra-file closure).
+2. **Access inventory** — every `self.<attr>` write (assign, augment,
+   subscript store, known mutator calls: append/pop/update/clear/...)
+   and read, tagged with the SET of lock identities lexically held
+   (or a wildcard when the containing method is only ever called from
+   under a lock — locked-context propagation; thread entries never
+   qualify: the Thread start is a lock-free call site).
+3. **Verdict** — `unlocked-shared-write`: a write and a cross-
+   boundary access with NO COMMON lock. Identity matters: a writer
+   under lock A and a reader under lock B race exactly like unlocked
+   code — disjoint locks do not exclude each other. The finding
+   cites both sites (and both locksets in the mismatch case).
+
+Exemptions by construction (not suppressions):
+
+- `__init__` writes — they happen-before the thread starts;
+- attributes whose every post-init write is a plain CONSTANT assign
+  (`self._stop = True`): the GIL makes the flag handoff atomic, and
+  fencing every stop flag would bury the real findings;
+- attributes never accessed outside the thread context (thread-local
+  by usage).
+
+False positives (e.g. a read that provably happens after `join()`)
+take `# lint-ok[unlocked-shared-state]: <why>` on the access line.
+"""
+import ast
+
+from .core import Finding, _BUILTIN_METHOD_NAMES, _last_attr
+
+PASS_NAME = "unlocked-shared-state"
+
+_MUTATORS = {"append", "appendleft", "pop", "popleft", "update",
+             "clear", "extend", "add", "remove", "discard", "insert",
+             "setdefault", "rotate", "sort"}
+
+
+def _thread_entries(sf):
+    """Callable names handed to Thread(target=...)/submit/
+    add_done_callback in this file: {'Class.method' | 'func'}."""
+    entries = set()
+    if sf.tree is None:
+        return entries
+
+    def callable_name(node, cls):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cls:
+            return f"{cls}.{node.attr}"
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def visit(node, cls):
+        if isinstance(node, ast.Call):
+            last = _last_attr(node.func)
+            if last == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        n = callable_name(kw.value, cls)
+                        if n:
+                            entries.add(n)
+            elif last in ("submit", "add_done_callback"):
+                if node.args:
+                    n = callable_name(node.args[0], cls)
+                    if n:
+                        entries.add(n)
+        for child in ast.iter_child_nodes(node):
+            visit(child, node.name if isinstance(node, ast.ClassDef)
+                  else cls)
+
+    visit(sf.tree, None)
+    return entries
+
+
+#: wildcard lockset member for locked-context methods — the callers
+#: hold SOME lock, identity unknown; matches any lock (conservative:
+#: never fabricates a mismatch finding)
+_ANY_LOCK = "<caller>"
+
+
+class _Access:
+    __slots__ = ("attr", "method", "line", "write", "mutation",
+                 "locks", "const_assign")
+
+    def __init__(self, attr, method, line, write, mutation, locks,
+                 const_assign):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.write = write
+        self.mutation = mutation
+        self.locks = locks  # frozenset of held lock ids (may be empty)
+        self.const_assign = const_assign
+
+    @property
+    def locked(self):
+        return bool(self.locks)
+
+
+def _protected(a, b):
+    """Two accesses are mutually protected only by a COMMON lock (or
+    when either side's lockset is the locked-context wildcard)."""
+    if _ANY_LOCK in a.locks or _ANY_LOCK in b.locks:
+        return True
+    return bool(a.locks & b.locks)
+
+
+def _is_const(node):
+    return isinstance(node, ast.Constant) or (
+        isinstance(node, ast.UnaryOp) and
+        isinstance(node.operand, ast.Constant))
+
+
+class UnlockedSharedStatePass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        ctx.build_summaries()
+        findings = []
+        for sf in ctx.files:
+            if sf.tree is None:
+                continue
+            findings.extend(self._check_file(ctx, sf))
+        return findings
+
+    # -- per-file ----------------------------------------------------
+
+    def _check_file(self, ctx, sf):
+        entries = _thread_entries(sf)
+        if not entries:
+            return []
+        infos = {info.qualname: info
+                 for info in ctx.functions.values()
+                 if info.file is sf}
+        edges = self._call_edges(sf, infos)
+        entry_quals = {q for q in infos
+                       if q in entries or q.split(".")[-1] in entries}
+        thread_ctx = self._closure(infos, entry_quals, edges)
+        locked_ctx = self._locked_contexts(infos, entry_quals, edges)
+        accesses = []
+        for qual, info in infos.items():
+            if info.class_name is None or \
+                    qual.endswith("__init__"):
+                continue
+            accesses.extend(self._collect_accesses(
+                ctx, sf, info, locked=qual in locked_ctx))
+        bases = ctx._class_bases.get(sf.rel, {})
+        return self._verdicts(sf, accesses, thread_ctx, bases)
+
+    @staticmethod
+    def _call_edges(sf, infos):
+        """{caller_qual: [(callee_qual, held_bool)]} intra-file call
+        edges, shared by the thread-context closure and the locked-
+        context propagation.
+
+        Unresolved `obj.m()` calls expand to EVERY same-file method
+        named `m`: resolve_call's unique-definition ladder returns
+        None when two classes define the name (serving.py — BOTH
+        engines define `_loop_once`/`_outstanding`, and the shared
+        `_SchedulerLifecycle.drain` calls them through `self`), and
+        dropping those edges leaves the scheduler loops out of the
+        thread context AND starves the locked-context propagation of
+        the under-lock call sites that protect the readers. The
+        expansion never claims builtin-shadowing or dunder names
+        (same guard as resolve_call's fallback)."""
+        by_name = {}
+        for q in infos:
+            if "." in q:
+                by_name.setdefault(q.split(".")[-1], []).append(q)
+        edges = {}
+        for qual, info in infos.items():
+            out = edges.setdefault(qual, [])
+            for callee, held, _, label in info.calls:
+                if callee and callee.startswith(f"{sf.rel}:"):
+                    cq = callee.split(":", 1)[1]
+                    if cq in infos:
+                        out.append((cq, bool(held)))
+                elif callee is None and "." in label:
+                    last = label.rsplit(".", 1)[-1]
+                    if last.startswith("__") or \
+                            last in _BUILTIN_METHOD_NAMES:
+                        continue
+                    for cq in by_name.get(last, ()):
+                        out.append((cq, bool(held)))
+        return edges
+
+    @staticmethod
+    def _closure(infos, entry_quals, edges):
+        """Thread context = entry callables + intra-file functions
+        reachable from them through the call edges. Over-approximating
+        the context is safe for this pass: a method wrongly inside it
+        only tightens what counts as cross-boundary, it cannot
+        suppress a finding on code that really races."""
+        work = list(entry_quals)
+        seen = set(work)
+        while work:
+            qual = work.pop()
+            for cq, _ in edges.get(qual, ()):
+                if cq not in seen:
+                    seen.add(cq)
+                    work.append(cq)
+        return seen
+
+    @staticmethod
+    def _locked_contexts(infos, entry_quals, edges):
+        """Methods whose EVERY intra-file call site holds a lock (or
+        sits in an already-locked context): their bodies inherit the
+        callers' protection. Thread ENTRIES never qualify — the
+        Thread(target=...) start runs them lock-free and that call
+        site is invisible to the intra-file scan. NON-entry methods
+        the thread reaches DO qualify: their thread-side call sites
+        are ordinary visible calls, so `all sites hold a lock`
+        already accounts for them (a scheduler helper invoked only
+        under the engine lock is protected, wherever the caller
+        runs)."""
+        call_sites = {}  # qualname -> [(caller_qual, held_bool)]
+        for qual, outs in edges.items():
+            for cq, held in outs:
+                call_sites.setdefault(cq, []).append((qual, held))
+        locked = set()
+        changed = True
+        while changed:
+            changed = False
+            for qual in infos:
+                if qual in locked or qual not in call_sites or \
+                        qual in entry_quals:
+                    continue
+                sites = call_sites[qual]
+                if sites and all(held or caller in locked
+                                 for caller, held in sites):
+                    locked.add(qual)
+                    changed = True
+        return locked
+
+    def _collect_accesses(self, ctx, sf, info, locked):
+        out = []
+        base = frozenset((_ANY_LOCK,)) if locked else frozenset()
+        track_explicit = ".acquire(" in sf.text
+
+        def add(attr, line, write, mutation, locks, const):
+            out.append(_Access(attr, info.qualname, line, write,
+                               mutation, locks, const))
+
+        def walk(node, held):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node is not info.node:
+                return
+            new_held = held
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = ctx.lock_id(sf, item.context_expr,
+                                      info.class_name, info.qualname)
+                    if lid:
+                        new_held = new_held | {lid}
+            is_locked = base | held
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    self._target_accesses(t, node.value, add,
+                                          is_locked, node.lineno)
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                # `self._x: int = v` writes exactly like `self._x = v`;
+                # a bare annotation (value None) declares, not writes
+                self._target_accesses(node.target, node.value, add,
+                                      is_locked, node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                t = node.target
+                if self._self_attr(t):
+                    add(t.attr, node.lineno, True, False, is_locked,
+                        False)
+                elif isinstance(t, ast.Subscript) and \
+                        self._self_attr(t.value):
+                    add(t.value.attr, node.lineno, True, True,
+                        is_locked, False)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            self._self_attr(t.value):
+                        add(t.value.attr, node.lineno, True, True,
+                            is_locked, False)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _MUTATORS and \
+                        self._self_attr(f.value):
+                    add(f.value.attr, node.lineno, True, True,
+                        is_locked, False)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    self._self_attr(node):
+                add(node.attr, node.lineno, False, False, is_locked,
+                    False)
+            # same sequential explicit-acquire flow as
+            # core._summarize: a bounded `.acquire(timeout=)` region
+            # protects the accesses inside it
+            run = new_held
+            for child in ast.iter_child_nodes(node):
+                walk(child, run)
+                if track_explicit:
+                    acq, rel = ctx.lock_flow(sf, child,
+                                             info.class_name,
+                                             info.qualname)
+                    if acq or rel:
+                        run = (run - rel) | (acq - rel)
+
+        walk(info.node, frozenset())
+        return out
+
+    @staticmethod
+    def _self_attr(node):
+        return isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self"
+
+    def _target_accesses(self, target, value, add, is_locked, line):
+        if self._self_attr(target):
+            add(target.attr, line, True, False, is_locked,
+                _is_const(value))
+        elif isinstance(target, ast.Subscript) and \
+                self._self_attr(target.value):
+            add(target.value.attr, line, True, True, is_locked, False)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._target_accesses(el, ast.Constant(value=None),
+                                      add, is_locked, line)
+
+    # -- verdicts ----------------------------------------------------
+
+    @staticmethod
+    def _ancestors(bases, cls):
+        out, work = set(), [cls]
+        while work:
+            c = work.pop()
+            for b in bases.get(c, ()):
+                if b in bases and b not in out:
+                    out.add(b)
+                    work.append(b)
+        return out
+
+    def _related(self, bases, m1, m2):
+        """Two accesses share an instance only when their classes are
+        the same or inheritance-related (same file): pairing
+        `GenerationEngine.retraces` writes with `InferenceEngine`
+        reads would report a race between two DIFFERENT objects'
+        fields that merely share a name."""
+        c1, c2 = m1.split(".")[0], m2.split(".")[0]
+        return c1 == c2 or c1 in self._ancestors(bases, c2) or \
+            c2 in self._ancestors(bases, c1)
+
+    def _verdicts(self, sf, accesses, thread_ctx, bases):
+        by_attr = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        findings = []
+        for attr, accs in sorted(by_attr.items()):
+            thread = [a for a in accs if a.method in thread_ctx]
+            main = [a for a in accs if a.method not in thread_ctx]
+            if not thread or not main:
+                continue  # never shared across the boundary
+            writes = [a for a in accs if a.write]
+            if writes and all(a.const_assign for a in writes
+                              if not a.mutation) and \
+                    not any(a.mutation for a in writes):
+                continue  # constant-flag handoff (GIL-atomic)
+            # a (write, access) pair across the thread boundary is
+            # safe only under a COMMON lock — writer under lock A and
+            # reader under lock B is the same race as no lock at all.
+            # Every distinct unprotected WRITE site reports (one
+            # finding per anchor line): collapsing an attribute to its
+            # first pair would let a line-scoped `# lint-ok` on that
+            # pair silently exempt every OTHER racy site on the same
+            # attribute
+            pairs = self._unprotected_pairs(
+                [a for a in thread if a.write], main, bases) + \
+                self._unprotected_pairs(
+                    [a for a in main if a.write], thread, bases)
+            anchored = set()
+            for w, r in pairs:
+                w_side = "thread context " if w.method in thread_ctx \
+                    else ""
+                if w.locks and r.locks:
+                    how = (f"under DIFFERENT locks "
+                           f"({', '.join(sorted(w.locks))} vs "
+                           f"{', '.join(sorted(r.locks))}) — disjoint "
+                           "locks do not exclude each other")
+                else:
+                    how = "with no common lock held"
+                # anchor at the UNLOCKED side — that's where the lock
+                # is missing, and where a justified `# lint-ok`
+                # belongs (write side when both are bare)
+                anchor = w if not w.locks else r
+                if anchor.line in anchored:
+                    continue
+                anchored.add(anchor.line)
+                findings.append(Finding(
+                    PASS_NAME, "unlocked-shared-write", sf.rel,
+                    anchor.line,
+                    f"self.{attr} written in {w_side}{w.method} "
+                    f"({sf.rel}:{w.line}) and accessed from "
+                    f"{r.method} ({sf.rel}:{r.line}) {how} — "
+                    "snapshot/iterate races the mutation"))
+        return findings
+
+    def _unprotected_pairs(self, writes, accesses, bases):
+        """One (write, access) pair per distinct unprotected write
+        site: for each write (deduped by line) the first access on the
+        SAME instance (classes inheritance-related) not protected by a
+        common lock."""
+        out, seen = [], set()
+        for w in writes:
+            if w.line in seen:
+                continue
+            for r in accesses:
+                if self._related(bases, w.method, r.method) and \
+                        not _protected(w, r):
+                    out.append((w, r))
+                    seen.add(w.line)
+                    break
+        return out

@@ -1,0 +1,318 @@
+"""use-after-donate pass: dataflow from donated buffers through
+dispatch calls to later reads of the same binding.
+
+`donate_argnums` hands a buffer's storage to XLA: after the dispatch
+returns, the Python binding still points at a deleted array, and the
+next read raises the jax "array has been deleted" RuntimeError — the
+class PR 5 converted to a loud FloatingPointError by hand in the
+train step, and PR 8's paged-decode path re-found in the pool
+handoff. This pass mechanizes it:
+
+1. **Donation registry** — scan the fileset for donation
+   declarations and record donated positional indices per callable
+   name:
+     - `@functools.partial(jax.jit, donate_argnums=(0,))` decorators
+       on module functions (ops/pallas pool ops);
+     - `X = jax.jit(fn, donate_argnums=(1, 2))` assignments, X a
+       local name or `self.<attr>` (models/gpt.py decode programs;
+       chained `fn = self._jit_fn = jax.jit(...)` registers both).
+   Dynamic argnums (`donate_argnums=donate_argnums`) are
+   unresolvable and skipped — the jit/api.py TrainStep guards that
+   path at runtime already.
+2. **Call-site dataflow** — within each function, a call to a
+   registered donating callable (matched by local name, `self.attr`,
+   or a local alias assigned from one) CONSUMES the plain-name
+   arguments at the donated positions. Any later read of a consumed
+   name in the same function — before a rebinding assignment —
+   is `use-after-donate`. Rebinding through the dispatch result
+   (`pool = step(pool, x)`) is the correct idiom and clears the
+   taint.
+
+The taint walk is source-order linear but BRANCH-SENSITIVE: a donate
+in one arm of an `if` never taints reads in the other arm (the two
+are mutually exclusive), while sibling `if`s — which can both run —
+still propagate. Known limitation (documented, fixture-tested): a
+donation at the BOTTOM of a loop body
+whose next iteration re-reads the name above it is not modeled.
+Every in-repo donation site either rebinds from the result or hands
+the binding off (the gpt.py pool programs), so the linear walk covers
+the real idiom; revisit if a loop-carried donation pattern appears.
+
+False positives (e.g. a read guarded by an is-deleted check) take
+`# lint-ok[use-after-donate]: <why>` on the read line.
+"""
+import ast
+
+from .core import Finding, _dotted
+
+PASS_NAME = "use-after-donate"
+
+
+def _exclusive(p1, p2):
+    """True when two branch paths sit in DIFFERENT arms of the same
+    `if`: control flow can execute one or the other, never both in
+    one pass through the function."""
+    for a, b in zip(p1, p2):
+        if a[0] != b[0]:
+            return False  # sibling ifs: both arms can run in sequence
+        if a[1] != b[1]:
+            return True
+    return False
+
+
+def _donated_positions(call):
+    """The literal donate_argnums of a jit-wrapping Call, else None."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and \
+                    isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) and
+                    isinstance(e.value, int) for e in v.elts):
+                return tuple(e.value for e in v.elts)
+            return None  # dynamic: unresolvable statically
+    return None
+
+
+def _is_jit_call(call):
+    d = _dotted(call.func) or ""
+    return d.endswith("jit") or d.endswith("pjit") or \
+        d.endswith("aot_compile")
+
+
+class _Registry:
+    """Donating callables of one file: name -> donated positions.
+    Names: 'func' (module function), 'Class.attr' (self-attribute),
+    'qualfunc.local' (function-local binding)."""
+
+    def __init__(self, sf):
+        self.positions = {}
+        if sf.tree is None:
+            return
+        self._scan(sf.tree, None, "")
+
+    def _scan(self, node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._scan(child, child.name, prefix)
+                continue
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                self._decorated(child)
+                self._scan(child, cls, f"{prefix}{child.name}.")
+                continue
+            if isinstance(child, (ast.Assign, ast.AnnAssign)) and \
+                    isinstance(child.value, ast.Call):
+                call = child.value
+                pos = None
+                if _is_jit_call(call):
+                    pos = _donated_positions(call)
+                elif isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "partial":
+                    pos = _donated_positions(call)
+                if pos:
+                    targets = child.targets \
+                        if isinstance(child, ast.Assign) \
+                        else [child.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            # function-local bindings stay scoped to
+                            # their qualified key — a bare-name entry
+                            # would taint unrelated same-named
+                            # callables in other functions (the
+                            # in-function `aliases` map covers local
+                            # call sites)
+                            self.positions[f"{prefix}{t.id}"] = pos
+                        elif isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self" and cls:
+                            self.positions[f"{cls}.{t.attr}"] = pos
+            self._scan(child, cls, prefix)
+
+    def _decorated(self, fn):
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call):
+                d = _dotted(dec.func) or ""
+                if d.endswith("partial") or _is_jit_call(dec):
+                    inner_is_jit = any(
+                        isinstance(a, (ast.Name, ast.Attribute)) and
+                        (_dotted(a) or "").endswith("jit")
+                        for a in dec.args) or _is_jit_call(dec)
+                    pos = _donated_positions(dec)
+                    if pos and inner_is_jit:
+                        self.positions[fn.name] = pos
+
+    def lookup(self, call, cls):
+        """Donated positions for this call's target, else None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.positions.get(f.id)
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name):
+            if f.value.id == "self" and cls:
+                return self.positions.get(f"{cls}.{f.attr}")
+        return None
+
+
+class UseAfterDonatePass:
+    name = PASS_NAME
+
+    def run(self, ctx):
+        findings = []
+        for sf in ctx.files:
+            if sf.tree is None:
+                continue
+            reg = _Registry(sf)
+            if not reg.positions:
+                continue
+            for info in ctx.functions.values():
+                if info.file is sf:
+                    findings.extend(self._check_function(sf, info, reg))
+        return findings
+
+    def _check_function(self, sf, info, reg):
+        """Linear taint walk over the function's statements in source
+        order: donating calls taint their donated Name arguments;
+        rebinding clears; a tainted Load is a finding."""
+        events = []  # (line, col, kind, name, extra)
+
+        # local aliases of donating self-attrs: `fn = self._jit_fn`
+        aliases = dict(reg.positions)
+
+        def visit(node, path):
+            if isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Attribute) and \
+                        isinstance(node.value.value, ast.Name) and \
+                        node.value.value.id == "self" and \
+                        info.class_name:
+                    pos = reg.positions.get(
+                        f"{info.class_name}.{node.value.attr}")
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                aliases[t.id] = pos
+                # chained `fn = self._x = jax.jit(...)`: registry
+                # already holds Class._x; bind the local names too
+                if isinstance(node.value, ast.Call):
+                    pos = _donated_positions(node.value) \
+                        if _is_jit_call(node.value) else None
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                aliases[t.id] = pos
+                # the rebinding takes effect AFTER the value runs:
+                # key it at the statement's end so `pool =
+                # update(pool, x)` (the correct idiom) ends clean
+                for t in node.targets:
+                    self._rebinds(t, events,
+                                  getattr(node, "end_lineno",
+                                          node.lineno), path)
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None:
+                # `pool: Pool = step(pool, x)` rebinds exactly like the
+                # unannotated spelling (a bare `pool: Pool` does not);
+                # the annotated jit-binding also registers as an alias
+                if isinstance(node.value, ast.Call):
+                    pos = _donated_positions(node.value) \
+                        if _is_jit_call(node.value) else None
+                    if pos and isinstance(node.target, ast.Name):
+                        aliases[node.target.id] = pos
+                self._rebinds(node.target, events,
+                              getattr(node, "end_lineno", node.lineno),
+                              path)
+            elif isinstance(node, ast.AugAssign):
+                self._rebinds(node.target, events,
+                              getattr(node, "end_lineno", node.lineno),
+                              path)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._rebinds(node.target, events, node.lineno, path)
+            elif isinstance(node, ast.Call):
+                pos = self._call_positions(node, info, reg, aliases)
+                if pos:
+                    label = _dotted(node.func) or "<call>"
+                    # anchor the taint at the call's END line: the
+                    # arguments of a multi-line call are reads of the
+                    # not-yet-donated value, not uses-after
+                    end = getattr(node, "end_lineno", node.lineno)
+                    for i in pos:
+                        if i < len(node.args) and \
+                                isinstance(node.args[i], ast.Name):
+                            events.append(
+                                (end, node.col_offset, "donate",
+                                 node.args[i].id,
+                                 (label, i, node.lineno), path))
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                events.append((node.lineno, node.col_offset, "read",
+                               node.id, None, path))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)) and \
+                        child is not info.node:
+                    continue
+                # branch sensitivity: an If's body and orelse are
+                # mutually exclusive — a donate in one arm cannot
+                # reach a read in the other
+                if isinstance(node, ast.If) and child in node.orelse:
+                    visit(child, path + ((id(node), "orelse"),))
+                elif isinstance(node, ast.If) and child in node.body:
+                    visit(child, path + ((id(node), "body"),))
+                else:
+                    visit(child, path)
+
+        visit(info.node, ())
+        events.sort(key=lambda e: (e[0], e[1]))
+        tainted = {}  # name -> (end line, label, argpos, line, path)
+        findings = []
+        for line, _col, kind, name, extra, path in events:
+            if kind == "rebind":
+                t = tainted.get(name)
+                # a rebind in a branch EXCLUSIVE with the donate does
+                # not clear the other arm's taint
+                if t is not None and not _exclusive(t[4], path):
+                    tainted.pop(name, None)
+            elif kind == "donate":
+                # the sort line is the call's END line — the call's
+                # own argument reads happen at or before it; taint
+                # only reads strictly after (extra[2] = the call's
+                # first line, for the message)
+                tainted[name] = (line, extra[0], extra[1], extra[2],
+                                 path)
+            elif kind == "read" and name in tainted:
+                dline, label, argpos, at, dpath = tainted[name]
+                if line <= dline:
+                    continue  # same-statement read (the arg itself)
+                if _exclusive(dpath, path):
+                    continue  # the donate's arm never reaches this one
+                findings.append(Finding(
+                    PASS_NAME, "use-after-donate", sf.rel, line,
+                    f"{name} read after being donated to {label}() "
+                    f"(arg {argpos}, donated at {sf.rel}:{at}) — "
+                    "the buffer was handed to XLA; rebind the name "
+                    "from the dispatch result or copy before donating"))
+                tainted.pop(name, None)  # one finding per taint
+        return findings
+
+    def _call_positions(self, call, info, reg, aliases):
+        pos = reg.lookup(call, info.class_name)
+        if pos:
+            return pos
+        f = call.func
+        if isinstance(f, ast.Name):
+            return aliases.get(f.id)
+        return None
+
+    @staticmethod
+    def _rebinds(target, events, at_line, path):
+        if isinstance(target, ast.Name):
+            events.append((at_line, 1 << 20, "rebind", target.id,
+                           None, path))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                UseAfterDonatePass._rebinds(el, events, at_line, path)
+        elif isinstance(target, ast.Starred):
+            UseAfterDonatePass._rebinds(target.value, events, at_line,
+                                        path)

@@ -6,7 +6,8 @@ PADDLE_TPU_METRICS_FILE export — docs/OBSERVABILITY.md): training step
 rollup (+ measured device time when the probe sampled), the compile
 ledger per executable, the serving SLO/goodput rollup, the distributed
 observatory's collective top-k by wall time and per-rank skew table,
-and every anomaly event (stragglers, spikes, retraces, NaNs) in order.
+every anomaly event (stragglers, spikes, retraces, NaNs) in order, and
+the static-analysis findings section (kind:"lint" — paddlelint).
 
 Plain json + arithmetic — no framework import, so it runs anywhere the
 JSONL landed (a laptop holding a pulled rank log included).
@@ -200,6 +201,35 @@ def section_events(recs, out, top):
     out.append("")
 
 
+def section_lint(recs, out, top):
+    """Static-analysis findings (kind:"lint" — tools/paddlelint.py,
+    docs/STATIC_ANALYSIS.md): unsuppressed findings are the headline
+    (a clean run renders none), suppressions roll up per pass."""
+    lints = [r for r in recs if r.get("kind") == "lint"]
+    if not lints:
+        return
+    live = [r for r in lints if not r.get("suppressed")]
+    sup = [r for r in lints if r.get("suppressed")]
+    out.append(f"== lint ==  ({len(live)} finding(s), {len(sup)} "
+               "suppressed with reasons)")
+    for r in live[:max(top, 5)]:
+        out.append(
+            f"  {r.get('severity', '?').upper()} "
+            f"[{r.get('pass', '?')}/{r.get('rule', '?')}] "
+            f"{r.get('file', '?')}:{r.get('line', '?')} "
+            f"{str(r.get('message', ''))[:100]}")
+    if len(live) > max(top, 5):
+        out.append(f"  ... and {len(live) - max(top, 5)} more")
+    by_pass = {}
+    for r in sup:
+        by_pass[r.get("pass", "?")] = by_pass.get(r.get("pass", "?"),
+                                                  0) + 1
+    if by_pass:
+        out.append("  suppressed: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(by_pass.items())))
+    out.append("")
+
+
 def render(recs, top=5):
     out = []
     ranks = sorted({r.get("rank", 0) for r in recs})
@@ -216,6 +246,7 @@ def render(recs, top=5):
     section_collectives(recs, out, top)
     section_ranks(recs, out)
     section_events(recs, out, top)
+    section_lint(recs, out, top)
     return "\n".join(out).rstrip() + "\n"
 
 

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""paddlelint — unified concurrency + tracing-safety static analysis.
+
+One driver, five passes (tools/lint/ — docs/STATIC_ANALYSIS.md):
+lock-order (static deadlock detection over the cross-module
+lock-acquisition graph), blocking-under-lock (file I/O, device reads,
+waits, JSONL export while holding a lock; unbounded explicit
+acquire()), unlocked-shared-state (thread-written fields read
+elsewhere lock-free), use-after-donate (reads of donated buffers
+after dispatch), and hot-sync (the check_no_hot_sync fence, now a
+framework pass — the old CLI is a thin shim over it).
+
+Runs from tier-1 like the other gates (tests/test_static_analysis.py)
+and inside the canonical workload (tools/_gate_common.py), emitting
+machine-readable `kind:"lint"` findings JSONL — schema enforced by
+tools/check_metrics_schema.py, rendered by tools/obs_report.py.
+
+Suppressions: `# lint-ok: <why>` (any pass) or
+`# lint-ok[pass-name]: <why>` on the finding's line; a marker without
+a reason is itself a finding. Pass-level region tables
+(hot_sync.HOT_REGIONS, blocking_under_lock.ALLOWED_BLOCKING) emit
+SUPPRESSED findings with the table's reason. LINT_BASELINE.json
+ratchets the per-pass suppressed counts: unsuppressed findings always
+fail; growth in suppressions fails until the baseline is raised BY
+HAND in the diff; `--update` only ever ratchets counts down.
+
+Usage:
+  python tools/paddlelint.py [ROOT] [--select p1,p2] [--jsonl OUT]
+                             [--baseline PATH] [--update] [--list]
+
+ROOT defaults to the repo; pointing it at a fixture corpus
+(tools/lint/fixtures/<pass>, with --select) must exit 1 — the linter
+proving it still catches its known-bad snippets. Exit 0 clean, 1
+findings/ratchet regression, 2 usage error.
+"""
+import argparse
+import json
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from lint import ALL_PASSES, PASS_NAMES, get_pass  # noqa: E402
+from lint import core  # noqa: E402
+
+REPO = os.path.dirname(_TOOLS)
+BASELINE_NAME = "LINT_BASELINE.json"
+
+
+def _rank():
+    """Process rank from the launch env (tools stay framework-free —
+    mirror of profiler/monitor.rank)."""
+    for var in ("PADDLE_TPU_PROCESS_ID", "PADDLE_TRAINER_ID"):
+        v = os.environ.get(var)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
+def run_passes(root=None, select=None):
+    """(findings, ctx) for the selected passes over `root` — the
+    library entry tools/_gate_common.py and the tests use. Findings
+    arrive suppression-applied, in pass-registration order."""
+    root = os.path.abspath(root or REPO)
+    # layout detection, not path identity: any repo-shaped checkout —
+    # symlinked spelling, git worktree, CI copy — gets the curated
+    # fileset (linter fixtures excluded); anything else (a fixture
+    # corpus dir) is walked whole
+    if os.path.isdir(os.path.join(root, "paddle_tpu")) and \
+            os.path.isdir(os.path.join(root, "tools", "lint")):
+        rels = core.default_fileset(root)
+    else:
+        rels = core.walk_fileset(root)
+    ctx = core.ProjectContext(root, rels)
+    findings = []
+    names = list(select) if select else list(PASS_NAMES)
+    for name in names:
+        findings.extend(get_pass(name).run(ctx))
+    findings = core.apply_suppressions(ctx, findings)
+    order = {n: i for i, n in enumerate(names + ["suppression"])}
+    findings.sort(key=lambda f: (order.get(f.pass_name, 99), f.file,
+                                 f.line))
+    return findings, ctx
+
+
+def records(findings):
+    """The `kind:"lint"` JSONL dicts for a finding list (suppressed
+    findings included — the ledger accounts for every deliberate
+    exemption)."""
+    rank = _rank()
+    return [f.record(rank=rank) for f in findings]
+
+
+def write_jsonl(path, findings):
+    with open(path, "a") as f:
+        for rec in records(findings):
+            f.write(json.dumps(rec) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddlelint", description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?", default=REPO,
+                    help="analysis root (default: the repo; point at "
+                         "a fixture corpus to prove a pass stays red)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated pass subset (default: all)")
+    ap.add_argument("--jsonl", default=None,
+                    help="append kind:'lint' findings JSONL here "
+                         "(PADDLE_TPU_METRICS_FILE is appended too "
+                         "when set)")
+    ap.add_argument("--baseline", default=None,
+                    help="ratchet file (default: ROOT/LINT_BASELINE."
+                         "json when present)")
+    ap.add_argument("--update", action="store_true",
+                    help="ratchet the baseline DOWN to the current "
+                         "suppressed counts (never up)")
+    ap.add_argument("--list", action="store_true",
+                    help="list passes and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for cls in ALL_PASSES:
+            doc = (sys.modules[cls.__module__].__doc__ or
+                   "").strip().splitlines()[0]
+            print(f"{cls.name:<24} {doc}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        unknown = [s for s in select if s not in PASS_NAMES]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)} "
+                  f"(known: {', '.join(PASS_NAMES)})", file=sys.stderr)
+            return 2
+
+    findings, _ctx = run_passes(args.root, select)
+
+    for f in findings:
+        print(f.render())
+
+    out = args.jsonl
+    envfile = os.environ.get("PADDLE_TPU_METRICS_FILE")
+    for path in {p for p in (out, envfile) if p}:
+        try:
+            write_jsonl(path, findings)
+        except OSError as e:
+            print(f"warning: could not write findings JSONL to "
+                  f"{path}: {e}", file=sys.stderr)
+
+    unsuppressed = [f for f in findings if not f.suppressed]
+    counts = core.suppressed_counts(findings)
+    selected = select or list(PASS_NAMES)
+
+    bl_path = args.baseline or os.path.join(args.root, BASELINE_NAME)
+    ratchet_errors = []
+    baseline = core.load_baseline(bl_path)
+    if baseline is None and os.path.exists(bl_path):
+        # a PRESENT but unreadable baseline must fail closed — a
+        # truncated/mangled file silently disabling the ratchet is
+        # exactly the regression the gate exists to prevent
+        ratchet_errors.append(
+            f"baseline {bl_path} exists but is not a valid "
+            f"{core.BASELINE_SCHEMA} file — fix or regenerate it")
+    if baseline is not None:
+        if args.update:
+            wrote, refused = core.update_baseline(
+                bl_path, baseline, counts, selected)
+            for name in refused:
+                ratchet_errors.append(
+                    f"--update refused for pass {name!r}: current "
+                    f"suppressed count "
+                    f"{counts.get(name, 0)} exceeds the baseline — "
+                    "the ratchet only tightens; raise the baseline "
+                    "by hand if the new suppression is justified")
+            if wrote:
+                print(f"baseline ratcheted: {bl_path}")
+        else:
+            ratchet_errors = core.check_baseline(
+                baseline, counts, selected)
+    elif args.baseline:
+        # an EXPLICITLY requested baseline that is missing fails
+        # closed, same as a corrupt one: a typo'd --baseline flag in a
+        # CI invocation must not silently disable the ratchet forever
+        ratchet_errors.append(
+            f"baseline {bl_path} was requested with --baseline but "
+            "does not exist — fix the path or create the baseline "
+            "with --update")
+
+    for err in ratchet_errors:
+        print(f"RATCHET: {err}")
+
+    n_sup = sum(counts.values())
+    if unsuppressed or ratchet_errors:
+        print(f"FAIL: {len(unsuppressed)} finding(s), "
+              f"{n_sup} suppressed, "
+              f"{len(ratchet_errors)} ratchet error(s)")
+        return 1
+    print(f"OK: 0 findings ({n_sup} suppressed with reasons) across "
+          f"{len(selected)} pass(es)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
