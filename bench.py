@@ -843,6 +843,131 @@ def _serve_gen_workload():
     }
 
 
+def _serve_router_workload():
+    """The FRONT-DOOR topology comparison behind `bench.py --serve`
+    (docs/SERVING.md "The front door"): the same mixed long/short
+    prompt set runs through (a) ONE GenerationEngine with 4 decode
+    slots and (b) a disaggregated 2-engine ServingRouter — a
+    prefill-role engine (2 slots) handing KV chains to a decode-role
+    engine (2 slots) over the SAME-SIZED shared page pool. Equal total
+    chips/slots, so `router_speedup_vs_single` is a scheduling win,
+    not a capacity one. Reports req/s, client-side TTFT p50/p99, fleet
+    SLO attainment, the handoff count, and token-for-token equality
+    (both paths decode greedily)."""
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    from paddle_tpu.inference import GenerationEngine, ServingRouter
+    from paddle_tpu.profiler import monitor as _pmon
+    from paddle_tpu.profiler import serve_observatory as _sobs
+
+    n_reqs = int(os.environ.get("BENCH_SERVE_ROUTER_REQS", "8"))
+    max_new = int(os.environ.get("BENCH_SERVE_GEN_NEW", "6"))
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(1)
+    system = rng.randint(0, 256, (16,))  # shared system prompt
+    # every 4th request is a long document; the rest are short chats —
+    # the regime where decoupling prefill from the decode cadence pays
+    lens = [40 if i % 4 == 0 else 4 for i in range(n_reqs)]
+    prompts = [np.concatenate([system, rng.randint(0, 256, (n,))])
+               for n in lens]
+
+    def run(submit, shutdown):
+        slo0 = _sobs.slo_report()["deadline"]
+        outs, ttfts = [None] * len(prompts), [None] * len(prompts)
+        t0 = time.perf_counter()
+        handles = [submit(p) for p in prompts]
+
+        def drain(i, h):
+            toks = []
+            for tok in h.tokens():
+                if not toks:
+                    ttfts[i] = time.perf_counter() - t0
+                toks.append(tok)
+            outs[i] = toks
+
+        threads = [threading.Thread(target=drain, args=(i, h))
+                   for i, h in enumerate(handles)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        shutdown()
+        slo1 = _sobs.slo_report()["deadline"]
+        slo_total = slo1["requests"] - slo0["requests"]
+        slo_met = slo1["met"] - slo0["met"]
+        ttfts_ms = sorted(1e3 * t for t in ttfts if t is not None)
+        return {
+            "outs": outs, "wall_s": round(wall, 3),
+            "req_per_sec": round(len(prompts) / wall, 2),
+            "gen_tokens_per_sec": round(
+                sum(len(o or []) for o in outs) / wall, 1),
+            "slo_attainment": round(slo_met / slo_total, 4)
+            if slo_total else 1.0,
+            "ttft_p50_ms": round(
+                ttfts_ms[len(ttfts_ms) // 2], 1) if ttfts_ms else 0.0,
+            "ttft_p99_ms": round(
+                ttfts_ms[min(len(ttfts_ms) - 1,
+                             int(0.99 * len(ttfts_ms)))], 1)
+            if ttfts_ms else 0.0,
+        }
+
+    # untimed compile pass: one short-decode run of the same prompt
+    # set compiles the shared (T, B, W) ragged signatures BEFORE either
+    # timed topology — the model's executable cache is per-process, so
+    # without this whichever topology ran first would pay the compiles
+    # the other one reuses
+    warm_eng = GenerationEngine(model, n_pages=128, page_size=8,
+                                max_batch=4, max_new_tokens=2,
+                                prefill_chunk=16, name="bench_warmup")
+    for h in [warm_eng.submit(p, max_new_tokens=2) for p in prompts]:
+        h.result(300)
+    warm_eng.shutdown()
+
+    # (a) single engine: 4 decode slots over one 128-page pool
+    eng = GenerationEngine(model, n_pages=128, page_size=8,
+                           max_batch=4, max_new_tokens=max_new,
+                           prefill_chunk=16, name="bench_single")
+    single = run(lambda p: eng.submit(p, max_new_tokens=max_new,
+                                      deadline_ms=120_000),
+                 eng.shutdown)
+    # (b) disaggregated router: prefill 2 + decode 2 slots, SAME pool
+    # size — equal chips. Signatures reuse (a)'s persistent-cache
+    # entries (same model config, same pool geometry).
+    h0 = _pmon.get_metric("serve.route_handoffs")
+    h0 = int(h0.value) if h0 else 0
+    router = ServingRouter.disaggregated(
+        model, n_pages=128, page_size=8, max_batch=2, prefill_batch=2,
+        max_new_tokens=max_new, prefill_chunk=16, name="bench_router")
+    routed = run(lambda p: router.submit(p, max_new_tokens=max_new,
+                                         deadline_ms=120_000),
+                 lambda: router.shutdown())
+    h1 = _pmon.get_metric("serve.route_handoffs")
+    handoffs = (int(h1.value) if h1 else 0) - h0
+    equal = single.pop("outs") == routed.pop("outs")
+    return {
+        "requests": n_reqs,
+        "topology": {"single": "1 engine x 4 slots, 128-page pool",
+                     "router": "prefill 2 + decode 2 slots, shared "
+                               "128-page pool"},
+        "single": single, "router": routed,
+        "router_equals_single": equal,
+        "handoff_count": handoffs,
+        "router_speedup_vs_single": round(
+            single["wall_s"] / routed["wall_s"], 3)
+        if routed["wall_s"] else 0.0,
+        "router_slo_attainment": routed["slo_attainment"],
+        "router_ttft_p50_ms": routed["ttft_p50_ms"],
+        "router_ttft_p99_ms": routed["ttft_p99_ms"],
+    }
+
+
 def _run_serve():
     """`bench.py --serve`: continuous-batching serving micro-benchmark
     (docs/SERVING.md). N concurrent closed-loop client threads drive one
@@ -955,6 +1080,16 @@ def _run_serve():
             gen = _serve_gen_workload()
         except Exception as e:
             gen = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    # disaggregated 2-engine router topology vs single engine at equal
+    # chips/slots (BENCH_SERVE_ROUTER=0 skips; failures degrade to an
+    # error key, never a dead bench)
+    router = None
+    if os.environ.get("BENCH_SERVE_ROUTER", "1") != "0":
+        _phase("router")
+        try:
+            router = _serve_router_workload()
+        except Exception as e:
+            router = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     _phase("done", serve_s=serve_s)
 
     lat.sort()
@@ -995,8 +1130,16 @@ def _run_serve():
         "compile_ledger": _compile_ledger_table(),
         "phases": dict(_PHASES),
     }
+    if router is not None:
+        headline["router"] = router
+        # the front-door acceptance numbers ride in the headline too
+        for k in ("router_speedup_vs_single", "router_slo_attainment",
+                  "handoff_count", "router_equals_single"):
+            if k in router:
+                headline[k] = router[k]
     if gen is not None:
         headline["generate"] = gen
+    if gen is not None or router is not None:
         # serve trajectory ACROSS rounds (the compile_history twin):
         # bench_state.json keeps the last 10 rounds of the headline
         # serving numbers so a regression in pad fraction / prefix hit
@@ -1012,8 +1155,13 @@ def _run_serve():
                   "ragged_equals_bucketed", "slo_attainment",
                   "goodput_tokens_per_s", "wasted_token_fraction",
                   "kv_peak_occupancy"):
-            if k in gen:
+            if gen is not None and k in gen:
                 entry[k] = gen[k]
+        for k in ("router_speedup_vs_single", "router_slo_attainment",
+                  "handoff_count", "router_equals_single",
+                  "router_ttft_p50_ms", "router_ttft_p99_ms"):
+            if router is not None and k in router:
+                entry[k] = router[k]
         history.append(entry)
         state["serve_history"] = history[-10:]
         _save_state(state)

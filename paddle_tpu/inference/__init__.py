@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from .serving import (InferenceEngine, GenerationEngine, GenerationHandle,
                       BucketLadder, ServingError, QueueFullError,
-                      DeadlineExceeded, EngineStopped)
+                      DeadlineExceeded, EngineStopped, SamplingParams)
+from .frontdoor import ServingRouter
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "DataType", "Tensor", "PredictorPool",
@@ -33,7 +34,9 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            # serving engine re-exports
            "InferenceEngine", "GenerationEngine", "GenerationHandle",
            "BucketLadder", "ServingError", "QueueFullError",
-           "DeadlineExceeded", "EngineStopped"]
+           "DeadlineExceeded", "EngineStopped", "SamplingParams",
+           # the serving front door (multi-engine router)
+           "ServingRouter"]
 
 
 class PrecisionType:

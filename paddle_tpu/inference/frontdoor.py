@@ -1,0 +1,461 @@
+"""The serving front door: a multi-engine router over N
+`GenerationEngine`s — load-aware admission, per-request SLO classes,
+sticky prefix-affinity placement, and prefill/decode disaggregation
+over a shared page pool (ROADMAP open item 3, the millions-of-users
+tier; vLLM-style architecture per PAPERS.md *Ragged Paged Attention*,
+arxiv 2604.15464).
+
+One `GenerationEngine` owns one model on one chip. `ServingRouter` is
+the tier above: callers submit HERE, and per-request placement is
+driven by each engine's `load_report()` — the admission snapshot PR 10
+built exactly for this (queue depth vs capacity, free batch slots,
+projected-admittable pages computed with the same claims math
+admission itself uses, TTFT/TPOT tail percentiles):
+
+- **load-aware dispatch** — candidates are scored per request: page
+  capacity for the request's worst case, queue pressure, free slots,
+  and (for the `interactive` SLO class) tail TTFT. A registered-prefix
+  match pins the request to the engine already holding those KV pages
+  (sticky prefix affinity: N users behind one system prompt land where
+  the system prompt's pages live, paying for its KV once).
+- **SLO classes** — `deadline_ms` maps onto an ordered class table
+  (default: `interactive` ≤ 10 s, `standard` ≤ 120 s, else `batch`);
+  the class is stamped on the route record and weights the placement.
+- **fast-fail backpressure** — when EVERY candidate engine is
+  saturated the router raises `QueueFullError` immediately (the
+  engines' own admission contract, one tier up): the caller sheds load
+  at the front door instead of timing out deep in a queue.
+- **prefill/decode disaggregation** — engines constructed over ONE
+  shared `PagedKVCache` split roles: a `prefill` engine chunk-prefills
+  prompts and streams each first token, then hands the KV chain to a
+  `decode` engine via `PagedKVCache.export_chain`/`adopt_chain` — page
+  ids move, refcounts carry, NOTHING is copied — and decode continues
+  token-for-token equal to a single-engine run (tests assert the
+  handoff down to page identity). Decode cadence never pauses for a
+  long prompt's prefill; prefill throughput never queues behind a
+  deep decode batch.
+
+Every routing decision emits a `kind:"route"` record through the
+serving observatory pipeline (flight-recorder ring always, JSONL when
+`PADDLE_TPU_METRICS_FILE` is set; schema enforced by
+tools/check_metrics_schema.py, rendered by tools/obs_report.py
+"== routing =="), plus `serve.route_*` metrics. `router.load_report()`
+aggregates the fleet (page pools deduplicated — a disaggregated pair
+shares one). See docs/SERVING.md "The front door".
+"""
+import threading
+
+import numpy as np
+
+from ..profiler import monitor as _monitor
+from ..profiler import serve_observatory as _obs
+from .serving import (GenerationEngine, QueueFullError, EngineStopped,
+                      SamplingParams)
+
+__all__ = ["ServingRouter", "ROUTE_OUTCOMES"]
+
+ROUTE_OUTCOMES = ("dispatched", "rejected", "handoff")
+
+# default SLO class table: ordered (name, max deadline_ms); a request
+# whose deadline fits no row (or carries none) is class "batch"
+DEFAULT_SLO_CLASSES = (("interactive", 10_000), ("standard", 120_000))
+
+
+class ServingRouter:
+    """Load-aware front door over N `GenerationEngine`s.
+
+        # load-balanced fleet (each engine its own model/pool):
+        router = ServingRouter([eng_a, eng_b])
+
+        # disaggregated pair (one shared pool, split roles):
+        router = ServingRouter.disaggregated(model, n_pages=256,
+                                             page_size=16, max_batch=8)
+
+        h = router.submit(prompt_ids, max_new_tokens=64,
+                          deadline_ms=5_000,
+                          sampling=SamplingParams(temperature=0.8,
+                                                  top_p=0.9, seed=7))
+        for tok in h.tokens(): ...
+
+    `roles` (per engine): ``both`` (default — admits and decodes),
+    ``prefill`` (admits + chunk-prefills, hands every chain off),
+    ``decode`` (never admits from the router; only adopts chains).
+    Prefill engines must share their `PagedKVCache` with at least one
+    decode/both engine — the handoff moves page ids, it cannot cross
+    pools. The router wires the prefill engines' handoff dispatchers;
+    it does not own the engines' lifecycles beyond `drain`/`shutdown`
+    convenience fan-outs."""
+
+    def __init__(self, engines, roles=None, slo_classes=None,
+                 name="router"):
+        if not engines:
+            raise ValueError("ServingRouter needs at least one engine")
+        for eng in engines:
+            if not isinstance(eng, GenerationEngine):
+                raise TypeError(
+                    "ServingRouter routes GenerationEngines, got "
+                    f"{type(eng).__name__}")
+        names = [e.name for e in engines]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"engine names must be unique, got {names}")
+        self.name = str(name)
+        self.engines = list(engines)
+        roles = list(roles) if roles is not None \
+            else ["both"] * len(engines)
+        if len(roles) != len(engines):
+            raise ValueError("roles must match engines 1:1")
+        for r in roles:
+            if r not in ("both", "prefill", "decode"):
+                raise ValueError(
+                    f"role {r!r} not one of both/prefill/decode")
+        self.roles = dict(zip(names, roles))
+        if all(r == "decode" for r in roles):
+            raise ValueError(
+                "ServingRouter needs at least one submit-capable "
+                "(both/prefill) engine — an all-decode fleet can "
+                "never admit a request")
+        self._slo_classes = tuple(slo_classes) if slo_classes \
+            else DEFAULT_SLO_CLASSES
+        self._lock = threading.Lock()
+        self._stats = {"requests": 0, "dispatched": 0, "rejected": 0,
+                       "handoffs": 0, "prefix_affinity": 0}
+        self._rr = 0  # round-robin tiebreak cursor
+        # wire disaggregation: every prefill engine hands off to a
+        # decode-capable engine on the SAME pool
+        self._decoders_of = {}
+        for eng, role in zip(self.engines, roles):
+            if role != "prefill":
+                continue
+            mates = [d for d, dr in zip(self.engines, roles)
+                     if d is not eng and dr in ("decode", "both")
+                     and d.cache is eng.cache and d.ragged]
+            if not mates:
+                raise ValueError(
+                    f"prefill engine {eng.name!r} has no ragged "
+                    "decode-role engine sharing its page pool — the "
+                    "chain handoff moves page ids (it cannot cross "
+                    "pools) and only the ragged scheduler adopts them")
+            self._decoders_of[eng.name] = mates
+            eng.set_handoff(self._handoff_dispatcher(eng))
+
+    # -- construction sugar ---------------------------------------------
+    @staticmethod
+    def disaggregated(model, n_pages=256, page_size=16, max_batch=8,
+                      prefill_batch=None, name="router", **engine_kw):
+        """A ready-made disaggregated pair over ONE shared page pool:
+        a prefill-role engine (admission + chunked prefill) and a
+        decode-role engine (adopted chains only), with `max_batch`
+        decode slots and `prefill_batch` (default max_batch) prefill
+        slots. Returns the wired ServingRouter; the engines are
+        reachable as `router.engines`."""
+        cache = model.make_paged_cache(n_pages, page_size)
+        pre = GenerationEngine(
+            model, cache=cache, max_batch=prefill_batch or max_batch,
+            name=f"{name}_prefill", **engine_kw)
+        dec = GenerationEngine(
+            model, cache=cache, max_batch=max_batch,
+            name=f"{name}_decode", **engine_kw)
+        return ServingRouter([pre, dec], roles=("prefill", "decode"),
+                             name=name)
+
+    # -- SLO classes -----------------------------------------------------
+    def slo_class(self, deadline_ms):
+        """Map a request deadline onto its SLO class name (the ordered
+        class table given at construction; None or beyond every bound
+        is "batch")."""
+        if deadline_ms is not None:
+            for cls, bound in self._slo_classes:
+                if deadline_ms <= bound:
+                    return cls
+        return "batch"
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
+               deadline_ms=None, sampling=None):
+        """Route one generation request onto the fleet and return its
+        GenerationHandle. Placement is load-aware (see module doc);
+        a QueueFullError means EVERY candidate engine was saturated —
+        shed load at the front door. ValueErrors (context limit, bad
+        sampling config) propagate from the first engine consulted:
+        they would fail identically everywhere."""
+        # np.array (a copy) rather than asarray: prompts are tiny, and
+        # the whole module is hot-sync-fenced — no D2H-read idiom here
+        prompt = np.array(prompt_ids).reshape(-1)
+        candidates = [e for e in self.engines
+                      if self.roles[e.name] != "decode"]
+        cls = self.slo_class(deadline_ms)
+        with self._lock:
+            self._stats["requests"] += 1
+            self._rr += 1
+            rr = self._rr
+        ranked, any_open, affinity_of, reports = self._rank(
+            candidates, prompt, max_new_tokens, cls, rr)
+        fleet = [e.name for e in self.engines]
+        # ONE load_report per engine per decision: every consumer below
+        # reuses _rank's snapshots (a report re-read acquires the
+        # engine's _cv — the exact lock its scheduler thread runs on)
+        fleet_depth = sum(r.get("queue_depth", 0)
+                          for r in reports.values())
+        if not any_open:
+            with self._lock:
+                self._stats["rejected"] += 1
+            _monitor.counter("serve.route_rejected").inc()
+            self._route_record(
+                engine=ranked[0].name if ranked else "?", fleet=fleet,
+                outcome="rejected", slo_class=cls,
+                queue_depth=fleet_depth, deadline_ms=deadline_ms)
+            raise QueueFullError(
+                f"router {self.name!r}: all {len(candidates)} "
+                "submit-capable engines are saturated — shed load or "
+                "grow the fleet")
+        last_exc = None
+        for eng in ranked:
+            try:
+                handle = eng.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    eos_token_id=eos_token_id, deadline_ms=deadline_ms,
+                    sampling=sampling)
+            except (QueueFullError, EngineStopped) as e:
+                last_exc = e  # load-shed THIS engine; try the next
+                continue
+            affinity = affinity_of.get(eng.name, 0)
+            with self._lock:
+                self._stats["dispatched"] += 1
+                if affinity:
+                    self._stats["prefix_affinity"] += 1
+            _monitor.counter("serve.route_requests").inc()
+            if affinity:
+                _monitor.counter("serve.route_prefix_affinity").inc()
+            _monitor.gauge("serve.route_queue_depth").set(fleet_depth)
+            self._route_record(
+                engine=eng.name, fleet=fleet, outcome="dispatched",
+                slo_class=cls,
+                queue_depth=int(reports[eng.name]
+                                .get("queue_depth", 0)),
+                prefix_affinity=bool(affinity),
+                prefix_match_pages=int(affinity),
+                deadline_ms=deadline_ms,
+                request_id=getattr(handle.trace, "request_id", None))
+            return handle
+        with self._lock:
+            self._stats["rejected"] += 1
+        _monitor.counter("serve.route_rejected").inc()
+        self._route_record(
+            engine=ranked[0].name, fleet=fleet, outcome="rejected",
+            slo_class=cls, queue_depth=fleet_depth,
+            deadline_ms=deadline_ms)
+        raise last_exc if last_exc is not None else QueueFullError(
+            f"router {self.name!r}: no engine admitted the request")
+
+    def _rank(self, candidates, prompt, max_new_tokens, cls, rr):
+        """(engines best-first, any_open, {engine: matched prefix
+        pages}, {engine: load_report}). Scoring blends page capacity
+        for this request's worst case, queue pressure, slot
+        availability, tail TTFT (weighted up for the interactive
+        class) and sticky prefix affinity — an engine already holding
+        a registered chain of this prompt's pages outranks a colder,
+        equally-loaded peer. The reports are returned so the caller
+        never re-reads what this pass already snapshot."""
+        scored, affinity_of, reports = [], {}, {}
+        any_open = False
+        for i, eng in enumerate(candidates):
+            rep = reports[eng.name] = self._safe_report(eng)
+            score = 0.0
+            if "unavailable" in rep:
+                score += 100.0  # wedged engine: last resort
+            else:
+                max_q = max(int(rep.get("max_queue", eng.max_queue)), 1)
+                q = int(rep.get("queue_depth", 0))
+                saturated = q >= max_q
+                if not saturated:
+                    any_open = True
+                score += 2.0 * q / max_q + (10.0 if saturated else 0.0)
+                if int(rep.get("slots_free", 0)) <= 0:
+                    score += 1.0
+                need = eng.cache.pages_needed(
+                    prompt.size + (max_new_tokens
+                                   or eng.default_max_new))
+                if int(rep.get("admittable_pages", 0)) < need:
+                    score += 4.0
+                ttft_w = 0.5 if cls == "interactive" else 0.05
+                score += ttft_w * min(
+                    rep.get("ttft_p99_s", 0.0) or 0.0, 10.0)
+            matched = self._prefix_match_pages(eng, prompt)
+            if matched:
+                affinity_of[eng.name] = matched
+                score -= 3.0
+            # round-robin epsilon: equal scores rotate instead of
+            # pinning everything on list order
+            scored.append((score, (i + rr) % max(len(candidates), 1),
+                           eng))
+        scored.sort(key=lambda t: (t[0], t[1]))
+        return ([e for _, _, e in scored], any_open, affinity_of,
+                reports)
+
+    def _prefix_match_pages(self, eng, prompt):
+        """Fully-matched registered-prefix pages this engine's pool
+        already holds for `prompt` (0 on a cold pool). Bounded lock
+        acquire: a busy pool just forfeits the affinity bonus."""
+        if not eng.prefix_cache or prompt.size < 2:
+            return 0
+        if not eng.cache.lock.acquire(timeout=0.2):
+            return 0
+        try:
+            _, full = eng.cache.match_prefix(
+                prompt, max_tokens=int(prompt.size) - 1)
+            return int(full)
+        except Exception:
+            return 0
+        finally:
+            eng.cache.lock.release()
+
+    @staticmethod
+    def _safe_report(eng):
+        try:
+            return eng.load_report()
+        except Exception as e:  # a dying engine must not kill routing
+            return {"engine": eng.name,
+                    "unavailable": f"{type(e).__name__}: {e}"[:120]}
+
+    # -- disaggregation --------------------------------------------------
+    def _handoff_dispatcher(self, pre):
+        """The prefill engine's handoff callback (runs on ITS
+        scheduler thread, holding no locks): place the exported chain
+        on the least-active decode mate, then emit the handoff route
+        record + counters."""
+        def dispatch(seq, chain):
+            mates = self._decoders_of[pre.name]
+            dec = min(mates, key=lambda d: self._active_of(d))
+            pages_moved = len(chain.pages)
+            chain_tokens = int(chain.length)
+            dec.adopt(handle=seq.handle, chain=chain,
+                      last_token=seq.last, generated=seq.generated,
+                      cached=seq.cached)
+            with self._lock:
+                self._stats["handoffs"] += 1
+            _monitor.counter("serve.route_handoffs").inc()
+            self._route_record(
+                engine=dec.name, fleet=[e.name for e in self.engines],
+                outcome="handoff",
+                # the SUBMIT-time deadline, not the time remaining: one
+                # request carries one class across its dispatched and
+                # handoff records
+                slo_class=self.slo_class(seq.handle.deadline_ms),
+                queue_depth=self._active_of(dec),
+                from_engine=pre.name, pages_moved=pages_moved,
+                chain_tokens=chain_tokens,
+                page_size=int(pre.cache.page_size),
+                request_id=getattr(seq.handle.trace, "request_id",
+                                   None))
+        return dispatch
+
+    @staticmethod
+    def _active_of(eng):
+        rep = ServingRouter._safe_report(eng)
+        return int(rep.get("active", 0)) + int(rep.get("queue_depth", 0))
+
+    # -- telemetry -------------------------------------------------------
+    def _route_record(self, engine, fleet, outcome, slo_class,
+                      queue_depth, **extra):
+        """One `kind:"route"` record per routing decision (dispatch /
+        reject / handoff) through the standard export pipeline —
+        flight-recorder ring always, metrics JSONL when configured.
+        Never raises; telemetry must not take down admission."""
+        try:
+            rec = {"router": self.name, "engine": str(engine),
+                   "fleet": list(fleet), "outcome": str(outcome),
+                   "slo_class": str(slo_class),
+                   "queue_depth": max(int(queue_depth), 0)}
+            for k, v in extra.items():
+                if v is not None:
+                    rec[k] = v
+            _monitor.export_step(rec, kind="route")
+        except Exception:
+            pass
+
+    # -- fleet aggregation ----------------------------------------------
+    def load_report(self):
+        """The fleet's admission snapshot: each engine's
+        `load_report()` verbatim plus a rollup — total queue depth and
+        free slots, projected-admittable pages summed over UNIQUE page
+        pools (a disaggregated pair shares one pool; summing per
+        engine would double-count it), saturated engines by name, and
+        the router's own routing counters."""
+        reports = {e.name: self._safe_report(e) for e in self.engines}
+        pools, admittable, free_pages = {}, 0, 0
+        for eng in self.engines:
+            if id(eng.cache) in pools:
+                continue
+            pools[id(eng.cache)] = eng.name
+            rep = reports[eng.name]
+            admittable += int(rep.get("admittable_pages", 0))
+            free_pages += int(rep.get("free_pages", 0))
+        saturated = [
+            e.name for e in self.engines
+            if "unavailable" in reports[e.name]
+            or reports[e.name].get("queue_depth", 0)
+            >= reports[e.name].get("max_queue", e.max_queue)]
+        with self._lock:
+            stats = dict(self._stats)
+        return {
+            "router": self.name,
+            "engines": reports,
+            "roles": dict(self.roles),
+            "fleet": {
+                "n_engines": len(self.engines),
+                "n_pools": len(pools),
+                "queue_depth": sum(r.get("queue_depth", 0)
+                                   for r in reports.values()),
+                "slots_free": sum(r.get("slots_free", 0)
+                                  for r in reports.values()),
+                "active": sum(r.get("active", 0)
+                              for r in reports.values()),
+                "admittable_pages": admittable,
+                "free_pages": free_pages,
+                "saturated": saturated,
+            },
+            "routing": stats,
+        }
+
+    def slo_report(self):
+        """Deadline attainment / goodput rollup (process-global — the
+        serving observatory aggregates across the fleet's engines)."""
+        return _obs.slo_report()
+
+    # -- warmup / lifecycle fan-outs -------------------------------------
+    def warm_async(self, prompt_len, max_new_tokens=None):
+        """Submit background AOT compiles of the signature schedule on
+        every engine (shared models dedupe through the single-flight
+        warm pipeline — a disaggregated pair over one model compiles
+        each signature once). Returns jit.warm.WarmHandles."""
+        handles = []
+        for eng in self.engines:
+            handles.extend(eng.warm_async(prompt_len, max_new_tokens))
+        return handles
+
+    def warm(self, prompt_len, max_new_tokens=None):
+        """Blocking warm_async; returns the count compiled now."""
+        from ..jit import warm as _warm
+        handles = self.warm_async(prompt_len, max_new_tokens)
+        _warm.join(handles)
+        return sum(1 for h in handles if h.fresh)
+
+    def drain(self, timeout=None):
+        """Stop admission and wait for the whole fleet to empty —
+        submit-capable engines first (their last chains hand off),
+        decode-role engines after (they finish the adopted tail)."""
+        order = sorted(self.engines,
+                       key=lambda e: self.roles[e.name] == "decode")
+        ok = True
+        for eng in order:
+            ok = eng.drain(timeout=timeout) and ok
+        return ok
+
+    def shutdown(self, wait=True):
+        """Shut the fleet down (prefill/both first, decode last, so a
+        draining handoff still finds its decode engine alive)."""
+        order = sorted(self.engines,
+                       key=lambda e: self.roles[e.name] == "decode")
+        for eng in order:
+            eng.shutdown(wait=wait)

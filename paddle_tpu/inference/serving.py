@@ -68,9 +68,20 @@ snapshot a load-aware router consumes). See docs/SERVING.md
 
 The dispatcher and decode loops are fenced by tools/check_no_hot_sync.py:
 the ONLY host blocks are the scheduler's queue wait and the one
-deliberate device read per batch (marked `# hot-sync-ok:`); sampling is
-an on-device argmax collected through an async copy — int32s cross to
-the host, never [vocab]-sized logits.
+deliberate device read per batch (marked `# hot-sync-ok:`); sampling
+runs ON DEVICE (seeded temperature/top-k/top-p per request via
+`SamplingParams`, argmax when temperature is 0) and is collected
+through an async copy — int32s cross to the host, never [vocab]-sized
+logits.
+
+`GenerationEngine` also speaks the prefill/decode DISAGGREGATION
+protocol the serving front door (`paddle_tpu/inference/frontdoor.py`
+`ServingRouter`) orchestrates: an engine with a handoff wired
+(`set_handoff`) plays the PREFILL role — it chunk-prefills a prompt,
+streams the first token, then moves the KV chain to a decode-role
+engine via `PagedKVCache.export_chain` / `adopt()` without copying a
+page (both engines share one pool; see docs/SERVING.md "The front
+door").
 """
 import itertools
 import threading
@@ -90,7 +101,65 @@ from ..profiler import statistic as _stat
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
            "EngineStopped", "BucketLadder", "InferenceEngine",
-           "GenerationEngine", "GenerationHandle"]
+           "GenerationEngine", "GenerationHandle", "SamplingParams"]
+
+
+class SamplingParams:
+    """Per-request decode sampling config (`GenerationEngine.submit`/
+    `ServingRouter.submit`, ragged path only — the legacy bucketed
+    path stays greedy). The defaults ARE today's behavior:
+    temperature 0 is the on-device argmax, bit-exact with the
+    pre-sampling path.
+
+    temperature > 0 enables seeded on-device sampling; `top_k` keeps
+    the k highest logits (None/0 disables), `top_p` keeps the smallest
+    nucleus reaching that probability mass (None/1.0 disables), both
+    applied before one `jax.random.categorical` draw per token. `seed`
+    makes the request reproducible: the per-token key is
+    fold_in(PRNGKey(seed), absolute token position), so the sampled
+    text does not depend on batching, admit/evict order, or which
+    engine of a disaggregated pair decoded it. seed=None draws a
+    fresh deterministic-per-process seed at submit."""
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=0.0, top_k=None, top_p=None,
+                 seed=None):
+        self.temperature = float(temperature)
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        self.top_k = None if not top_k else int(top_k)
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.top_p = None if top_p is None else float(top_p)
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {top_p}")
+        self.seed = None if seed is None else int(seed)
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
+
+    def key_data(self, fallback_seed=0):
+        """uint32[2] threefry key data for this request's seed (host
+        bit math — no device op at submit). ONE layout source: the
+        gpt helper next to the sampler that consumes these keys."""
+        from ..models.gpt import sampling_key_data
+        seed = self.seed if self.seed is not None else int(fallback_seed)
+        return sampling_key_data(seed)
+
+    def __repr__(self):
+        return (f"SamplingParams(temperature={self.temperature}, "
+                f"top_k={self.top_k}, top_p={self.top_p}, "
+                f"seed={self.seed})")
+
+
+GREEDY = SamplingParams()
+# seeds for seed=None sampling requests: deterministic per-process
+# submit order, never colliding across engines
+_SEED_IDS = itertools.count(1)
 
 
 class ServingError(RuntimeError):
@@ -913,7 +982,12 @@ class GenerationHandle:
         self._closed = False
         self.t_submit = time.perf_counter()
         self.deadline = None  # perf_counter bound (submit deadline_ms=)
+        self.deadline_ms = None  # the submit-time value, verbatim (a
+        # router's handoff record re-derives the SLO class from THIS,
+        # not from the time remaining — one request, one class)
         self.trace = None     # serve_observatory RequestTrace
+        self.sampling = GREEDY  # SamplingParams (submit sampling=)
+        self.key = None         # uint32[2] per-request base PRNG key
 
     def _push(self, tok):
         with self._cv:
@@ -952,7 +1026,7 @@ class GenerationHandle:
 
 class _ActiveSeq:
     __slots__ = ("sid", "handle", "generated", "last", "reserve",
-                 "cached", "filled")
+                 "cached", "filled", "sampling", "key")
 
     def __init__(self, sid, handle, reserve, cached=0):
         self.sid = sid
@@ -962,6 +1036,8 @@ class _ActiveSeq:
         self.reserve = reserve  # worst-case pages this request may draw
         self.cached = cached    # prompt tokens served by the prefix cache
         self.filled = cached    # prompt tokens whose KV is in the pool
+        self.sampling = handle.sampling  # SamplingParams
+        self.key = handle.key            # uint32[2] base PRNG key
 
 
 class GenerationEngine(_SchedulerLifecycle):
@@ -998,10 +1074,24 @@ class GenerationEngine(_SchedulerLifecycle):
     FULL attention work, which is what the ragged path eliminates).
 
     Either way sequences free their pages on finish without stalling
-    neighbors, and decoding is greedy (argmax, computed ON DEVICE so
-    only int32 tokens cross to the host) — deterministic,
-    token-for-token equal to a single-sequence paged decode of the
-    same prompt."""
+    neighbors. Decoding defaults to greedy (temperature 0 — an
+    on-device argmax, deterministic and token-for-token equal to a
+    single-sequence paged decode of the same prompt); on the ragged
+    path `submit(..., sampling=SamplingParams(temperature=, top_k=,
+    top_p=, seed=))` switches a request to REAL seeded sampling,
+    computed inside the same fixed-shape jitted step (per-row config
+    arrays — admit/evict never changes the compiled signature, and
+    only int32 tokens ever cross to the host). The legacy bucketed
+    path stays greedy-only.
+
+    Disaggregation (the front door, docs/SERVING.md): `set_handoff(fn)`
+    makes this engine the PREFILL role — a prompt whose last chunk
+    just produced its first token is exported as a `KVChainHandle`
+    (page ids, zero copies) and `fn(seq, chain)` moves it to a
+    decode-role engine's `adopt()` over the SAME shared page pool.
+    Admission reservations live pool-wide in the cache's claims
+    ledger, so two engines admitting against one pool never
+    double-book a page."""
 
     def __init__(self, model, n_pages=256, page_size=16, max_batch=8,
                  max_queue=64, max_new_tokens=64, eos_token_id=None,
@@ -1040,6 +1130,9 @@ class GenerationEngine(_SchedulerLifecycle):
         self._active = []        # list of _ActiveSeq, decode-batch order
         self._prefilling = []    # admitted, prompt KV still chunking in
         self._admitting = 0      # popped from pending, prefill in flight
+        self._handoff_fn = None  # set_handoff: this engine = prefill role
+        self._adopted = deque()  # chains handed to this engine (decode
+        # role), adopted into _active by the scheduler thread
         self._step_prefix_hits = 0  # prefix tokens since last record
         self._cv = threading.Condition()
         self._stopping = False
@@ -1058,7 +1151,7 @@ class GenerationEngine(_SchedulerLifecycle):
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
-               deadline_ms=None):
+               deadline_ms=None, sampling=None):
         """Queue one prompt (1-D int array) for generation; returns a
         GenerationHandle. Rejects immediately (QueueFullError) when the
         queue is full, and validates the context limit up front. A
@@ -1066,12 +1159,27 @@ class GenerationEngine(_SchedulerLifecycle):
         fails the handle with DeadlineExceeded (outcome "expired") —
         in-flight generation is never killed by its deadline, but the
         request record states whether it was met (`deadline_met`), and
-        the SLO aggregates count it."""
+        the SLO aggregates count it.
+
+        `sampling` (SamplingParams) picks this request's decode
+        strategy: the default is greedy (temperature 0, bit-exact with
+        the pre-sampling argmax path); temperature > 0 enables seeded
+        on-device temperature/top-k/top-p sampling — ragged path only
+        (the legacy bucketed decode stays greedy)."""
         prompt = np.asarray(
             prompt_ids.value if isinstance(prompt_ids, Tensor)
             else prompt_ids).astype(np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
+        sp = GREEDY if sampling is None else sampling
+        if not isinstance(sp, SamplingParams):
+            raise TypeError(
+                f"sampling must be a SamplingParams, got "
+                f"{type(sp).__name__}")
+        if not sp.greedy and not self.ragged:
+            raise ValueError(
+                "sampling (temperature > 0) needs the ragged engine "
+                "path — the legacy bucketed decode is greedy-only")
         max_new = int(max_new_tokens) if max_new_tokens is not None \
             else self.default_max_new
         if max_new < 1:
@@ -1092,9 +1200,16 @@ class GenerationEngine(_SchedulerLifecycle):
                 "admitted; grow n_pages or shorten the request")
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         handle = GenerationHandle(prompt, max_new, eos)
+        handle.sampling = sp
+        # key data is host bit math; seed=None draws a process-unique
+        # deterministic seed so an unseeded request still reproduces
+        # within one process run
+        handle.key = sp.key_data(fallback_seed=0) if sp.greedy \
+            else sp.key_data(fallback_seed=next(_SEED_IDS))
         if deadline_ms is not None:
             handle.deadline = time.perf_counter() \
                 + float(deadline_ms) / 1000.0
+            handle.deadline_ms = float(deadline_ms)
         handle.trace = _obs.start_request(
             self.name, prompt_tokens=int(prompt.size),
             max_new_tokens=max_new,
@@ -1134,12 +1249,12 @@ class GenerationEngine(_SchedulerLifecycle):
         no strong engine ref in between."""
         with self._cv:
             if not self._pending and not self._active \
-                    and not self._prefilling:
+                    and not self._prefilling and not self._adopted:
                 if self._stopping:
                     return False
                 self._cv.wait(0.05)  # idle: wait for work
                 if not self._pending and not self._active \
-                        and not self._prefilling:
+                        and not self._prefilling and not self._adopted:
                     return True  # still idle: let the runner drop its ref
         if self._abort:
             # shutdown(wait=False): a long in-flight generation must
@@ -1149,6 +1264,7 @@ class GenerationEngine(_SchedulerLifecycle):
             return False
         try:
             if self.ragged:
+                self._drain_adopted()
                 self._admit_ragged()
                 if self._active or self._prefilling:
                     self._ragged_step()
@@ -1231,19 +1347,27 @@ class GenerationEngine(_SchedulerLifecycle):
                     if len(self._active) >= self.max_batch:
                         return
                     handle = self._pending[0]
-                    need = self.cache.pages_needed(
-                        handle.prompt.size + handle.max_new_tokens)
-                    # allocation is LAZY: active sequences still hold
-                    # claims on pages they haven't drawn yet — admit
-                    # only against what's free AFTER every outstanding
-                    # reservation
-                    outstanding = sum(
-                        max(s.reserve - self.cache.pages_drawn(s.sid), 0)
-                        for s in self._active)
-                    if not self.cache.can_allocate(
-                            handle.prompt.size + handle.max_new_tokens,
-                            reserved=outstanding):
-                        return  # wait for evictions to free pages
+                    # the cache lock spans the capacity check AND the
+                    # claim registration: a second engine sharing this
+                    # pool cannot admit into the same free pages
+                    # between the two (claims are POOL-wide — see
+                    # PagedKVCache.outstanding_claims)
+                    with self.cache.lock:
+                        need = self.cache.pages_needed(
+                            handle.prompt.size + handle.max_new_tokens)
+                        # allocation is LAZY: live sequences still hold
+                        # claims on pages they haven't drawn yet —
+                        # admit only against what's free AFTER every
+                        # outstanding reservation on this pool
+                        outstanding = self.cache.outstanding_claims()
+                        if not self.cache.can_allocate(
+                                handle.prompt.size
+                                + handle.max_new_tokens,
+                                reserved=outstanding):
+                            return  # wait for evictions to free pages
+                        sid = self._new_sid()
+                        self.cache.add_sequence(sid)
+                        self.cache.set_claim(sid, need)
                     self._pending.popleft()
                     self._admitting += 1  # drain() must see the handoff
                     _monitor.gauge("serve.queue_depth").set(
@@ -1254,9 +1378,6 @@ class GenerationEngine(_SchedulerLifecycle):
                 self._close_doomed(doomed)
                 continue
             try:
-                sid = f"g{self._next_sid}"
-                self._next_sid += 1
-                self.cache.add_sequence(sid)
                 seq = _ActiveSeq(sid, handle, need)
                 try:
                     logits = self.model.paged_decode_step(
@@ -1271,7 +1392,8 @@ class GenerationEngine(_SchedulerLifecycle):
                     tok_dev.copy_to_host_async()
                     tok = int(tok_dev)
                 except Exception as e:
-                    self.cache.free_sequence(sid)
+                    with self.cache.lock:
+                        self.cache.free_sequence(sid)
                     _reject_future(handle.future, e)
                     _finish_trace(handle.trace, e)
                     handle._close()
@@ -1285,6 +1407,135 @@ class GenerationEngine(_SchedulerLifecycle):
                 with self._cv:
                     self._admitting -= 1
                     self._cv.notify_all()
+
+    def _new_sid(self):
+        """Engine-unique sequence id. Prefixed with the engine name:
+        several engines sharing one page pool (prefill/decode
+        disaggregation) must never collide on a sid."""
+        sid = f"{self.name}.g{self._next_sid}"
+        self._next_sid += 1
+        return sid
+
+    # -- prefill/decode disaggregation (the front door) ------------------
+    def set_handoff(self, fn):
+        """Wire this engine as the PREFILL role of a disaggregated
+        pair: when a prompt's last chunk produces its first token, the
+        sequence's KV chain is exported (`PagedKVCache.export_chain` —
+        page ids move, nothing copies) and `fn(seq, chain)` is called
+        on the scheduler thread to place it on a decode-role engine
+        (normally `ServingRouter`'s handoff dispatcher calling
+        `decode_engine.adopt`). fn raising fails the request onto its
+        handle and releases the chain. Pass None to unwire."""
+        if fn is not None and not self.ragged:
+            raise ValueError(
+                "prefill-role handoff needs the ragged engine path")
+        self._handoff_fn = fn  # lint-ok[unlocked-shared-state]: one-shot wiring at router construction, before any traffic; a function-reference store is GIL-atomic and the loop thread only reads it
+
+    def adopt(self, handle, chain, last_token, generated, cached=0):
+        """DECODE-role entry (any thread): accept a chain prefilled by
+        another engine over the SAME shared page pool. The scheduler
+        thread attaches it under a fresh sid (`adopt_chain` — page
+        identity, refcounts, and the admission claim all carry over)
+        and the sequence joins the decode batch at its next step,
+        continuing token-for-token as if it had prefetched here."""
+        if not self.ragged:
+            # symmetric with set_handoff's prefill-side guard: only the
+            # ragged scheduler drains _adopted — accepting the chain
+            # here would park it (and its pages + claim) forever
+            raise ValueError(
+                "decode-role adoption needs the ragged engine path")
+        with self._cv:
+            if self._stopping:
+                raise EngineStopped(
+                    "decode engine is drained/shut down")
+            self._adopted.append(
+                (handle, chain, int(last_token), list(generated),
+                 int(cached)))
+            self._cv.notify_all()
+
+    def _drain_adopted(self):
+        """Move handed-off chains into the active decode set
+        (scheduler thread, called before admission each iteration).
+        Respects max_batch — an over-capacity chain waits in the
+        adoption queue, its pages and claim safely parked in the
+        chain handle."""
+        while True:
+            with self._cv:
+                if not self._adopted:
+                    return
+                if len(self._active) + len(self._prefilling) \
+                        >= self.max_batch:
+                    return
+                handle, chain, last, generated, cached = \
+                    self._adopted.popleft()
+            if handle.future.cancelled():
+                with self.cache.lock:
+                    self.cache.release_chain(chain)
+                if handle.trace is not None:
+                    handle.trace.finish("cancelled")
+                handle._close()
+                continue
+            sid = self._new_sid()
+            with self.cache.lock:
+                self.cache.adopt_chain(sid, chain)
+            seq = _ActiveSeq(sid, handle, chain.claim, cached=cached)
+            seq.generated = list(generated)
+            seq.last = last
+            seq.filled = int(handle.prompt.size)
+            self._active.append(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (adoption), same contract as the admission append
+
+    def _handoff_seq(self, seq, tok):
+        """PREFILL role epilogue (scheduler thread): the prompt's last
+        chunk just produced the first sampled token. Stream it, then
+        hand the chain to the decode engine instead of joining the
+        local decode batch — unless the request is already terminal
+        (cancelled, eos on the first token, max_new_tokens == 1),
+        which finishes here exactly like the single-engine path."""
+        h = seq.handle
+        if h.future.cancelled():
+            with self.cache.lock:
+                self.cache.free_sequence(seq.sid)
+            if h.trace is not None:
+                h.trace.finish("cancelled")
+            h._close()
+            with self._cv:
+                self._cv.notify_all()
+            return
+        if h.trace is not None:
+            h.trace.first_token()
+            h.trace.note_token(self.cache.pages_held(seq.sid))
+        _monitor.counter("serve.generated_tokens").inc()
+        seq.generated.append(tok)
+        seq.last = tok
+        h._push(tok)
+        if (h.eos_token_id is not None and tok == h.eos_token_id) \
+                or len(seq.generated) >= h.max_new_tokens:
+            with self.cache.lock:
+                if self.prefix_cache and seq.filled >= h.prompt.size:
+                    self.cache.register_prefix(seq.sid, h.prompt)
+                self.cache.free_sequence(seq.sid)
+            _monitor.histogram("serve.latency_s").observe(
+                time.perf_counter() - h.t_submit)
+            if h.trace is not None:
+                h.trace.finish("completed")
+            final = np.asarray(seq.generated, np.int64)  # hot-sync-ok: host int list, not a device read
+            _resolve_future(h.future, final)
+            h._close()
+        else:
+            with self.cache.lock:
+                chain = self.cache.export_chain(seq.sid)
+            try:
+                # NOT holding any lock: the dispatcher enqueues on the
+                # decode engine (its _cv) and emits the route record
+                self._handoff_fn(seq, chain)
+            except Exception as e:
+                with self.cache.lock:
+                    self.cache.release_chain(chain)
+                _reject_future(h.future, e)
+                _finish_trace(h.trace, e)
+                h._close()
+        with self._cv:
+            self._cv.notify_all()  # slot freed / pages handed off
 
     def _decode_step(self):
         """ONE jitted step for every active sequence: the decode batch
@@ -1370,61 +1621,66 @@ class GenerationEngine(_SchedulerLifecycle):
                     if in_flight >= self.max_batch:
                         return
                     handle = self._pending[0]
-                    matched_full = pinned = 0
-                    if self.prefix_cache:
-                        # at most prompt-1 cached tokens: the final
-                        # prompt token must run through the model to
-                        # produce the first sampled token's logits
-                        _, matched_full, pinned = \
-                            self.cache.match_prefix_credit(
-                                handle.prompt,
+                    # ONE cache-locked section from the prefix match to
+                    # the claim: with a second engine sharing this pool
+                    # (disaggregation) nothing may slip between the
+                    # capacity check and the reservation it justifies
+                    with self.cache.lock:
+                        matched_full = pinned = 0
+                        if self.prefix_cache:
+                            # at most prompt-1 cached tokens: the final
+                            # prompt token must run through the model
+                            # to produce the first sampled token's
+                            # logits
+                            _, matched_full, pinned = \
+                                self.cache.match_prefix_credit(
+                                    handle.prompt,
+                                    max_tokens=handle.prompt.size - 1)
+                        need = self.cache.pages_needed(
+                            handle.prompt.size + handle.max_new_tokens) \
+                            - matched_full
+                        # claims compare against pages DRAWN, not held:
+                        # an acquired shared prefix inflates pages_held
+                        # without consuming the pool, and its
+                        # copy-on-write + tail pages are still owed.
+                        # outstanding_claims is POOL-wide — every
+                        # engine's reservations count, plus chains in
+                        # handoff limbo
+                        outstanding = self.cache.outstanding_claims()
+                        # supply subtracts `pinned`: matched
+                        # registry-only pages count as evictable TODAY
+                        # but acquire_prefix pins them — crediting need
+                        # AND counting them as supply would admit
+                        # against phantom capacity
+                        if need + outstanding > self.cache.n_free_pages() \
+                                + self.cache.n_evictable_pages() - pinned:
+                            return  # wait for evictions to free pages
+                        sid = self._new_sid()
+                        self.cache.add_sequence(sid)
+                        cached = 0
+                        if self.prefix_cache:
+                            cached = self.cache.acquire_prefix(
+                                sid, handle.prompt,
                                 max_tokens=handle.prompt.size - 1)
-                    need = self.cache.pages_needed(
-                        handle.prompt.size + handle.max_new_tokens) \
-                        - matched_full
-                    # claims compare against pages DRAWN, not held: an
-                    # acquired shared prefix inflates pages_held
-                    # without consuming the pool, and its copy-on-write
-                    # + tail pages are still owed from this reservation
-                    outstanding = sum(
-                        max(s.reserve - self.cache.pages_drawn(s.sid), 0)
-                        for s in self._active + self._prefilling)
-                    # supply subtracts `pinned`: matched registry-only
-                    # pages count as evictable TODAY but acquire_prefix
-                    # pins them — crediting need AND counting them as
-                    # supply would admit against phantom capacity
-                    if need + outstanding > self.cache.n_free_pages() \
-                            + self.cache.n_evictable_pages() - pinned:
-                        return  # wait for evictions to free pages
+                        self.cache.set_claim(sid, need)
                     self._pending.popleft()
-                    self._admitting += 1  # drain() sees the handoff
                     _monitor.gauge("serve.queue_depth").set(
                         len(self._pending))
                     if handle.trace is not None:
                         handle.trace.admitted()
+                    if cached:
+                        _monitor.counter("serve.prefix_hits").inc(cached)
+                        self._step_prefix_hits += cached
+                        if handle.trace is not None:
+                            handle.trace.note_prefix(cached)
+                    # appended UNDER self._cv: pop->prefilling is one
+                    # atomic transition, so drain() never observes
+                    # "queue empty, nothing in flight" mid-admission
+                    self._prefilling.append(
+                        _ActiveSeq(sid, handle, need, cached=cached))
+                    continue
             if doomed is not None:
                 self._close_doomed(doomed)
-                continue
-            try:
-                sid = f"g{self._next_sid}"
-                self._next_sid += 1
-                self.cache.add_sequence(sid)
-                cached = 0
-                if self.prefix_cache:
-                    cached = self.cache.acquire_prefix(
-                        sid, handle.prompt,
-                        max_tokens=handle.prompt.size - 1)
-                if cached:
-                    _monitor.counter("serve.prefix_hits").inc(cached)
-                    self._step_prefix_hits += cached
-                    if handle.trace is not None:
-                        handle.trace.note_prefix(cached)
-                self._prefilling.append(  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers snapshot via GIL-atomic list() (load_report) or len()
-                    _ActiveSeq(sid, handle, need, cached=cached))
-            finally:
-                with self._cv:
-                    self._admitting -= 1
-                    self._cv.notify_all()
 
     def _ragged_step(self):
         """ONE jitted mixed step over the Pallas ragged kernel: every
@@ -1436,7 +1692,8 @@ class GenerationEngine(_SchedulerLifecycle):
         one int32 per row through a copy launched at dispatch."""
         for s in list(self._prefilling):  # cancelled mid-prefill: evict
             if s.handle.future.cancelled():
-                self.cache.free_sequence(s.sid)
+                with self.cache.lock:
+                    self.cache.free_sequence(s.sid)
                 self._prefilling.remove(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers take GIL-atomic list() snapshots, remove() is C-level atomic
                 if s.handle.trace is not None:
                     s.handle.trace.finish("cancelled")
@@ -1483,8 +1740,25 @@ class GenerationEngine(_SchedulerLifecycle):
         useful = int(bounds.sum())
         self._attn_computed += computed  # lint-ok[unlocked-shared-state]: loop-thread-owned monotonic counter (ragged site), same contract as the bucketed decode site
         self._attn_useful += useful  # lint-ok[unlocked-shared-state]: paired with _attn_computed above — same single-writer telemetry counter
+        # per-row sampling config, [pad_b]-shaped like the row axis so
+        # the compiled signature still keys on (T, B, W) only: pad and
+        # greedy rows carry temperature 0 (the bit-exact argmax lane),
+        # sampled rows their request's temperature/top-k/top-p and the
+        # per-SEQUENCE base key (the step folds in the token position)
+        temps = np.zeros((pad_b,), np.float32)
+        top_ks = np.zeros((pad_b,), np.int32)
+        top_ps = np.ones((pad_b,), np.float32)
+        keys = np.zeros((pad_b, 2), np.uint32)
+        for i, (_, s, _) in enumerate(metas):
+            sp = s.sampling
+            if sp is not None and not sp.greedy:
+                temps[i] = sp.temperature
+                top_ks[i] = sp.top_k or 0
+                top_ps[i] = 1.0 if sp.top_p is None else sp.top_p
+                keys[i] = s.key
         _, nxt = self.model.paged_ragged_step(
-            self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b)
+            self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b,
+            sampling=(temps, top_ks, top_ps, keys))
         nxt.copy_to_host_async()  # overlap with the bookkeeping below
         self._sync_retraces()
         now = time.perf_counter()
@@ -1525,14 +1799,19 @@ class GenerationEngine(_SchedulerLifecycle):
             s.filled += n
             if s.filled < s.handle.prompt.size:
                 continue  # mid-prompt chunk: sampled token is not real
-            # prompt complete: stream the first token, join the decode
-            # batch (prefix registration waits for EVICTION — a
-            # still-generating sequence registering its partial tail
-            # page would copy-on-write its own next decode token, an
-            # extra page draw its admission reservation never counted)
+            # prompt complete: stream the first token, then either join
+            # the local decode batch or — prefill role — hand the chain
+            # to the decode engine (prefix registration waits for
+            # EVICTION either way: a still-generating sequence
+            # registering its partial tail page would copy-on-write its
+            # own next decode token, an extra page draw its admission
+            # reservation never counted)
             self._prefilling.remove(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; promote-to-active handoff stays on the one loop thread
             _monitor.histogram("serve.ttft_s").observe(
                 now - s.handle.t_submit)
+            if self._handoff_fn is not None:
+                self._handoff_seq(s, tok)
+                continue
             self._active.append(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers take GIL-atomic list() snapshots (load_report)
             self._emit(s, tok)
         self._note_kv_step()
@@ -1584,13 +1863,10 @@ class GenerationEngine(_SchedulerLifecycle):
             stopping = self._stopping
         finally:
             self._cv.release()
-        outstanding = 0
-        for s in seqs:
-            try:
-                outstanding += max(
-                    s.reserve - self.cache.pages_drawn(s.sid), 0)
-            except KeyError:
-                pass  # evicted between the snapshot and this read
+        # POOL-wide reservations (claims ledger): what admission — on
+        # THIS engine or any other sharing the pool — has promised but
+        # not yet drawn; snapshot-copied internally, safe lock-free
+        outstanding = self.cache.outstanding_claims()
         free = self.cache.n_free_pages()
         evictable = self.cache.n_evictable_pages()
         admittable = max(free + evictable - outstanding, 0)
@@ -1598,7 +1874,7 @@ class GenerationEngine(_SchedulerLifecycle):
         tpot = _monitor.get_metric("serve.tpot_s")
         return {
             "engine": self.name, "stopping": stopping,
-            "queue_depth": pending,
+            "queue_depth": pending, "max_queue": int(self.max_queue),
             "active": len(seqs), "max_batch": self.max_batch,
             "slots_free": max(self.max_batch - len(seqs), 0),
             "free_pages": free, "evictable_pages": evictable,
@@ -1669,7 +1945,8 @@ class GenerationEngine(_SchedulerLifecycle):
         instead of decoding a sequence nobody is waiting for."""
         h = seq.handle
         if h.future.cancelled():
-            self.cache.free_sequence(seq.sid)
+            with self.cache.lock:
+                self.cache.free_sequence(seq.sid)
             self._active.remove(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (cancel eviction); remove() is C-level atomic under the GIL
             if h.trace is not None:  # tokens already generated = waste
                 h.trace.finish("cancelled")
@@ -1692,9 +1969,10 @@ class GenerationEngine(_SchedulerLifecycle):
             # (itself included) will ever copy-on-write a registered
             # tail mid-reservation, and the registry hold keeps the
             # pages alive past free_sequence
-            if self.prefix_cache and seq.filled >= h.prompt.size:
-                self.cache.register_prefix(seq.sid, h.prompt)
-            self.cache.free_sequence(seq.sid)
+            with self.cache.lock:
+                if self.prefix_cache and seq.filled >= h.prompt.size:
+                    self.cache.register_prefix(seq.sid, h.prompt)
+                self.cache.free_sequence(seq.sid)
             self._active.remove(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (completion retirement); remove() is C-level atomic under the GIL
             _monitor.histogram("serve.latency_s").observe(
                 time.perf_counter() - h.t_submit)
@@ -1721,19 +1999,33 @@ class GenerationEngine(_SchedulerLifecycle):
 
     def _fail_all(self, exc):
         """A decode-step failure poisons shared state (donated pools):
-        fail every in-flight request loudly rather than hang them."""
+        fail every in-flight request loudly rather than hang them —
+        queued adoptions included (their chains release back to the
+        pool; the other engine of the pair may still be healthy)."""
         with self._cv:
             seqs = list(self._active) + list(self._prefilling)
             self._active, self._prefilling = [], []
             pend, self._pending = list(self._pending), deque()
+            adopted, self._adopted = list(self._adopted), deque()
         for seq in seqs:
             try:
-                self.cache.free_sequence(seq.sid)
+                with self.cache.lock:
+                    self.cache.free_sequence(seq.sid)
             except Exception:
                 pass
             _reject_future(seq.handle.future, exc)
             _finish_trace(seq.handle.trace, exc)
             seq.handle._close()
+        for item in adopted:
+            handle, chain = item[0], item[1]
+            try:
+                with self.cache.lock:
+                    self.cache.release_chain(chain)
+            except Exception:
+                pass
+            _reject_future(handle.future, exc)
+            _finish_trace(handle.trace, exc)
+            handle._close()
         for h in pend:
             _reject_future(h.future, exc)
             _finish_trace(h.trace, exc)
@@ -1742,7 +2034,7 @@ class GenerationEngine(_SchedulerLifecycle):
     # -- lifecycle (drain/shutdown via _SchedulerLifecycle) --------------
     def _outstanding(self):
         return bool(self._pending or self._active or self._prefilling
-                    or self._admitting)
+                    or self._admitting or self._adopted)
 
     def _take_pending(self):
         self._abort = True  # the loop thread fails _active itself
@@ -1753,11 +2045,20 @@ class GenerationEngine(_SchedulerLifecycle):
     def _take_outstanding(self):
         # the loop thread is gone (or dying) with the engine, so the
         # _abort flag set by _take_pending has no reader — detach the
-        # active set too or their handles hang forever
+        # active set too or their handles hang forever. Queued
+        # adoptions release their chains back to the (shared) pool.
         out = self._take_pending()
         out += [(s.handle, s.sid)
                 for s in self._active + self._prefilling]
         self._active, self._prefilling = [], []
+        while self._adopted:
+            item = self._adopted.popleft()
+            try:
+                with self.cache.lock:
+                    self.cache.release_chain(item[1])
+            except Exception:
+                pass
+            out.append((item[0], None))
         return out
 
     def _reject_detached(self, items, exc):

@@ -127,6 +127,71 @@ class RaggedJitSlot:
         self.bounds = bounds
 
 
+def sample_token_rows(last, temps, top_ks, top_ps, rng_keys, positions):
+    """On-device per-row sampling for the ragged serving step: one
+    fixed-shape program covers every request's sampling config, so
+    admit/evict (and mixed greedy/sampled batches) never change the
+    compiled signature.
+
+    last [B, V] next-token logits; temps [B] f32 (<= 0 selects the
+    greedy argmax lane BIT-EXACTLY — the pre-sampling serving
+    behavior); top_ks [B] i32 (0 disables); top_ps [B] f32 (1.0
+    disables); rng_keys [B, 2] u32 per-SEQUENCE base PRNG keys;
+    positions [B] i32 absolute position of each row's sampled token.
+
+    The draw key is fold_in(base_key, position): a function of the
+    request's seed and the token index ONLY — which batch the row
+    landed in, what its neighbors were, or which ENGINE decoded it
+    (prefill/decode disaggregation) cannot change the sample, so a
+    handed-off chain decodes token-for-token equal to a single-engine
+    run and a fixed seed reproduces exactly. Returns [B] int32."""
+    import jax
+    V = last.shape[-1]
+    greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        arr = last.astype(jnp.float32) \
+            / jnp.maximum(temps[:, None], 1e-6)
+        # per-row top-k: the kth-largest value is the row's floor
+        # (k <= 0 keeps everything). One descending sort serves both
+        # filters.
+        srt = jnp.sort(arr, axis=-1)[:, ::-1]
+        k_eff = jnp.clip(jnp.where(top_ks > 0, top_ks, V), 1, V)
+        kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=-1)
+        arr = jnp.where(arr < kth, jnp.float32(-1e30), arr)
+        # per-row nucleus over the top-k-masked logits: keep the
+        # smallest prefix of the sorted probs reaching top_p (a token
+        # stays iff the mass BEFORE it is < top_p) — top_p = 1.0 keeps
+        # every survivor
+        srt2 = jnp.sort(arr, axis=-1)[:, ::-1]
+        p_srt = jax.nn.softmax(srt2, axis=-1)
+        before = jnp.cumsum(p_srt, axis=-1) - p_srt
+        keep = before < top_ps[:, None]
+        thresh = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1,
+                         keepdims=True)
+        arr = jnp.where(arr >= thresh, arr, jnp.float32(-1e30))
+        step_keys = jax.vmap(jax.random.fold_in)(rng_keys, positions)
+        sampled = jax.vmap(jax.random.categorical)(step_keys, arr)
+        return jnp.where(temps <= 0.0, greedy,
+                         sampled.astype(jnp.int32))
+
+    # runtime branch, ONE executable: an all-greedy batch (the default
+    # serving workload) skips the two [B, V] sorts + softmax/cumsum at
+    # execution time instead of paying for a lane jnp.where would
+    # force XLA to materialize; a mixed batch takes the sampled branch
+    # and its greedy rows still ride the bit-exact argmax lane
+    return jax.lax.cond(jnp.any(temps > 0.0), _sampled,
+                        lambda _: greedy, None)
+
+
+def sampling_key_data(seed):
+    """Host-side uint32[2] PRNG key data for `seed` (the threefry key
+    layout jax.random.PRNGKey produces) — no device op at submit."""
+    seed = int(seed)
+    return np.array([(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF],
+                    np.uint32)
+
+
 def _remat_policy(scan_remat):
     """Map cfg.scan_remat to a jax.checkpoint policy. True → full
     recompute (policy None). "dots" → save non-batch matmul outputs.
@@ -550,10 +615,13 @@ class GPTForCausalLM(nn.Layer):
         if T == 1:
             return self._paged_decode_jit(cache, seq_ids, input_ids,
                                           pad_to=pad_to)
-        caches = [PagedCacheSlot(cache, l, list(seq_ids), None)
-                  for l in range(self.cfg.num_layers)]
-        logits, _ = self(input_ids, caches=caches)
-        return logits[:, -1, :]
+        # the cache lock serializes allocator + pool mutations when a
+        # second engine shares this pool (no-op cost when uncontended)
+        with cache.lock:
+            caches = [PagedCacheSlot(cache, l, list(seq_ids), None)
+                      for l in range(self.cfg.num_layers)]
+            logits, _ = self(input_ids, caches=caches)
+            return logits[:, -1, :]
 
     def clear_decode_cache(self):
         """Refresh the decode param snapshot. Call after loading or
@@ -568,10 +636,6 @@ class GPTForCausalLM(nn.Layer):
 
         L = self.cfg.num_layers
         B = len(seq_ids)
-        # poisoned-cache guard lives in paged_decode_step (the only
-        # caller), hoisted to cover the prefill path too
-        pages, in_pages, pt, lens = cache.plan_decode(seq_ids,
-                                                     pad_to=pad_to)
         # params are frozen during serving: snapshot once (see
         # clear_decode_cache for mid-serving weight swaps)
         params = getattr(self, "_paged_params", None)
@@ -599,35 +663,45 @@ class GPTForCausalLM(nn.Layer):
             # pools donated: page writes update HBM in place; jax.jit's
             # own cache keys on (B, table width) shapes
             fn = self._paged_jit_fn = jax.jit(step, donate_argnums=(1, 2))
-        toks = input_ids.value.astype(jnp.int32)
-        if pad_to is not None and pad_to > B:
-            # pad rows decode token 0 at position 0 into the reserved
-            # pad page — garbage by construction, sliced off below
-            toks = jnp.concatenate(
-                [toks, jnp.zeros((int(pad_to) - B, 1), jnp.int32)])
-        try:
-            logits, new_k, new_v = fn(
-                params, list(cache.k), list(cache.v), toks, pages,
-                in_pages, pt, lens)
-        except Exception as e:
-            # donation only consumes the pools once the compiled program
-            # EXECUTES; a trace/compile failure leaves them valid
-            if not any(getattr(a, "is_deleted", lambda: False)()
-                       for a in (*cache.k, *cache.v)):
-                raise
-            # the pools were donated to the failed program — they are
-            # gone; make the poisoned state loud instead of letting the
-            # next step die with a bare "Array has been deleted"
-            cache.k = cache.v = None
-            raise RuntimeError(
-                "jitted paged decode step failed AFTER its page pools "
-                "were donated — this PagedKVCache is unrecoverable; "
-                "rebuild it with make_paged_cache() and re-prefill "
-                "in-flight sequences") from e
-        cache.k = list(new_k)
-        cache.v = list(new_v)
-        for sid in seq_ids:
-            cache.advance(sid, 1)
+        # the cache lock holds from the plan through the donated-pool
+        # swap: a second engine sharing this pool (prefill/decode
+        # disaggregation) must neither plan against pools this step is
+        # about to donate nor interleave allocator mutations mid-plan
+        with cache.lock:
+            pages, in_pages, pt, lens = cache.plan_decode(seq_ids,
+                                                          pad_to=pad_to)
+            toks = input_ids.value.astype(jnp.int32)
+            if pad_to is not None and pad_to > B:
+                # pad rows decode token 0 at position 0 into the
+                # reserved pad page — garbage by construction, sliced
+                # off below
+                toks = jnp.concatenate(
+                    [toks, jnp.zeros((int(pad_to) - B, 1), jnp.int32)])
+            try:
+                logits, new_k, new_v = fn(
+                    params, list(cache.k), list(cache.v), toks, pages,
+                    in_pages, pt, lens)
+            except Exception as e:
+                # donation only consumes the pools once the compiled
+                # program EXECUTES; a trace/compile failure leaves them
+                # valid
+                if not any(getattr(a, "is_deleted", lambda: False)()
+                           for a in (*cache.k, *cache.v)):
+                    raise
+                # the pools were donated to the failed program — they
+                # are gone; make the poisoned state loud instead of
+                # letting the next step die with a bare "Array has been
+                # deleted"
+                cache.k = cache.v = None
+                raise RuntimeError(
+                    "jitted paged decode step failed AFTER its page "
+                    "pools were donated — this PagedKVCache is "
+                    "unrecoverable; rebuild it with make_paged_cache() "
+                    "and re-prefill in-flight sequences") from e
+            cache.k = list(new_k)
+            cache.v = list(new_v)
+            for sid in seq_ids:
+                cache.advance(sid, 1)
         return Tensor(logits[:B])
 
     # ---- ragged mixed prefill+decode step ---------------------------
@@ -646,7 +720,8 @@ class GPTForCausalLM(nn.Layer):
         L = self.cfg.num_layers
 
         def step(ps, kps, vps, toks, pos, tok_seq, tok_pages,
-                 tok_in_pages, bounds, pt, out_idx):
+                 tok_in_pages, bounds, pt, out_idx, temps, top_ks,
+                 top_ps, rng_keys):
             # trace-time side effect: exact count of ragged executables
             # traced (one per novel (T, B, W) signature) — the serving
             # engine folds the delta into serve.retraces
@@ -661,10 +736,13 @@ class GPTForCausalLM(nn.Layer):
                         "position_ids": Tensor(pos[None, :])},
                 training=False)
             last = logits[0][out_idx]          # [B, vocab]
-            # sampling ON DEVICE: the host reads back B int32s, never
-            # the [B, vocab] logits (serving satellite: no vocab-sized
-            # D2H in the decode loop)
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            # sampling ON DEVICE: seeded temperature/top-k/top-p per
+            # row (temperature 0 rows take the argmax lane bit-exactly)
+            # — the host reads back B int32s, never the [B, vocab]
+            # logits (serving satellite: no vocab-sized D2H in the
+            # decode loop)
+            nxt = sample_token_rows(last, temps, top_ks, top_ps,
+                                    rng_keys, pos[out_idx])
             return (last, nxt, [s.k for s in out_slots],
                     [s.v for s in out_slots])
 
@@ -685,16 +763,21 @@ class GPTForCausalLM(nn.Layer):
         pools = [sds(pshape, cache.k[0].dtype)
                  for _ in range(self.cfg.num_layers)]
         i32 = jnp.int32
+        B = int(n_rows)
         tok = lambda: sds((int(n_tokens),), i32)
         return (jax.tree.map(lambda a: sds(a.shape, a.dtype), params),
                 pools, list(pools), tok(), tok(), tok(), tok(), tok(),
-                tok(), sds((int(n_rows), int(width)), i32),
-                sds((int(n_rows),), i32))
+                tok(), sds((B, int(width)), i32), sds((B,), i32),
+                # per-row sampling config: [B]-shaped like out_idx, so
+                # the signature still keys on (T, B, W) only
+                sds((B,), jnp.float32), sds((B,), i32),
+                sds((B,), jnp.float32), sds((B, 2), jnp.uint32))
 
     _RAGGED_ARG_NAMES = ("params", "k_pages", "v_pages", "tokens",
                          "positions", "token_seq", "tok_pages",
                          "tok_in_pages", "bounds", "page_table",
-                         "out_idx")
+                         "out_idx", "temperatures", "top_ks", "top_ps",
+                         "rng_keys")
 
     @staticmethod
     def _ragged_sig(cache, n_tokens, n_rows, width):
@@ -727,7 +810,7 @@ class GPTForCausalLM(nn.Layer):
                                    thunk, inline=inline)
 
     def paged_ragged_step(self, cache, rows, pad_to_tokens=None,
-                          pad_to_rows=None):
+                          pad_to_rows=None, sampling=None):
         """ONE continuous-batching step over mixed rows: `rows` is a
         list of (seq_id, token_ids) where decode rows carry one token
         and prefill-chunk rows carry a slice of their prompt — all
@@ -737,9 +820,15 @@ class GPTForCausalLM(nn.Layer):
 
         Returns (logits Tensor [n_rows, vocab] — each row's LAST
         token's next-token logits — and next_tokens, a device int32
-        array of their argmax: greedy sampling without a vocab-sized
-        host read). pad_to_tokens/pad_to_rows pin the compiled shape
-        for a serving scheduler."""
+        array sampled ON DEVICE per row: no vocab-sized host read).
+        pad_to_tokens/pad_to_rows pin the compiled shape for a serving
+        scheduler.
+
+        `sampling` is an optional (temperatures, top_ks, top_ps,
+        rng_keys) tuple of PADDED-row-shaped host arrays (f32 [B],
+        i32 [B], f32 [B], u32 [B, 2] — see `sample_token_rows`); None
+        means every row decodes greedily (temperature 0), bit-exact
+        with the pre-sampling argmax path."""
         if cache.k is None:
             raise RuntimeError(
                 "this PagedKVCache was poisoned by an earlier failed "
@@ -753,55 +842,71 @@ class GPTForCausalLM(nn.Layer):
                 f"sequences {over!r} would exceed "
                 f"max_position_embeddings={limit}; free them or raise "
                 "the limit")
-        plan = cache.plan_ragged([(s, len(t)) for s, t in rows],
-                                 pad_to_tokens=pad_to_tokens,
-                                 pad_to_rows=pad_to_rows)
-        T = plan["tok_pages"].shape[0]
-        B, W = plan["page_table"].shape
-        toks = np.zeros((T,), np.int32)
-        off = 0
-        for _, t in rows:
-            toks[off:off + len(t)] = np.asarray(t, np.int32).reshape(-1)
-            off += len(t)
         from ..jit.api import state_arrays
         params = getattr(self, "_paged_params", None)
         if params is None:
             params = self._paged_params = state_arrays(self)[0]
-        entry = getattr(self, "_ragged_exec", {}).get(
-            self._ragged_sig(cache, T, B, W))
-        if entry is None:
-            # miss: compile inline (single-flight — a concurrent warm
-            # of the same signature is joined, not duplicated)
-            entry = self.warm_ragged(cache, T, B, W,
-                                     inline=True).result()
-        compiled, _ = entry
-        args = (params, list(cache.k), list(cache.v),
-                jnp.asarray(toks), jnp.asarray(plan["positions"]),
-                jnp.asarray(plan["token_seq"]),
-                jnp.asarray(plan["tok_pages"]),
-                jnp.asarray(plan["tok_in_pages"]),
-                jnp.asarray(plan["bounds"]),
-                jnp.asarray(plan["page_table"]),
-                jnp.asarray(plan["out_idx"]))
-        try:
-            last, nxt, new_k, new_v = compiled(*args)
-        except Exception as e:
-            # donation only consumes the pools once the program
-            # EXECUTES; a dispatch failure before that leaves them valid
-            if not any(getattr(a, "is_deleted", lambda: False)()
-                       for a in (*cache.k, *cache.v)):
-                raise
-            cache.k = cache.v = None
-            raise RuntimeError(
-                "jitted ragged step failed AFTER its page pools were "
-                "donated — this PagedKVCache is unrecoverable; rebuild "
-                "it with make_paged_cache() and re-prefill in-flight "
-                "sequences") from e
-        cache.k = list(new_k)
-        cache.v = list(new_v)
-        for s, t in rows:
-            cache.advance(s, len(t))
-        n = plan["n_rows"]
+        # the cache lock holds from the plan through the donated-pool
+        # swap (see _paged_decode_jit): with two engines sharing one
+        # pool, the other engine's step must see either the pre- or
+        # the post-step pool buffers, never the donated carcass
+        with cache.lock:
+            plan = cache.plan_ragged([(s, len(t)) for s, t in rows],
+                                     pad_to_tokens=pad_to_tokens,
+                                     pad_to_rows=pad_to_rows)
+            T = plan["tok_pages"].shape[0]
+            B, W = plan["page_table"].shape
+            toks = np.zeros((T,), np.int32)
+            off = 0
+            for _, t in rows:
+                toks[off:off + len(t)] = \
+                    np.asarray(t, np.int32).reshape(-1)
+                off += len(t)
+            entry = getattr(self, "_ragged_exec", {}).get(
+                self._ragged_sig(cache, T, B, W))
+            if entry is None:
+                # miss: compile inline (single-flight — a concurrent
+                # warm of the same signature is joined, not duplicated)
+                entry = self.warm_ragged(cache, T, B, W,
+                                         inline=True).result()
+            compiled, _ = entry
+            if sampling is None:
+                # greedy defaults: temp-0 rows take the argmax lane
+                sampling = (np.zeros((B,), np.float32),
+                            np.zeros((B,), np.int32),
+                            np.ones((B,), np.float32),
+                            np.zeros((B, 2), np.uint32))
+            temps, top_ks, top_ps, rng_keys = sampling
+            args = (params, list(cache.k), list(cache.v),
+                    jnp.asarray(toks), jnp.asarray(plan["positions"]),
+                    jnp.asarray(plan["token_seq"]),
+                    jnp.asarray(plan["tok_pages"]),
+                    jnp.asarray(plan["tok_in_pages"]),
+                    jnp.asarray(plan["bounds"]),
+                    jnp.asarray(plan["page_table"]),
+                    jnp.asarray(plan["out_idx"]),
+                    jnp.asarray(temps), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(rng_keys))
+            try:
+                last, nxt, new_k, new_v = compiled(*args)
+            except Exception as e:
+                # donation only consumes the pools once the program
+                # EXECUTES; a dispatch failure before that leaves them
+                # valid
+                if not any(getattr(a, "is_deleted", lambda: False)()
+                           for a in (*cache.k, *cache.v)):
+                    raise
+                cache.k = cache.v = None
+                raise RuntimeError(
+                    "jitted ragged step failed AFTER its page pools "
+                    "were donated — this PagedKVCache is "
+                    "unrecoverable; rebuild it with make_paged_cache() "
+                    "and re-prefill in-flight sequences") from e
+            cache.k = list(new_k)
+            cache.v = list(new_v)
+            for s, t in rows:
+                cache.advance(s, len(t))
+            n = plan["n_rows"]
         return Tensor(last[:n]), nxt[:n]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,

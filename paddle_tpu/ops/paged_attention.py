@@ -35,16 +35,38 @@ written while its refcount is above one.
 (ops/pallas/paged_attention.py): ONE jitted step advances mixed
 decode rows and prefill chunks with per-token write coordinates and
 causal bounds — no row pays for another row's padding.
+
+Two engines can SHARE one pool (prefill/decode disaggregation — the
+serving front door, docs/SERVING.md "The front door"):
+
+- `cache.lock` (an RLock) serializes the host-side allocator and the
+  donated-pool swap; every engine-facing mutation path acquires it, so
+  a prefill engine and a decode engine driving the same pool from two
+  scheduler threads interleave safely (the device work itself is
+  ordered by XLA's data dependency on the donated pool buffers).
+- the CLAIMS ledger (`set_claim`/`outstanding_claims`) makes worst-case
+  admission reservations POOL-wide: each live sequence's claim is
+  (reserved pages - pages drawn so far), summed across every engine on
+  the pool — two engines admitting against one free list can no longer
+  double-book it.
+- `export_chain`/`adopt_chain` move a fully-prefilled sequence's pages
+  between sequences (and engines) WITHOUT copying: the chain handle
+  keeps every page's hold and the sequence's claim alive in limbo, the
+  adopting side reattaches them under a new seq id — page ids,
+  refcounts, and the cumulative draw counter are all invariant across
+  the handoff (asserted by tests/test_frontdoor.py).
 """
 import functools
+import itertools
 import math
+import threading
 from collections import OrderedDict
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["PagedKVCache", "paged_attention"]
+__all__ = ["PagedKVCache", "KVChainHandle", "paged_attention"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -93,6 +115,31 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, scale=None):
 
 _ROOT = 0  # prefix-chain id of the empty prefix
 
+_CHAIN_IDS = itertools.count()
+
+
+class KVChainHandle:
+    """A detached, fully-written KV chain in flight between two
+    sequences (the prefill→decode handoff unit — docs/SERVING.md "The
+    front door"). Holds the exported sequence's page list, token
+    length, cumulative draw count, and admission claim; while the
+    handle is live the pool keeps every page's hold AND counts the
+    claim in `outstanding_claims()`, so the handoff window can never
+    be double-booked by a concurrent admission. Consume exactly once
+    via `adopt_chain` (same pool only — the move is page IDS, no
+    copies) or `release_chain`."""
+
+    __slots__ = ("chain_id", "pages", "length", "drawn", "claim",
+                 "consumed")
+
+    def __init__(self, pages, length, drawn, claim):
+        self.chain_id = next(_CHAIN_IDS)
+        self.pages = pages
+        self.length = length
+        self.drawn = drawn
+        self.claim = claim
+        self.consumed = False
+
 
 class PagedKVCache:
     """Host-side page allocator + device-side page pools (per layer).
@@ -115,11 +162,21 @@ class PagedKVCache:
         shape = (n_pages, page_size, n_heads, head_dim)
         self.k = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
         self.v = [jnp.zeros(shape, dtype) for _ in range(n_layers)]
+        # serializes the host allocator + the donated-pool swap when
+        # more than one engine drives this pool (prefill/decode
+        # disaggregation); re-entrant so an engine holding it can call
+        # any cache method. Uncontended cost for the single-engine
+        # case is one C-level RLock acquire per step.
+        self.lock = threading.RLock()
         # page 0 is reserved as the pad page so 0-padded tables are safe
         self._free = list(range(1, n_pages))
         self._tables = {}   # seq_id -> list of page ids
         self._len = {}      # seq_id -> tokens stored
         self._ref = {}      # page id -> holders (sequences + registry)
+        self._claims = {}   # seq_id -> worst-case pages reserved at
+        # admission (see set_claim); outstanding_claims() is the
+        # POOL-wide reservation view a multi-engine scheduler needs
+        self._chains = {}   # chain_id -> in-flight KVChainHandle
         self._drawn = {}    # seq_id -> pages DRAWN from the pool (a
         # shared prefix page is held but was never drawn — reservation
         # accounting must compare against draws, see pages_drawn)
@@ -150,6 +207,7 @@ class PagedKVCache:
             self._deref(page)
         self._len.pop(seq_id)
         self._drawn.pop(seq_id)
+        self._claims.pop(seq_id, None)
 
     def length(self, seq_id):
         return self._len[seq_id]
@@ -159,8 +217,10 @@ class PagedKVCache:
 
     def n_evictable_pages(self):
         """Registered pages held ONLY by the registry — reclaimable on
-        demand (prefix cache retention is best-effort memory)."""
-        return sum(1 for info in self._chain_info.values()
+        demand (prefix cache retention is best-effort memory). The
+        registry is snapshot-copied (C-level list()) so lock-free
+        telemetry readers (load_report) never race a mutation."""
+        return sum(1 for info in list(self._chain_info.values())
                    if self._ref.get(info["page"], 0) == 1)
 
     def pages_needed(self, n_tokens):
@@ -202,6 +262,88 @@ class PagedKVCache:
         out-of-pages is impossible (see GenerationEngine._admit)."""
         return self.pages_needed(n_tokens) + int(reserved) \
             <= len(self._free) + self.n_evictable_pages()
+
+    # ---- pool-wide admission claims ----------------------------------
+    def set_claim(self, seq_id, n_pages):
+        """Record a sequence's worst-case page reservation (admission
+        time, AFTER prefix credit). The claim lives in the POOL, not
+        the admitting engine: with several engines sharing one pool,
+        each one's capacity gate must see every other's outstanding
+        reservations (`outstanding_claims`). Cleared by free_sequence;
+        carried through export_chain/adopt_chain."""
+        if seq_id not in self._tables:
+            raise KeyError(f"set_claim: unknown sequence {seq_id!r}")
+        self._claims[seq_id] = int(n_pages)
+
+    def outstanding_claims(self):
+        """Σ max(claim - pages drawn, 0) over live claimed sequences
+        PLUS in-flight exported chains — the pages admission promised
+        but the pool has not handed out yet. Admission passing this as
+        `reserved` to can_allocate (or subtracting it from the
+        free+evictable supply) keeps mid-decode out-of-pages impossible
+        even with multiple engines admitting against one pool.
+        Snapshot-copies (C-level list()/dict()) make the read safe
+        from any thread; admission itself calls it under `lock`."""
+        drawn = dict(self._drawn)
+        out = sum(max(c - drawn.get(s, 0), 0)
+                  for s, c in list(self._claims.items()))
+        out += sum(max(h.claim - h.drawn, 0)
+                   for h in list(self._chains.values()))
+        return out
+
+    # ---- chain handoff (prefill/decode disaggregation) ----------------
+    def export_chain(self, seq_id):
+        """Detach a sequence's fully-written KV chain into a
+        KVChainHandle WITHOUT touching refcounts or copying a single
+        page: the handle inherits every page hold, the token length,
+        the cumulative draw count, and the admission claim, and the
+        sequence id disappears from the pool. The handoff unit of
+        prefill/decode disaggregation — `adopt_chain` on the SAME pool
+        reattaches it under a new sequence id, so the decode engine
+        continues on the exact pages the prefill engine wrote."""
+        handle = KVChainHandle(
+            pages=self._tables.pop(seq_id),
+            length=self._len.pop(seq_id),
+            drawn=self._drawn.pop(seq_id),
+            claim=self._claims.pop(seq_id, 0))
+        self._chains[handle.chain_id] = handle
+        return handle
+
+    def adopt_chain(self, seq_id, chain):
+        """Attach an exported chain to a FRESH sequence id on the SAME
+        pool: page ids move, nothing is copied, refcounts are exactly
+        what export_chain left (the handle's holds become the new
+        sequence's holds), and the admission claim resumes under the
+        new id. Returns the adopted token length."""
+        if chain.consumed:
+            raise ValueError("adopt_chain: chain handle already "
+                             "consumed (adopted or released)")
+        if self._chains.pop(chain.chain_id, None) is None:
+            raise ValueError(
+                "adopt_chain: chain was not exported from THIS pool — "
+                "cross-pool handoff would need a device copy; share "
+                "the PagedKVCache between the two engines instead")
+        if seq_id in self._tables:
+            raise ValueError(f"adopt_chain: sequence {seq_id!r} "
+                             "already present")
+        chain.consumed = True
+        self._tables[seq_id] = chain.pages
+        self._len[seq_id] = chain.length
+        self._drawn[seq_id] = chain.drawn
+        if chain.claim:
+            self._claims[seq_id] = chain.claim
+        return chain.length
+
+    def release_chain(self, chain):
+        """Drop an exported chain that will never be adopted (the
+        decode side rejected the handoff): every page loses the
+        handle's hold, the limbo claim disappears."""
+        if chain.consumed:
+            return
+        chain.consumed = True
+        self._chains.pop(chain.chain_id, None)
+        for page in chain.pages:
+            self._deref(page)
 
     def _deref(self, page):
         self._ref[page] -= 1

@@ -9,8 +9,11 @@ against the checked-in BASELINE_HLO.json. The ledger can come from any
 metrics JSONL (`--ledger file.jsonl`), but the apples-to-apples source
 is the CANONICAL WORKLOAD here: a fixed tiny GPT train step (per-step,
 scanned run_steps, scanned accumulate), a two-bucket serving engine,
-and the ragged paged-attention serving step (serve.ragged_step: the
-Pallas mixed prefill+decode program behind GenerationEngine),
+the ragged paged-attention serving step (serve.ragged_step: the
+Pallas mixed prefill+decode program behind GenerationEngine), and a
+2-engine DISAGGREGATED ServingRouter (prefill/decode roles over one
+shared page pool — the router tier adds zero executables and lands
+real kind:"route" records in the tier-1-linted ledger),
 compiled cold (persistent cache off) on the single-device CPU backend —
 same model, same shapes, same flags every run, so fusion counts and
 bytes-accessed are deterministic and compile seconds are comparable.
@@ -226,7 +229,8 @@ def emit_workload():
     stacked = paddle.to_tensor(
         np.stack([ids.numpy(), ids.numpy()]))
 
-    from paddle_tpu.inference import InferenceEngine, GenerationEngine
+    from paddle_tpu.inference import (InferenceEngine, GenerationEngine,
+                                      ServingRouter)
     paddle.seed(0)
     eng = InferenceEngine(nn.Linear(8, 8), batch_sizes=(1, 2),
                           name="canonical")
@@ -243,12 +247,21 @@ def emit_workload():
     gen = GenerationEngine(gen_model, n_pages=8, page_size=16,
                            max_batch=2, max_new_tokens=3,
                            name="canonical_gen")
+    # the serving FRONT DOOR: a 2-engine disaggregated router (prefill
+    # role -> decode role over ONE shared page pool) on the same model
+    # and pool geometry as canonical_gen, so every ragged signature it
+    # dispatches is already in the warm set — the router tier must add
+    # ZERO executables, and tier-1 lints real kind:"route" records
+    router = ServingRouter.disaggregated(
+        gen_model, n_pages=8, page_size=16, max_batch=2,
+        max_new_tokens=3, name="canonical_router")
     handles = [
         step.warm(ids, ids),                       # train.step
         step.warm_run_steps(2, ids, ids),          # train.run_steps
         step.warm_accumulate(2, stacked, stacked),  # train.accumulate
     ] + eng.warm_async(x_serve) \
-      + gen.warm_async(4, 3)                       # serve.ragged_step
+      + gen.warm_async(4, 3) \
+      + router.warm_async(4, 3)                    # serve.ragged_step
     summary = jwarm.join(handles)                  # kind:"warm" record
     warmed = cobs.ledger_signatures()
 
@@ -260,6 +273,9 @@ def emit_workload():
     eng.shutdown()
     gen.submit(np.array([1, 2, 3, 4]), max_new_tokens=3).result(120)
     gen.shutdown()
+    router.submit(np.array([1, 2, 3, 4]), max_new_tokens=3,
+                  deadline_ms=120_000).result(120)
+    router.shutdown()
     steady = cobs.ledger_signatures()
     if steady != warmed:
         raise AssertionError(
@@ -280,7 +296,8 @@ def emit_workload():
     mfile = os.environ["PADDLE_TPU_METRICS_FILE"]
     reqs = _load_kind(mfile, "request")
     kvs = _load_kind(mfile, "kvcache")
-    schema_errs = [e for r in reqs + kvs
+    routes = _load_kind(mfile, "route")
+    schema_errs = [e for r in reqs + kvs + routes
                    for e in _cms.validate_line(_json.dumps(r))]
     if schema_errs:
         raise AssertionError(
@@ -289,7 +306,9 @@ def emit_workload():
     by_engine = {}
     for r in reqs:
         by_engine.setdefault(r["engine"], []).append(r)
-    if sorted(by_engine) != ["canonical", "canonical_gen"] or \
+    # the router request's trace is born at the PREFILL engine's submit
+    if sorted(by_engine) != ["canonical", "canonical_gen",
+                             "canonical_router_prefill"] or \
             any(len(v) != 1 for v in by_engine.values()):
         raise AssertionError(
             "expected exactly one request record per submitted request "
@@ -301,15 +320,33 @@ def emit_workload():
     gen_total = _pmon.get_metric("serve.generated_tokens")
     gen_total = int(gen_total.value) if gen_total else 0
     rec_total = sum(r["generated_tokens"] for r in reqs)
-    if rec_total != gen_total or rec_total != 3:  # max_new_tokens=3
+    if rec_total != gen_total or rec_total != 6:  # 2 x max_new_tokens=3
         raise AssertionError(
             "request-record token counts do not reconcile with the "
             f"engine counters: records {rec_total}, "
-            f"serve.generated_tokens {gen_total}, expected 3")
-    if not kvs or any(r["engine"] != "canonical_gen" for r in kvs):
+            f"serve.generated_tokens {gen_total}, expected 6")
+    kv_engines = {r["engine"] for r in kvs}
+    if not kvs or "canonical_gen" not in kv_engines:
         raise AssertionError(
             f"expected kind:'kvcache' snapshots from canonical_gen, "
             f"got {[(r.get('engine'), r.get('kind')) for r in kvs][:5]}")
+    # the front-door contract: the one router request lands >= 1
+    # "dispatched" decision on the prefill engine AND exactly one
+    # "handoff" moving its chain to the decode engine with reconciling
+    # page counts (the schema cross-checks ceil(tokens/page_size))
+    outcomes = {r["outcome"] for r in routes}
+    if not {"dispatched", "handoff"} <= outcomes:
+        raise AssertionError(
+            f"expected dispatched + handoff route records, got "
+            f"{[(r.get('outcome'), r.get('engine')) for r in routes]}")
+    hoffs = [r for r in routes if r["outcome"] == "handoff"]
+    if len(hoffs) != 1 or \
+            hoffs[0]["engine"] != "canonical_router_decode" or \
+            hoffs[0]["from_engine"] != "canonical_router_prefill" or \
+            hoffs[0]["chain_tokens"] != 4:
+        raise AssertionError(
+            f"handoff record does not match the canonical request: "
+            f"{hoffs}")
 
     # the distributed-observatory contract: the canonical workload must
     # land ≥1 schema-valid kind:"collective" record (an eager

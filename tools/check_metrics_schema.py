@@ -250,6 +250,38 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        0 = already expired at submit)
                   deadline_met bool    completed within deadline_s
                   error        str     exception repr (outcome error)
+  kind == "route" (ONE record per routing decision — the serving
+                  front door, paddle_tpu/inference/frontdoor.py
+                  ServingRouter) additionally requires:
+                  engine       str     engine chosen (non-empty; MUST
+                                       be a member of `fleet` — a
+                                       router placing work on an
+                                       engine it does not know about
+                                       is the bug this catches)
+                  fleet        list    the router's engine names
+                                       (non-empty strings, >= 1)
+                  outcome      str     dispatched | rejected | handoff
+                  slo_class    str     non-empty (interactive /
+                                       standard / batch by default)
+                  queue_depth  int     >= 0 at the decision
+  outcome == "handoff" additionally:
+                  from_engine  str     prefill engine (in fleet, and
+                                       != engine — a self-handoff is
+                                       a wiring bug)
+                  pages_moved  int     >= 1 pages in the moved chain
+                  chain_tokens int     >= 1 KV tokens moved
+                  page_size    int     >= 1; the counts must
+                                       RECONCILE: pages_moved ==
+                                       ceil(chain_tokens / page_size)
+                                       (the chain covers exactly its
+                                       written tokens — a mismatch
+                                       means pages leaked or doubled
+                                       across the handoff)
+                  and optionally:
+                  prefix_affinity bool sticky prefix routing applied
+                  prefix_match_pages int >= 0
+                  deadline_ms  number  >= 0
+                  router / request_id str non-empty
   kind == "kvcache" (periodic KV page-pool snapshot —
                   PagedKVCache.pool_stats via serve_observatory)
                   additionally requires:
@@ -338,6 +370,11 @@ REQUEST_REQUIRED = {"engine": str, "request_id": str, "outcome": str,
                     "queue_s": (int, float), "latency_s": (int, float)}
 REQUEST_OUTCOMES = {"completed", "expired", "rejected", "error",
                     "cancelled"}
+ROUTE_REQUIRED = {"engine": str, "fleet": list, "outcome": str,
+                  "slo_class": str, "queue_depth": int}
+ROUTE_OUTCOMES = {"dispatched", "rejected", "handoff"}
+ROUTE_HANDOFF_REQUIRED = {"from_engine": str, "pages_moved": int,
+                          "chain_tokens": int, "page_size": int}
 KVCACHE_REQUIRED = {"engine": str, "n_pages": int, "free_pages": int,
                     "held_pages": int, "shared_pages": int,
                     "registered_pages": int, "pages_drawn": int,
@@ -642,6 +679,87 @@ def validate_line(line, where="<line>"):
             errors.append(
                 f"{where}: deadline_met must be bool, got "
                 f"{rec['deadline_met']!r}")
+    elif rec.get("kind") == "route":
+        _check_types(rec, ROUTE_REQUIRED, where, errors)
+        for key in ("engine", "slo_class"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+        fleet = rec.get("fleet")
+        if isinstance(fleet, list):
+            if not fleet or any(not isinstance(n, str) or not n
+                                for n in fleet):
+                errors.append(
+                    f"{where}: fleet must be a non-empty list of "
+                    f"non-empty engine names, got {fleet!r}")
+            elif isinstance(rec.get("engine"), str) and rec["engine"] \
+                    and rec["engine"] not in fleet:
+                errors.append(
+                    f"{where}: engine {rec['engine']!r} not in fleet "
+                    f"{fleet} — the router placed work on an engine "
+                    "it does not know about")
+        outcome = rec.get("outcome")
+        if isinstance(outcome, str) and outcome not in ROUTE_OUTCOMES:
+            errors.append(
+                f"{where}: route outcome {outcome!r} not one of "
+                f"{sorted(ROUTE_OUTCOMES)}")
+        qd = _int_val(rec, "queue_depth")
+        if qd is not None and qd < 0:
+            errors.append(
+                f"{where}: queue_depth must be >= 0, got {qd}")
+        if outcome == "handoff":
+            _check_types(rec, ROUTE_HANDOFF_REQUIRED, where, errors)
+            fe = rec.get("from_engine")
+            if isinstance(fe, str):
+                if not fe:
+                    errors.append(f"{where}: from_engine must be "
+                                  "non-empty")
+                elif isinstance(fleet, list) and fleet and \
+                        fe not in fleet:
+                    errors.append(
+                        f"{where}: from_engine {fe!r} not in fleet "
+                        f"{fleet}")
+                elif fe == rec.get("engine"):
+                    errors.append(
+                        f"{where}: handoff from {fe!r} to itself — "
+                        "a self-handoff is a role-wiring bug")
+            moved = _int_val(rec, "pages_moved")
+            toks = _int_val(rec, "chain_tokens")
+            psize = _int_val(rec, "page_size")
+            for key, v in (("pages_moved", moved),
+                           ("chain_tokens", toks),
+                           ("page_size", psize)):
+                if v is not None and v < 1:
+                    errors.append(
+                        f"{where}: {key} must be >= 1, got {v}")
+            if None not in (moved, toks, psize) and psize >= 1 and \
+                    moved != -(-toks // psize):
+                errors.append(
+                    f"{where}: pages_moved {moved} != "
+                    f"ceil(chain_tokens {toks} / page_size {psize}) "
+                    "— the handoff page count does not reconcile "
+                    "with the tokens it claims to carry")
+        if "prefix_affinity" in rec and \
+                not isinstance(rec["prefix_affinity"], bool):
+            errors.append(
+                f"{where}: prefix_affinity must be bool, got "
+                f"{rec['prefix_affinity']!r}")
+        pmp = _int_val(rec, "prefix_match_pages") \
+            if "prefix_match_pages" in rec else None
+        if pmp is not None and pmp < 0:
+            errors.append(
+                f"{where}: prefix_match_pages must be >= 0, got {pmp}")
+        if "deadline_ms" in rec:
+            v = _num_val(rec, "deadline_ms")
+            if v is None or v < 0:
+                errors.append(
+                    f"{where}: deadline_ms must be a number >= 0, got "
+                    f"{rec['deadline_ms']!r}")
+        for key in ("router", "request_id"):
+            if key in rec and (not isinstance(rec[key], str)
+                               or not rec[key]):
+                errors.append(
+                    f"{where}: {key} must be a non-empty string, got "
+                    f"{rec[key]!r}")
     elif rec.get("kind") == "kvcache":
         _check_types(rec, KVCACHE_REQUIRED, where, errors)
 

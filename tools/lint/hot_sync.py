@@ -83,7 +83,17 @@ HOT_REGIONS = {
         "GenerationEngine._ragged_step",
         "GenerationEngine._pop_doomed_head",
         "GenerationEngine._close_doomed",
-        "GenerationEngine._note_kv_step", "GenerationEngine.load_report"],
+        "GenerationEngine._note_kv_step", "GenerationEngine.load_report",
+        # the disaggregation paths run on the scheduler threads too:
+        # the handoff epilogue, chain adoption, and the cross-engine
+        # adopt entry are all host dict/list math — the chain moves
+        # page IDS, never page contents
+        "GenerationEngine._handoff_seq",
+        "GenerationEngine._drain_adopted", "GenerationEngine.adopt"],
+    # the serving front door: routing decisions and the handoff
+    # dispatcher run on caller/scheduler threads against load_report
+    # snapshots — pure host scoring, never a device read
+    "paddle_tpu/inference/frontdoor.py": ["*"],
     # the serving observatory: request traces mutate on the scheduler
     # hot loop and kvcache snapshots run per step — the whole module
     # must stay pure host arithmetic (no device reads, ever)

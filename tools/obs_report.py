@@ -4,7 +4,9 @@
 Renders a human summary from a paddle_tpu metrics JSONL file (the
 PADDLE_TPU_METRICS_FILE export — docs/OBSERVABILITY.md): training step
 rollup (+ measured device time when the probe sampled), the compile
-ledger per executable, the serving SLO/goodput rollup, the distributed
+ledger per executable, the serving SLO/goodput rollup, the front-door
+routing section (per-engine placements, handoffs, fleet SLO), the
+distributed
 observatory's collective top-k by wall time and per-rank skew table,
 every anomaly event (stragglers, spikes, retraces, NaNs) in order, and
 the static-analysis findings section (kind:"lint" — paddlelint).
@@ -131,6 +133,64 @@ def section_serve(recs, out):
     out.append("")
 
 
+def section_routing(recs, out):
+    """The serving front door (kind:"route" — ServingRouter,
+    paddle_tpu/inference/frontdoor.py): per-engine placement counts by
+    SLO class, prefill->decode handoffs with the pages they moved,
+    rejections, and the fleet SLO rollup joined from the request
+    ledger (deadline attainment per engine)."""
+    routes = [r for r in recs if r.get("kind") == "route"]
+    if not routes:
+        return
+    disp = [r for r in routes if r.get("outcome") == "dispatched"]
+    hoffs = [r for r in routes if r.get("outcome") == "handoff"]
+    rej = [r for r in routes if r.get("outcome") == "rejected"]
+    out.append(f"== routing ==  ({len(routes)} decisions: "
+               f"{len(disp)} dispatched, {len(hoffs)} handoffs, "
+               f"{len(rej)} rejected)")
+    by_engine = {}
+    for r in disp:
+        e = by_engine.setdefault(r.get("engine", "?"),
+                                 {"n": 0, "cls": {}, "aff": 0})
+        e["n"] += 1
+        cls = r.get("slo_class", "?")
+        e["cls"][cls] = e["cls"].get(cls, 0) + 1
+        e["aff"] += 1 if r.get("prefix_affinity") else 0
+    for name in sorted(by_engine):
+        e = by_engine[name]
+        cls_txt = "  ".join(f"{k}={v}" for k, v in sorted(
+            e["cls"].items()))
+        out.append(f"  {name:<24} {e['n']:>4} placed  [{cls_txt}]"
+                   f"  prefix-affinity {e['aff']}")
+    if hoffs:
+        pairs = {}
+        for r in hoffs:
+            key = (r.get("from_engine", "?"), r.get("engine", "?"))
+            p = pairs.setdefault(key, {"n": 0, "pages": 0, "toks": 0})
+            p["n"] += 1
+            p["pages"] += int(r.get("pages_moved", 0))
+            p["toks"] += int(r.get("chain_tokens", 0))
+        for (src, dst), p in sorted(pairs.items()):
+            out.append(f"  handoff {src} -> {dst}: x{p['n']}  "
+                       f"{p['pages']} pages  {p['toks']} kv tokens")
+    # fleet SLO rollup: join the request ledger per placed engine
+    reqs = [r for r in recs if r.get("kind") == "request"
+            and "deadline_met" in r]
+    if reqs:
+        by_eng = {}
+        for r in reqs:
+            b = by_eng.setdefault(r.get("engine", "?"), [0, 0])
+            b[0] += 1 if r.get("deadline_met") else 0
+            b[1] += 1
+        met = sum(b[0] for b in by_eng.values())
+        total = sum(b[1] for b in by_eng.values())
+        per = "  ".join(f"{k}={b[0]}/{b[1]}"
+                        for k, b in sorted(by_eng.items()))
+        out.append(f"  fleet slo: {met}/{total} "
+                   f"({met / total:.3f})  [{per}]")
+    out.append("")
+
+
 def section_collectives(recs, out, top):
     colls = [r for r in recs if r.get("kind") == "collective"]
     if not colls:
@@ -243,6 +303,7 @@ def render(recs, top=5):
     section_steps(recs, out)
     section_compiles(recs, out, top)
     section_serve(recs, out)
+    section_routing(recs, out)
     section_collectives(recs, out, top)
     section_ranks(recs, out)
     section_events(recs, out, top)
