@@ -1723,7 +1723,13 @@ class GenerationEngine(_SchedulerLifecycle):
             return
         t_real = sum(n for _, _, n in metas)
         b_real = len(rows)
-        pad_t = self._pow2(t_real)
+        # the token bucket floors at MIN_Q_TOKENS so every q-block the
+        # kernel forms reaches the MXU's 8-row sublane tile (a pure-
+        # decode step of 1-3 rows would otherwise dispatch the old
+        # [1, D] VPU-shaped dots); the extra slots carry bound 0 and
+        # compute NOTHING — they ride sublanes the narrow dot wasted
+        from ..ops.pallas.attention_core import MIN_Q_TOKENS
+        pad_t = max(self._pow2(t_real), MIN_Q_TOKENS)
         pad_b = min(self._pow2(b_real), self._pow2(self.max_batch))
         # slot-accurate accounting (pre-dispatch: lengths advance in
         # the step): each token computes exactly ceil(bound/page)
@@ -1918,6 +1924,7 @@ class GenerationEngine(_SchedulerLifecycle):
         Returns jit.warm.WarmHandles; join with jit.warm.join."""
         if not self.ragged:
             return []
+        from ..ops.pallas.attention_core import MIN_Q_TOKENS
         max_new = self.default_max_new if max_new_tokens is None \
             else int(max_new_tokens)
         P = self.cache.page_size
@@ -1925,6 +1932,12 @@ class GenerationEngine(_SchedulerLifecycle):
         def width(tokens):  # table width bucket once `tokens` are held
             return self._pow2(-(-tokens // P))
 
+        # every token bucket floors at MIN_Q_TOKENS — the same rule
+        # _ragged_step pads with, so short chunks, prefix-hit
+        # remainders, and decode steps all land on signatures warmed
+        # here (small buckets COLLAPSE: a 4-prompt workload warms one
+        # (8, 1, w) signature where the unfloored schedule warmed
+        # (4,...) and (1,...) separately)
         sigs, filled, total = [], 0, int(prompt_len)
         while filled < total:
             n = min(self.prefill_chunk, total - filled)
@@ -1932,10 +1945,10 @@ class GenerationEngine(_SchedulerLifecycle):
             t_bucket = self._pow2(n)
             w = width(filled)
             while t_bucket >= 1:  # sub-chunk remainders at this width
-                sigs.append((t_bucket, 1, w))
+                sigs.append((max(t_bucket, MIN_Q_TOKENS), 1, w))
                 t_bucket //= 2
         for k in range(max_new - 1):  # decode k writes token total+k
-            sigs.append((1, 1, width(total + k + 1)))
+            sigs.append((MIN_Q_TOKENS, 1, width(total + k + 1)))
         return [self.model.warm_ragged(self.cache, *sig)
                 for sig in dict.fromkeys(sigs)]
 

@@ -111,13 +111,17 @@ class RaggedJitSlot:
     prefill+decode program over the Pallas kernel in
     ops/pallas/paged_attention.py): traced/donated k/v pools plus the
     host plan from PagedKVCache.plan_ragged — per-token scatter
-    coordinates and causal bounds, per-row page tables."""
+    coordinates and causal bounds, per-row page tables, and the
+    q-block kv-page walk (blk_*) the kernel's double-buffered DMA loop
+    follows."""
 
     __slots__ = ("k", "v", "tok_pages", "tok_in_pages", "page_table",
-                 "token_seq", "bounds")
+                 "token_seq", "bounds", "blk_pages", "blk_seq",
+                 "blk_start", "blk_n")
 
     def __init__(self, k, v, tok_pages, tok_in_pages, page_table,
-                 token_seq, bounds):
+                 token_seq, bounds, blk_pages=None, blk_seq=None,
+                 blk_start=None, blk_n=None):
         self.k = k
         self.v = v
         self.tok_pages = tok_pages
@@ -125,6 +129,10 @@ class RaggedJitSlot:
         self.page_table = page_table
         self.token_seq = token_seq
         self.bounds = bounds
+        self.blk_pages = blk_pages
+        self.blk_seq = blk_seq
+        self.blk_start = blk_start
+        self.blk_n = blk_n
 
 
 def sample_token_rows(last, temps, top_ks, top_ps, rng_keys, positions):
@@ -319,9 +327,12 @@ class GPTAttention(nn.Layer):
             k.value[0].astype(kd))
         slot.v = slot.v.at[slot.tok_pages, slot.tok_in_pages].set(
             v.value[0].astype(kd))
+        plan = (None if slot.blk_pages is None else
+                (slot.blk_pages, slot.blk_seq, slot.blk_start,
+                 slot.blk_n))
         out = ragged_paged_attention(
             q.value[0], slot.k, slot.v, slot.page_table, slot.token_seq,
-            slot.bounds)
+            slot.bounds, block_plan=plan)
         out = self.out_proj(Tensor(out.reshape(1, T, H).astype(
             x.value.dtype)))
         return out, slot
@@ -721,14 +732,16 @@ class GPTForCausalLM(nn.Layer):
 
         def step(ps, kps, vps, toks, pos, tok_seq, tok_pages,
                  tok_in_pages, bounds, pt, out_idx, temps, top_ks,
-                 top_ps, rng_keys):
+                 top_ps, rng_keys, blk_pages, blk_seq, blk_start,
+                 blk_n):
             # trace-time side effect: exact count of ragged executables
             # traced (one per novel (T, B, W) signature) — the serving
             # engine folds the delta into serve.retraces
             model._ragged_traces = getattr(
                 model, "_ragged_traces", 0) + 1
             slots = [RaggedJitSlot(kps[l], vps[l], tok_pages,
-                                   tok_in_pages, pt, tok_seq, bounds)
+                                   tok_in_pages, pt, tok_seq, bounds,
+                                   blk_pages, blk_seq, blk_start, blk_n)
                      for l in range(L)]
             logits, out_slots = functional_call(
                 model, ps, {}, (Tensor(toks[None, :]),),
@@ -765,19 +778,37 @@ class GPTForCausalLM(nn.Layer):
         i32 = jnp.int32
         B = int(n_rows)
         tok = lambda: sds((int(n_tokens),), i32)
+        # the q-block plan's shapes derive from (T, B, W) through the
+        # same choose_q_block the planner applies — still one
+        # executable per (T, B, W) signature
+        qb, s_cap = self._ragged_block_geometry(
+            cache, n_tokens, n_rows, width)
         return (jax.tree.map(lambda a: sds(a.shape, a.dtype), params),
                 pools, list(pools), tok(), tok(), tok(), tok(), tok(),
                 tok(), sds((B, int(width)), i32), sds((B,), i32),
                 # per-row sampling config: [B]-shaped like out_idx, so
                 # the signature still keys on (T, B, W) only
                 sds((B,), jnp.float32), sds((B,), i32),
-                sds((B,), jnp.float32), sds((B, 2), jnp.uint32))
+                sds((B,), jnp.float32), sds((B, 2), jnp.uint32),
+                sds((qb, s_cap), i32), sds((qb, s_cap), i32),
+                sds((qb, s_cap), i32), sds((qb,), i32))
+
+    def _ragged_block_geometry(self, cache, n_tokens, n_rows, width):
+        """(QB, S) of the q-block plan arrays for one (T, B, W)
+        signature — the shape contract between plan_ragged's host
+        planner and the compiled step."""
+        from ..ops.pallas.attention_core import MXU_ROWS, choose_q_block
+        fold = max(self.cfg.num_heads // cache.n_heads, 1)
+        q_block = choose_q_block(int(n_tokens),
+                                 cap=max(MXU_ROWS // fold, 1))
+        return int(n_tokens) // q_block, int(n_rows) * int(width)
 
     _RAGGED_ARG_NAMES = ("params", "k_pages", "v_pages", "tokens",
                          "positions", "token_seq", "tok_pages",
                          "tok_in_pages", "bounds", "page_table",
                          "out_idx", "temperatures", "top_ks", "top_ps",
-                         "rng_keys")
+                         "rng_keys", "blk_pages", "blk_seq",
+                         "blk_start", "blk_n")
 
     @staticmethod
     def _ragged_sig(cache, n_tokens, n_rows, width):
@@ -853,7 +884,8 @@ class GPTForCausalLM(nn.Layer):
         with cache.lock:
             plan = cache.plan_ragged([(s, len(t)) for s, t in rows],
                                      pad_to_tokens=pad_to_tokens,
-                                     pad_to_rows=pad_to_rows)
+                                     pad_to_rows=pad_to_rows,
+                                     q_heads=self.cfg.num_heads)
             T = plan["tok_pages"].shape[0]
             B, W = plan["page_table"].shape
             toks = np.zeros((T,), np.int32)
@@ -886,7 +918,11 @@ class GPTForCausalLM(nn.Layer):
                     jnp.asarray(plan["page_table"]),
                     jnp.asarray(plan["out_idx"]),
                     jnp.asarray(temps), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(rng_keys))
+                    jnp.asarray(top_ps), jnp.asarray(rng_keys),
+                    jnp.asarray(plan["blk_pages"]),
+                    jnp.asarray(plan["blk_seq"]),
+                    jnp.asarray(plan["blk_start"]),
+                    jnp.asarray(plan["blk_n"]))
             try:
                 last, nxt, new_k, new_v = compiled(*args)
             except Exception as e:

@@ -676,7 +676,8 @@ class PagedKVCache:
             lens = jnp.concatenate([lens, jnp.zeros((n_pad,), jnp.int32)])
         return jnp.asarray(pages), jnp.asarray(in_pages), pt, lens
 
-    def plan_ragged(self, rows, pad_to_tokens=None, pad_to_rows=None):
+    def plan_ragged(self, rows, pad_to_tokens=None, pad_to_rows=None,
+                    q_heads=None):
         """Host-side plan for ONE jitted RAGGED step (the Pallas kernel
         in ops/pallas/paged_attention.py): `rows` is a list of
         (seq_id, n_new_tokens) mixing decode rows (1) and prefill
@@ -691,12 +692,22 @@ class PagedKVCache:
             page_table [B, W] int32 (width pow2-bucketed, 0-padded)
             out_idx [B]     flat index of each row's LAST token
             n_tokens/n_rows the REAL counts before padding
+            blk_pages/blk_seq/blk_start [QB, B*W], blk_n [QB]  the
+                kernel's q-block kv-page walk (build_block_plan): per
+                q-block, the compacted slot list its double-buffered
+                DMA loop visits — planned HERE on the host so the
+                serving scheduler stays free of device round-trips
 
         pad_to_tokens/pad_to_rows pad to fixed compiled shapes: pad
         tokens scatter into the reserved pad page with bound 0 — the
         kernel SKIPS them, so padding costs no attention work (the
         whole point vs plan_decode's bucket rows). Lengths are
-        pre-write; advance(sid, n) after the step commits."""
+        pre-write; advance(sid, n) after the step commits.
+
+        q_heads: the model's QUERY head count when it exceeds this
+        cache's kv heads (grouped-query attention) — the kernel folds
+        the group into the q-block rows, so the block cap shrinks by
+        the same factor; defaults to the kv head count (fold 1)."""
         sids = [s for s, _ in rows]
         if len(set(sids)) != len(sids):
             raise ValueError(f"duplicate seq_ids in ragged step: {sids!r}")
@@ -747,16 +758,33 @@ class PagedKVCache:
         tok_pos += [0] * n_tok_pad
         bounds += [0] * n_tok_pad
         out_idx += [0] * n_row_pad
+        bounds = np.asarray(bounds, np.int32)
+        tok_seq = np.asarray(tok_seq, np.int32)
+        # q-block plan for the blocked kernel — the same choose_q_block
+        # the kernel wrapper would apply, computed here so the serving
+        # step ships a ready-made plan (no in-trace derivation, no
+        # device round-trips in the scheduler)
+        from .pallas.attention_core import MXU_ROWS, choose_q_block
+        from .pallas.paged_attention import build_block_plan
+        fold = max(int(q_heads or self.n_heads) // self.n_heads, 1)
+        q_block = choose_q_block(len(bounds),
+                                 cap=max(MXU_ROWS // fold, 1))
+        blk_pages, blk_seq, blk_start, blk_n = build_block_plan(
+            pt, tok_seq, bounds, P, q_block)
         return {
             "tok_pages": np.asarray(tok_pages, np.int32),
             "tok_in_pages": np.asarray(tok_in, np.int32),
-            "token_seq": np.asarray(tok_seq, np.int32),
+            "token_seq": tok_seq,
             "positions": np.asarray(tok_pos, np.int32),
-            "bounds": np.asarray(bounds, np.int32),
+            "bounds": bounds,
             "page_table": pt.astype(np.int32),
             "out_idx": np.asarray(out_idx, np.int32),
             "n_tokens": T,
             "n_rows": B,
+            "blk_pages": blk_pages,
+            "blk_seq": blk_seq,
+            "blk_start": blk_start,
+            "blk_n": blk_n,
         }
 
     # ---- reads --------------------------------------------------------

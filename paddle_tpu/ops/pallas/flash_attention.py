@@ -1,18 +1,26 @@
-"""Flash attention for TPU in Pallas.
+"""Fused flash-attention TRAINING kernel for TPU in Pallas.
 
-Replaces the reference's fused attention CUDA kernels
-(paddle/fluid/operators/fused/fused_attention_op.cu) and the O(T^2)-memory
-XLA composition: attention is computed blockwise in VMEM with an online
-softmax, so the [T, T] probability matrix never hits HBM. Backward is the
-standard two-pass flash backward (dq pass, then dk/dv pass) via
-jax.custom_vjp, accumulating in fp32 scratch.
+The training-side twin of paged_attention.py: the SAME blocking policy
+and online-softmax block update (ops/pallas/attention_core.py owns
+both) applied to the contiguous case — q-blocks of one sequence's
+tokens against kv blocks of the same sequence, so the [T, T]
+probability matrix never materializes in HBM. Block shapes come from
+attention_core.choose_flash_blocks (VMEM-budget-capped, measured on
+real TPU); every score dot is [bq, D] x [D, bk] with bq targeting the
+same MXU tiles the serving kernel's q-block/head folding targets, and
+tools/check_dot_shapes.py ratchets both kernels against the same M >= 8
+floor.
 
-Layout contract: q, k, v are [batch, seq, heads, head_dim] (paddle
-incubate fused-attention layout); internally we fold to [B*H, T, D].
-Causal masking is applied per-block; fully-masked blocks are skipped.
+Backward is the standard two-pass flash backward (dq pass, then dk/dv
+pass) via jax.custom_vjp, recomputing probabilities from the saved lse
+and accumulating in f32 scratch.
+
+Layout contract: q, k, v are [batch, seq, heads, head_dim] (the
+framework's fused-attention layout); internally folded to [B*H, T, D].
+Causal masking is attention_core.causal_valid per block; blocks
+strictly above the diagonal are skipped outright.
 """
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
@@ -20,44 +28,29 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .common import I0, NEG_INF  # noqa: F401
+from . import attention_core as core
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                l_ref, *, scale, causal, block_q, block_k, seq_k):
+                l_ref, *, scale, causal, block_q, block_k):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ik == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, jnp.float32(NEG_INF))
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m0, l0, acc0 = core.softmax_carry(block_q, q_ref.shape[-1])
+        m_ref[:], l_ref[:], acc_ref[:] = m0, l0, acc0
 
     def _body():
         q = q_ref[0].astype(jnp.float32)          # [bq, d]
         k = k_ref[0].astype(jnp.float32)          # [bk, d]
         v = v_ref[0].astype(jnp.float32)          # [bk, d]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * jnp.float32(scale)                 # [bq, bk]
-        if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
-
-        m_prev = m_ref[:]                          # [bq]
-        m_cur = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
+        s = core.score_dot(q, k, scale)           # [bq, bk]
+        valid = (core.causal_valid(iq, ik, block_q, block_k)
+                 if causal else None)
+        m_ref[:], l_ref[:], acc_ref[:] = core.softmax_update(
+            m_ref[:], l_ref[:], acc_ref[:], s, v, valid=valid)
 
     if causal:
         # skip blocks strictly above the diagonal band
@@ -69,9 +62,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 
     @pl.when(ik == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[:], jnp.float32(1e-30))
-        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:] + jnp.log(l)
+        out, lse = core.softmax_finalize(m_ref[:], l_ref[:], acc_ref[:])
+        o_ref[0] = out.astype(o_ref.dtype)
+        lse_ref[0, 0] = lse
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -91,14 +84,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * jnp.float32(scale)
+        s = core.score_dot(q, k, scale)
         if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
+            s = jnp.where(core.causal_valid(iq, ik, block_q, block_k),
+                          s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -138,21 +127,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * jnp.float32(scale)
+        s = core.score_dot(q, k, scale)
         if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, jnp.float32(NEG_INF))
+            s = jnp.where(core.causal_valid(iq, ik, block_q, block_k),
+                          s, jnp.float32(NEG_INF))
         p = jnp.exp(s - lse[:, None])                       # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * jnp.float32(scale)               # [bq, bk]
+        ds = p * (dp - delta[:, None]) * jnp.float32(scale)  # [bq, bk]
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bk, d]
@@ -170,31 +155,6 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _choose_blocks(t_q, t_k, d):
-    # Biggest blocks win decisively on real TPU (measured on
-    # [128,1024,64] bf16: 1024x1024 runs fwd 1.9x / fwd+bwd 1.5x faster
-    # than 512x512; small bk is the worst axis to shrink). 1024x1024
-    # puts the f32 [bq, bk] score+prob tiles at ~8 MB of VMEM — about
-    # the ceiling once q/k/v/do/acc tiles are added, so the cap is the
-    # VMEM budget; round down to divisors of the seq lens.
-    # the dkv backward holds ~3 concurrent f32 [bq, bk] tiles plus
-    # q/k/v/do tiles that scale with d — shrink bk for head dims > 64
-    # to stay inside the same budget the d=64 measurement validated
-    bq = min(1024, t_q)
-    while t_q % bq:
-        bq //= 2
-    # round the bk seed DOWN to a power of two first: for d=96/80 the
-    # VMEM-budget quotient (682/819) is not a power of two, and the
-    # halving loop would otherwise never land on a divisor of a
-    # power-of-two t_k until bk collapsed to 1
-    seed = 1024 * 64 // max(d, 64)
-    seed = 1 << (seed.bit_length() - 1)
-    bk = min(seed, t_k)
-    while t_k % bk:
-        bk //= 2
-    return max(bq, 1), max(bk, 1)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, scale, interpret):
     out, _ = _flash_fwd_impl(q, k, v, causal, scale, interpret)
@@ -204,11 +164,11 @@ def _flash(q, k, v, causal, scale, interpret):
 def _flash_fwd_impl(q, k, v, causal, scale, interpret):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
-    bq, bk = _choose_blocks(Tq, Tk, D)
+    bq, bk = core.choose_flash_blocks(Tq, Tk, D)
     grid = (BH, Tq // bq, Tk // bk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, seq_k=Tk),
+                          block_q=bq, block_k=bk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, I0)),
@@ -244,7 +204,7 @@ def _flash_bwd(causal, scale, interpret, res, dout):
     q, k, v, out, lse = res
     BH, Tq, D = q.shape
     Tk = k.shape[1]
-    bq, bk = _choose_blocks(Tq, Tk, D)
+    bq, bk = core.choose_flash_blocks(Tq, Tk, D)
     delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32),
                     axis=-1)[:, None, :]  # [BH, 1, Tq]
 
@@ -300,19 +260,16 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
                            interpret=False):
     """Array-level entry: q,k,v [B, T, H, D] → out [B, T, H, D]."""
     B, Tq, H, D = q.shape
-    Tk = k.shape[1]
-    if scale is None:
-        scale = 1.0 / math.sqrt(D)
+    scale = core.default_scale(scale, D)
     fold = lambda x: jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], D)
-    out = _flash(fold(q), fold(k), fold(v), causal, float(scale), interpret)
+    out = _flash(fold(q), fold(k), fold(v), causal, scale, interpret)
     return jnp.swapaxes(out.reshape(B, H, Tq, D), 1, 2)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, interpret=None):
     """Tensor-level entry used by F.scaled_dot_product_attention."""
     from ...framework.core import apply_op
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = core.default_interpret(interpret)
     return apply_op(
         lambda qa, ka, va: flash_attention_arrays(
             qa, ka, va, causal=causal, scale=scale, interpret=interpret),

@@ -1,33 +1,46 @@
-"""Ragged paged attention for TPU in Pallas.
+"""MXU-shaped ragged paged attention for TPU in Pallas.
 
-The serving-side twin of flash_attention.py (see PAPERS.md "Ragged
-Paged Attention: A High-Performance and Flexible LLM Inference Kernel
-for TPU"): ONE kernel call processes a batch of query tokens whose rows
+The serving-side twin of flash_attention.py (PAPERS.md "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for
+TPU"): ONE kernel call processes a batch of query tokens whose rows
 belong to DIFFERENT sequences at DIFFERENT lengths — decode rows (one
 token against a long history) and prefill-chunk rows (a slice of a
-prompt against its own growing history) mix freely. Per-token causal
-bounds drive the page-table walk, so no row ever pays for another
-row's padding:
+prompt against its own growing history) mix freely, under per-token
+causal bounds.
 
-- the grid is (token, head, kv-page-slot); the page id each program
-  reads comes from a scalar-prefetched per-token page table, so the
-  DMA walks each sequence's own pages;
-- a kv slot at or past the token's causal bound is SKIPPED outright
-  (`pl.when` predication — on TPU the grid is sequential, a skipped
-  block costs ~nothing). A pad token (bound 0) therefore does ZERO
-  attention work; a decode token next to a 2048-token neighbor does
-  exactly ceil(len/page) blocks of its own.
+Blocking (ops/pallas/attention_core.py owns the policy, shared with
+the training kernel):
 
-The kernel also emits a per-token WORK counter (kv blocks actually
-computed) — the ground truth behind the serving engine's
-`pad_token_fraction` metric and the tests' skip-proof, not an estimate.
+- tokens are grouped into Q-BLOCKS of `Bq` rows; for grouped-query
+  models the `fold = H_q // H_kv` query heads sharing one kv head are
+  folded into the row dimension, so every score dot is
+  [Bq*fold, D] x [D, P] — M >= MIN_DOT_ROWS (target MXU_ROWS), where
+  the seed-era kernel issued per-(token, head) [1, D] x [D, P] VPU
+  dots. Rows of a q-block that don't own the current page are masked
+  (and their probabilities explicitly zeroed), which costs nothing:
+  they ride sublanes the narrow dot was wasting anyway.
+- the kv pages each q-block must visit come from a host-side BLOCK
+  PLAN (build_block_plan, grown in PagedKVCache.plan_ragged — no
+  device round-trips in the serving scheduler): per q-block, the
+  compacted list of (page id, owning row, kv start) slots any of its
+  tokens' bounds reach, plus the real slot count. Shapes depend only
+  on (T, B, W), so the serving executable's signature is unchanged.
+- the page walk is DOUBLE-BUFFERED DMA (pallas_guide.md pattern): the
+  kernel copies page i+1 into the alternate VMEM slot while computing
+  page i, so the HBM walk overlaps the MXU work. A q-block of pure pad
+  tokens has a zero slot count and issues NO copies at all.
 
-Softmax is the standard online/flash formulation in f32 scratch. On
-CPU (tier-1 tests) the same kernel runs in Pallas interpret mode, so
-the serving engine exercises identical code on every backend.
+The kernel still emits the per-token WORK counter (kv page blocks
+actually computed = ceil(bound/P), 0 for pads) — the ground truth
+behind the serving engine's `pad_token_fraction` metric and the tests'
+skip-proof, not an estimate.
+
+Softmax is the shared online/flash formulation in f32
+(attention_core.softmax_update). On CPU (tier-1) the same kernel —
+DMA double-buffering included — runs in Pallas interpret mode, so the
+serving engine exercises identical code on every backend.
 """
 import functools
-import math
 
 import numpy as np
 import jax
@@ -35,116 +48,234 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .common import I0, NEG_INF
+from .common import I0
+from . import attention_core as core
 
-__all__ = ["ragged_paged_attention"]
+__all__ = ["ragged_paged_attention", "ragged_work_plan",
+           "build_block_plan"]
 
 
-def _kernel(pt_ref, bd_ref, q_ref, k_ref, v_ref, o_ref, w_ref,
-            m_ref, l_ref, acc_ref, *, page_size, scale):
-    """One (token t, head h, kv slot j) program: online-softmax update
-    of token t's head-h accumulator with page `pt[t, j]`, skipped when
-    the slot starts at or past the token's causal bound."""
+def build_block_plan(page_table, token_seq, bounds, page_size, q_block):
+    """HOST-side (numpy) kv-page plan for the blocked kernel: which
+    pages each q-block walks, compacted so the DMA loop touches only
+    real work.
+
+    Returns (blk_pages, blk_seq, blk_start, blk_n):
+
+        blk_pages [QB, S] int32  page id of each slot (S = B*W cap)
+        blk_seq   [QB, S] int32  page_table row owning the slot
+        blk_start [QB, S] int32  kv position where the page starts
+        blk_n     [QB]    int32  real slots; the kernel loops to this
+
+    A slot exists when ANY token of the q-block has a causal bound
+    reaching into that page (bound > page_start). Slots keep
+    (row-major, page-minor) order; entries past blk_n are never read.
+    Shapes are a pure function of (T, B, W, q_block), so a serving
+    executable keyed on (T, B, W) stays one executable."""
+    pt = np.asarray(page_table, np.int64)
+    seq = np.asarray(token_seq, np.int64).reshape(-1)
+    bd = np.asarray(bounds, np.int64).reshape(-1)
+    B, W = pt.shape
+    T = seq.shape[0]
+    q_block = int(q_block)
+    if T % q_block:
+        raise ValueError(f"tokens {T} not divisible by q_block {q_block}")
+    QB = T // q_block
+    S = B * W
+    # per-(q-block, row) max bound: the page reach of the block's rows
+    bb = np.zeros((QB, B), np.int64)
+    np.maximum.at(bb, (np.arange(T) // q_block, seq), bd)
+    starts = np.arange(W, dtype=np.int64) * int(page_size)
+    active = (bb[:, :, None] > starts[None, None, :]).reshape(QB, S)
+    # stable partition: active slots first, (row, page) order preserved
+    order = np.argsort(~active, axis=1, kind="stable")
+    take = lambda a: np.take_along_axis(
+        np.broadcast_to(a.reshape(1, S), (QB, S)), order, axis=1)
+    return (take(pt.reshape(-1)).astype(np.int32),
+            take(np.arange(S) // W).astype(np.int32),
+            take((np.arange(S) % W) * int(page_size)).astype(np.int32),
+            active.sum(axis=1).astype(np.int32))
+
+
+def _block_plan_jnp(page_table, token_seq, bounds, page_size, q_block):
+    """Traced twin of build_block_plan for callers without a host plan
+    (eager tests, kernels jitted standalone): same fixed shapes, same
+    slot order, computable on concrete OR traced arrays. The serving
+    path never takes this — its plan rides in from plan_ragged."""
+    pt = page_table.astype(jnp.int32)
+    seq = token_seq.astype(jnp.int32).reshape(-1)
+    bd = bounds.astype(jnp.int32).reshape(-1)
+    B, W = pt.shape
+    T = seq.shape[0]
+    QB = T // int(q_block)
+    S = B * W
+    qb_idx = jnp.arange(T, dtype=jnp.int32) // jnp.int32(q_block)
+    bb = jnp.zeros((QB, B), jnp.int32).at[qb_idx, seq].max(bd)
+    slot = jnp.arange(S, dtype=jnp.int32)
+    rows, pages = slot // W, slot % W
+    starts = pages * jnp.int32(page_size)
+    active = bb[:, rows] > starts[None, :]                   # [QB, S]
+    # stable partition via a composite sort key (inactive rank S floats
+    # every active slot ahead while the +slot term keeps their order)
+    order = jnp.argsort(
+        jnp.where(active, jnp.int32(0), jnp.int32(S)) * S + slot, axis=1)
+    take = lambda a: jnp.take_along_axis(
+        jnp.broadcast_to(a[None, :], (QB, S)), order, axis=1)
+    return (take(pt.reshape(-1)), take(rows), take(starts),
+            jnp.sum(active.astype(jnp.int32), axis=1))
+
+
+def _kernel(bp_ref, bs_ref, bst_ref, bn_ref,      # scalar prefetch
+            seq_ref, bd_ref, q_ref,               # blocked VMEM inputs
+            k_hbm, v_hbm,                         # full pools (ANY)
+            o_ref, w_ref,                         # blocked outputs
+            kbuf, vbuf, ksem, vsem,               # DMA double buffers
+            *, page_size, scale, fold):
+    """One (q-block, kv-head) program: walk the block's planned kv
+    pages through the double buffer, online-softmax every page into
+    the folded [Bq*fold, D] accumulator under the per-token bounds."""
+    qb = pl.program_id(0)
     h = pl.program_id(1)
-    j = pl.program_id(2)
-    nj = pl.num_programs(2)
+    n = bn_ref[qb]
+    Bq, f, D = q_ref.shape
+    M = Bq * fold
 
-    @pl.when(j == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, jnp.float32(NEG_INF))
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    seq = seq_ref[:, 0]                           # [Bq] row per token
+    bd = bd_ref[:, 0]                             # [Bq] causal bounds
+    if fold == 1:
+        q = q_ref[:, 0, :].astype(jnp.float32)    # [M, D]
+        seq_f, bd_f = seq, bd
+    else:
+        q = q_ref[...].astype(jnp.float32).reshape(M, D)
+        brd = lambda a: jnp.broadcast_to(
+            a[:, None], (Bq, fold)).reshape(M)
+        seq_f, bd_f = brd(seq), brd(bd)
 
-    @pl.when((j == 0) & (h == 0))
-    def _init_work():
-        w_ref[0, 0] = jnp.int32(0)
+    def copies(i, slot):
+        page = bp_ref[qb, i]
+        return (pltpu.make_async_copy(k_hbm.at[page, :, h],
+                                      kbuf.at[slot], ksem.at[slot]),
+                pltpu.make_async_copy(v_hbm.at[page, :, h],
+                                      vbuf.at[slot], vsem.at[slot]))
 
-    bound = bd_ref[pl.program_id(0)]
+    @pl.when(h == 0)
+    def _zero_work():
+        w_ref[:, 0] = jnp.zeros((Bq,), jnp.int32)
 
-    @pl.when(j * page_size < bound)
-    def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)          # [D]
-        k = k_ref[0, :, 0].astype(jnp.float32)       # [P, D]
-        v = v_ref[0, :, 0].astype(jnp.float32)       # [P, D]
-        s = jax.lax.dot_general(q[None, :], k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * jnp.float32(scale)                   # [1, P]
-        pos = j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, page_size), 1)
-        s = jnp.where(pos < bound, s, jnp.float32(NEG_INF))
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1)
-        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:] = m_new
+    @pl.when(n > 0)
+    def _warmup():                                # first page's DMA
+        for c in copies(0, 0):
+            c.start()
+
+    def body(i, carry):
+        m, l, acc = carry
+        two = jnp.asarray(2, i.dtype)
+        slot = jax.lax.rem(i, two)
+
+        @pl.when(i + 1 < n)
+        def _prefetch():                          # overlap: next page
+            for c in copies(i + 1, jax.lax.rem(i + 1, two)):
+                c.start()
+
+        for c in copies(i, slot):
+            c.wait()
+        b = bs_ref[qb, i]
+        start = bst_ref[qb, i]
+        k = kbuf[slot].astype(jnp.float32)        # [P, D]
+        v = vbuf[slot].astype(jnp.float32)        # [P, D]
+        s = core.score_dot(q, k, scale)           # [M, P] — MXU-shaped
+        pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (M, page_size), 1)
+        valid = (seq_f == b)[:, None] & (pos < bd_f[:, None])
+        m, l, acc = core.softmax_update(m, l, acc, s, v, valid=valid)
 
         @pl.when(h == 0)
-        def _count():
-            w_ref[0, 0] += jnp.int32(1)
+        def _count():                             # measured work, not
+            w_ref[:, 0] += (                      # an estimate
+                (seq == b) & (start < bd)).astype(jnp.int32)
 
-    @pl.when(j == nj - 1)
-    def _finalize():
-        # a fully-skipped token (bound 0: pad slot) divides 0 by the
-        # floor and writes zeros — garbage by construction, sliced off
-        # by the caller
-        l = jnp.maximum(l_ref[:], jnp.float32(1e-30))
-        o_ref[0, 0] = (acc_ref[:] / l[:, None])[0].astype(o_ref.dtype)
+        return m, l, acc
+
+    m, l, acc = jax.lax.fori_loop(
+        0, n, body, core.softmax_carry(M, D))
+    out, _ = core.softmax_finalize(m, l, acc)
+    o_ref[...] = out.reshape(Bq, fold, D).astype(o_ref.dtype)
 
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, token_seq,
                            bounds, scale=None, interpret=None,
-                           return_work=False):
+                           return_work=False, block_plan=None,
+                           q_block=None):
     """Mixed prefill+decode attention over paged KV state.
 
     q:          [T, H, D]  query tokens, any mix of sequences/phases
-    k_pages:    [n_pages, P, H, D]  shared page pools
-    v_pages:    [n_pages, P, H, D]
+    k_pages:    [n_pages, P, H_kv, D]  shared page pools (H_kv may
+                divide H: grouped-query folding puts the group's heads
+                in the same score dot)
+    v_pages:    [n_pages, P, H_kv, D]
     page_table: [B, W] int32 page ids per sequence (pad page 0)
     token_seq:  [T] int32  page_table row of each token
     bounds:     [T] int32  kv tokens visible to each token (causal:
                 history + preceding new tokens + itself); 0 marks a pad
                 token that does NO work
+    block_plan: optional (blk_pages, blk_seq, blk_start, blk_n) from
+                build_block_plan — the serving path precomputes it on
+                the host (PagedKVCache.plan_ragged); omitted, the same
+                plan is derived in-trace.
+    q_block:    rows per q-block; default attention_core.choose_q_block
+                (<= MXU_ROWS/fold, halved to divide T).
+
     Returns [T, H, D] (and, with return_work, the per-token count of
     kv page blocks actually computed — ceil(bound/P), 0 for pads)."""
     T, H, D = q.shape
-    P = k_pages.shape[1]
-    W = page_table.shape[1]
-    if scale is None:
-        scale = 1.0 / math.sqrt(D)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    # per-token page rows: ONE tiny gather so the index maps stay pure
-    # scalar reads (page_table rows are shared by a sequence's tokens)
-    tok_pt = jnp.take(page_table.astype(jnp.int32),
-                      token_seq.astype(jnp.int32), axis=0)
+    n_pages, P, KVH, _ = k_pages.shape
+    if H % KVH:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {KVH}")
+    fold = H // KVH
+    B, W = page_table.shape
+    scale = core.default_scale(scale, D)
+    interpret = core.default_interpret(interpret)
+    bq = int(q_block) if q_block else core.choose_q_block(
+        T, cap=max(core.MXU_ROWS // fold, 1))
+    if T % bq:
+        raise ValueError(f"tokens {T} not divisible by q_block {bq}")
+    QB = T // bq
+    if block_plan is None:
+        block_plan = _block_plan_jnp(page_table, token_seq, bounds,
+                                     P, bq)
+    bp, bs, bst, bn = (jnp.asarray(a, jnp.int32) for a in block_plan)
+    if bp.shape != (QB, B * W) or bn.shape != (QB,):
+        raise ValueError(
+            f"block plan shape {bp.shape}/{bn.shape} does not match "
+            f"q_block={bq} over T={T}, B={B}, W={W}")
     out, work = pl.pallas_call(
-        functools.partial(_kernel, page_size=P, scale=float(scale)),
+        functools.partial(_kernel, page_size=P, scale=scale, fold=fold),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(T, H, W),
+            num_scalar_prefetch=4,
+            grid=(QB, KVH),
             in_specs=[
-                pl.BlockSpec((1, 1, D),
-                             lambda t, h, j, pt, bd: (t, h, I0)),
-                pl.BlockSpec((1, P, 1, D),
-                             lambda t, h, j, pt, bd: (pt[t, j], I0, h, I0)),
-                pl.BlockSpec((1, P, 1, D),
-                             lambda t, h, j, pt, bd: (pt[t, j], I0, h, I0)),
+                pl.BlockSpec((bq, 1), lambda qb, h, *_: (qb, I0)),
+                pl.BlockSpec((bq, 1), lambda qb, h, *_: (qb, I0)),
+                pl.BlockSpec((bq, fold, D),
+                             lambda qb, h, *_: (qb, h, I0)),
+                # the pools stay in HBM; the kernel's double-buffered
+                # DMA walks exactly the planned pages
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, D),
-                             lambda t, h, j, pt, bd: (t, h, I0)),
-                # work lives in a [T, 1] column: trailing (1, 1) blocks
-                # keep the revisited accumulator on one resident tile
-                pl.BlockSpec((1, 1), lambda t, h, j, pt, bd: (t, I0)),
+                pl.BlockSpec((bq, fold, D),
+                             lambda qb, h, *_: (qb, h, I0)),
+                # work lives in a [T, 1] column: trailing (Bq, 1)
+                # blocks keep the revisited counter on one resident
+                # tile across the kv-head grid axis
+                pl.BlockSpec((bq, 1), lambda qb, h, *_: (qb, I0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((1,), jnp.float32),       # m (running max)
-                pltpu.VMEM((1,), jnp.float32),       # l (running sum)
-                pltpu.VMEM((1, D), jnp.float32),     # acc
+                pltpu.VMEM((2, P, D), k_pages.dtype),  # k double buffer
+                pltpu.VMEM((2, P, D), v_pages.dtype),  # v double buffer
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         ),
         out_shape=[
@@ -152,7 +283,10 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, token_seq,
             jax.ShapeDtypeStruct((T, 1), jnp.int32),
         ],
         interpret=interpret,
-    )(tok_pt, bounds.astype(jnp.int32), q, k_pages, v_pages)
+    )(bp, bs, bst, bn,
+      token_seq.astype(jnp.int32).reshape(T, 1),
+      bounds.astype(jnp.int32).reshape(T, 1),
+      q, k_pages, v_pages)
     if return_work:
         return out, work[:, 0]
     return out

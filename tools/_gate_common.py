@@ -239,8 +239,9 @@ def emit_workload():
     # mixed prefill+decode program): its own tiny GPT in eval mode so
     # the train step's donation traffic can't touch its param snapshot.
     # prompt 4 + max_new 3 at page_size 16 keeps the table width at 1,
-    # so warm_async's simulated schedule is exactly two signatures:
-    # one prefill chunk (T=4) and the decode step (T=1)
+    # and the MIN_Q_TOKENS=8 token-bucket floor (q-blocks must reach
+    # the MXU's 8-row sublane tile) collapses the prefill chunk (T=4)
+    # and the decode step (T=1) onto ONE signature: (8, 1, 1)
     paddle.seed(0)
     gen_model = GPTForCausalLM(cfg)
     gen_model.eval()
