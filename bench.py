@@ -985,6 +985,52 @@ def _serve_router_workload():
     }
 
 
+def _serve_load_workload():
+    """The OPEN-LOOP load stage behind `bench.py --serve`
+    (tools/load_harness.py, docs/OBSERVABILITY.md "The fleet
+    observatory"): a seeded deterministic trace — Poisson arrivals
+    with a 10x burst window, heavy-tailed lengths, tiered SLO mix —
+    drives a 2-engine disaggregated router open-loop (arrivals never
+    wait on completions, so the burst actually overloads admission).
+    Returns the harness summary: goodput tokens/s, per-class SLO
+    attainment, TTFT/TPOT percentiles, rejected/expired fractions,
+    peak in-flight, and the pressure-event count."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingRouter
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import load_harness as _lh
+
+    seed = int(os.environ.get("BENCH_SERVE_LOAD_SEED", "0"))
+    n_reqs = int(os.environ.get("BENCH_SERVE_LOAD_REQS", "16"))
+    rate = float(os.environ.get("BENCH_SERVE_LOAD_RATE", "4"))
+    max_new = int(os.environ.get("BENCH_SERVE_GEN_NEW", "6"))
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    trace = _lh.generate_trace(seed, n_reqs, rate_rps=rate,
+                               burst=(0.4, 0.7, 10.0),
+                               max_prompt=48, max_out=max_new,
+                               vocab=256)
+    # small admission queue on purpose: the 10x burst must actually
+    # reject at the front door, or the open-loop stage measures nothing
+    # the closed-loop stages don't
+    router = ServingRouter.disaggregated(
+        model, n_pages=128, page_size=8, max_batch=2, max_queue=4,
+        max_new_tokens=max_new, prefill_chunk=16, name="bench_load",
+        fleet_snapshot_s=0.5)
+    try:
+        summary = _lh.run_harness(router, trace, seed=seed,
+                                  drain_timeout_s=300.0)
+    finally:
+        router.shutdown()
+    return summary
+
+
 def _run_serve():
     """`bench.py --serve`: continuous-batching serving micro-benchmark
     (docs/SERVING.md). N concurrent closed-loop client threads drive one
@@ -1123,6 +1169,16 @@ def _run_serve():
             router = _serve_router_workload()
         except Exception as e:
             router = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    # open-loop load stage: seeded 10x-burst trace through a fresh
+    # disaggregated router (BENCH_SERVE_LOAD=0 skips; failures degrade
+    # to an error key, never a dead bench)
+    load = None
+    if os.environ.get("BENCH_SERVE_LOAD", "1") != "0":
+        _phase("load")
+        try:
+            load = _serve_load_workload()
+        except Exception as e:
+            load = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     _phase("done", serve_s=serve_s)
 
     lat.sort()
@@ -1172,7 +1228,14 @@ def _run_serve():
                 headline[k] = router[k]
     if gen is not None:
         headline["generate"] = gen
-    if gen is not None or router is not None:
+    if load is not None:
+        headline["load"] = load
+        for k in ("goodput_tokens_per_s", "rejected_fraction",
+                  "expired_fraction", "peak_in_flight",
+                  "pressure_events"):
+            if k in load:
+                headline[f"load_{k}"] = load[k]
+    if gen is not None or router is not None or load is not None:
         # serve trajectory ACROSS rounds (the compile_history twin):
         # bench_state.json keeps the last 10 rounds of the headline
         # serving numbers so a regression in pad fraction / prefix hit
@@ -1195,6 +1258,11 @@ def _run_serve():
                   "router_ttft_p50_ms", "router_ttft_p99_ms"):
             if router is not None and k in router:
                 entry[k] = router[k]
+        for k in ("goodput_tokens_per_s", "rejected_fraction",
+                  "expired_fraction", "peak_in_flight",
+                  "pressure_events", "ttft_p99_s"):
+            if load is not None and k in load:
+                entry[f"load_{k}"] = load[k]
         history.append(entry)
         state["serve_history"] = history[-10:]
         _save_state(state)

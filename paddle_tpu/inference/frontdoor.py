@@ -47,6 +47,7 @@ import threading
 
 import numpy as np
 
+from ..profiler import fleet_observatory as _fobs
 from ..profiler import monitor as _monitor
 from ..profiler import serve_observatory as _obs
 from .serving import (GenerationEngine, QueueFullError, EngineStopped,
@@ -87,7 +88,7 @@ class ServingRouter:
     convenience fan-outs."""
 
     def __init__(self, engines, roles=None, slo_classes=None,
-                 name="router"):
+                 name="router", fleet_snapshot_s=None):
         if not engines:
             raise ValueError("ServingRouter needs at least one engine")
         for eng in engines:
@@ -138,11 +139,17 @@ class ServingRouter:
                     "pools) and only the ragged scheduler adopts them")
             self._decoders_of[eng.name] = mates
             eng.set_handoff(self._handoff_dispatcher(eng))
+        # the fleet observatory: periodic kind:"fleet" snapshots +
+        # edge-triggered pressure events, driven opportunistically
+        # from submit (outside every lock — the monitor emits JSONL)
+        self._fleet_mon = _fobs.FleetMonitor(
+            self, interval_s=fleet_snapshot_s)
 
     # -- construction sugar ---------------------------------------------
     @staticmethod
     def disaggregated(model, n_pages=256, page_size=16, max_batch=8,
-                      prefill_batch=None, name="router", **engine_kw):
+                      prefill_batch=None, name="router",
+                      fleet_snapshot_s=None, **engine_kw):
         """A ready-made disaggregated pair over ONE shared page pool:
         a prefill-role engine (admission + chunked prefill) and a
         decode-role engine (adopted chains only), with `max_batch`
@@ -157,7 +164,7 @@ class ServingRouter:
             model, cache=cache, max_batch=max_batch,
             name=f"{name}_decode", **engine_kw)
         return ServingRouter([pre, dec], roles=("prefill", "decode"),
-                             name=name)
+                             name=name, fleet_snapshot_s=fleet_snapshot_s)
 
     # -- SLO classes -----------------------------------------------------
     def slo_class(self, deadline_ms):
@@ -205,6 +212,8 @@ class ServingRouter:
                 engine=ranked[0].name if ranked else "?", fleet=fleet,
                 outcome="rejected", slo_class=cls,
                 queue_depth=fleet_depth, deadline_ms=deadline_ms)
+            self._fleet_mon.note_rejection()
+            self._fleet_mon.maybe_snapshot()
             raise QueueFullError(
                 f"router {self.name!r}: all {len(candidates)} "
                 "submit-capable engines are saturated — shed load or "
@@ -219,6 +228,16 @@ class ServingRouter:
             except (QueueFullError, EngineStopped) as e:
                 last_exc = e  # load-shed THIS engine; try the next
                 continue
+            # the stable request identity: born at the prefill
+            # engine's submit (the trace id), stamped onto the handle
+            # so it rides the exported chain and the adopted
+            # decode-side trace — route record, both engine-side
+            # request records, and the journey all join on it
+            handle.request_id = getattr(handle.trace, "request_id",
+                                        None)
+            handle.router = self.name
+            if handle.trace is not None:
+                handle.trace.slo_class = cls
             affinity = affinity_of.get(eng.name, 0)
             with self._lock:
                 self._stats["dispatched"] += 1
@@ -236,7 +255,8 @@ class ServingRouter:
                 prefix_affinity=bool(affinity),
                 prefix_match_pages=int(affinity),
                 deadline_ms=deadline_ms,
-                request_id=getattr(handle.trace, "request_id", None))
+                request_id=handle.request_id)
+            self._fleet_mon.maybe_snapshot()
             return handle
         with self._lock:
             self._stats["rejected"] += 1
@@ -245,6 +265,8 @@ class ServingRouter:
             engine=ranked[0].name, fleet=fleet, outcome="rejected",
             slo_class=cls, queue_depth=fleet_depth,
             deadline_ms=deadline_ms)
+        self._fleet_mon.note_rejection()
+        self._fleet_mon.maybe_snapshot()
         raise last_exc if last_exc is not None else QueueFullError(
             f"router {self.name!r}: no engine admitted the request")
 

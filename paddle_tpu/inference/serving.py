@@ -988,6 +988,10 @@ class GenerationHandle:
         self.trace = None     # serve_observatory RequestTrace
         self.sampling = GREEDY  # SamplingParams (submit sampling=)
         self.key = None         # uint32[2] per-request base PRNG key
+        self.request_id = None  # router-stamped stable id: rides the
+        # handle, the exported KVChainHandle, and the adopted decode
+        # trace, so route + both request records + the journey join
+        self.router = None      # ServingRouter name (fleet telemetry)
 
     def _push(self, tok):
         with self._cv:
@@ -1444,14 +1448,48 @@ class GenerationEngine(_SchedulerLifecycle):
             # here would park it (and its pages + claim) forever
             raise ValueError(
                 "decode-role adoption needs the ragged engine path")
+        # split the request trace at the handoff boundary: the prefill
+        # trace closes with outcome "handoff", a fresh decode-side
+        # trace (SAME request_id, original t_submit — deadline math
+        # spans the whole request) rides the handle from here, and a
+        # fleet_observatory Journey joins the pair at decode-terminal
+        # time. Built BEFORE the enqueue (pure host arithmetic): once
+        # the entry is in _adopted the scheduler thread may finish the
+        # request at any moment, and it must finish the DECODE trace.
+        old_trace, new_trace, journey = handle.trace, None, None
+        if old_trace is not None:
+            from ..profiler import fleet_observatory as _fobs
+            new_trace = _obs.start_request(
+                self.name, prompt_tokens=old_trace.prompt_tokens,
+                max_new_tokens=old_trace.max_new_tokens,
+                deadline_s=old_trace.deadline_s)
+            new_trace.request_id = old_trace.request_id
+            new_trace.t_submit = old_trace.t_submit
+            new_trace.slo_class = old_trace.slo_class
+            new_trace.prefix_hit_tokens = old_trace.prefix_hit_tokens
+            new_trace.generated_tokens = len(generated)
+            new_trace.handoff_of = old_trace.engine
+            old_trace.handoff_of = self.name
+            journey = _fobs.Journey(
+                handle=handle, prefill_trace=old_trace,
+                decode_engine=self.name, chain=chain,
+                page_size=int(self.cache.page_size))
+            new_trace.journey = journey
         with self._cv:
             if self._stopping:
                 raise EngineStopped(
                     "decode engine is drained/shut down")
+            if new_trace is not None:
+                handle.trace = new_trace
             self._adopted.append(
                 (handle, chain, int(last_token), list(generated),
                  int(cached)))
             self._cv.notify_all()
+        # close the prefill half OUTSIDE _cv: finish() appends to the
+        # metrics JSONL, and file I/O must never run under the decode
+        # scheduler's condition lock
+        if old_trace is not None:
+            old_trace.finish("handoff")
 
     def _drain_adopted(self):
         """Move handed-off chains into the active decode set
@@ -1478,6 +1516,14 @@ class GenerationEngine(_SchedulerLifecycle):
             sid = self._new_sid()
             with self.cache.lock:
                 self.cache.adopt_chain(sid, chain)
+            trace = handle.trace
+            if trace is not None:
+                trace.admitted()  # decode-side admission boundary
+                if trace.journey is not None:
+                    # the MEASURED end of the handoff gap: the chain
+                    # is attached and the sequence joins the decode
+                    # batch at the next step
+                    trace.journey.adopted()
             seq = _ActiveSeq(sid, handle, chain.claim, cached=cached)
             seq.generated = list(generated)
             seq.last = last
@@ -1524,6 +1570,13 @@ class GenerationEngine(_SchedulerLifecycle):
         else:
             with self.cache.lock:
                 chain = self.cache.export_chain(seq.sid)
+            # journey riders, stamped AT the export site: the id that
+            # joins route + both request records, and the measured
+            # start of the handoff gap (the chain is not shared with
+            # the decode engine until _handoff_fn below)
+            chain.request_id = getattr(h.trace, "request_id", None) \
+                or h.request_id
+            chain.t_export = time.perf_counter()
             try:
                 # NOT holding any lock: the dispatcher enqueues on the
                 # decode engine (its _cv) and emits the route record
@@ -1968,8 +2021,11 @@ class GenerationEngine(_SchedulerLifecycle):
                 self._cv.notify_all()  # pages freed: admission may proceed
             return
         if h.trace is not None:
-            if not seq.generated:
-                h.trace.first_token()  # TTFT boundary
+            # idempotent: the TTFT boundary locally, and — for an
+            # ADOPTED sequence, whose fresh decode-side trace has no
+            # t_first yet even though seq.generated is non-empty — the
+            # first local decode step of the handoff pair
+            h.trace.first_token()
             h.trace.note_token(self.cache.pages_held(seq.sid))
         _monitor.counter("serve.generated_tokens").inc()
         seq.generated.append(tok)

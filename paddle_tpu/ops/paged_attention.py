@@ -130,7 +130,7 @@ class KVChainHandle:
     copies) or `release_chain`."""
 
     __slots__ = ("chain_id", "pages", "length", "drawn", "claim",
-                 "consumed")
+                 "consumed", "request_id", "t_export")
 
     def __init__(self, pages, length, drawn, claim):
         self.chain_id = next(_CHAIN_IDS)
@@ -139,6 +139,12 @@ class KVChainHandle:
         self.drawn = drawn
         self.claim = claim
         self.consumed = False
+        # journey telemetry riders (profiler/fleet_observatory.py): the
+        # originating request's id and the export timestamp, stamped by
+        # the prefill engine so the handoff gap is MEASURED at the
+        # export site, never inferred downstream
+        self.request_id = None
+        self.t_export = None
 
 
 class PagedKVCache:

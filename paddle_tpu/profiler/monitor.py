@@ -57,6 +57,34 @@ process `rank` (from the launch env), and a `kind`. TrainStep /
 HybridTrainStep call it once per optimizer step with the documented step
 schema (step, step_time_s, compile_s, cache_hit, peak_bytes, flops, mfu
 — validated by tools/check_metrics_schema.py); see docs/OBSERVABILITY.md.
+
+Record kinds riding the exporter (one line each; full field schemas in
+tools/check_metrics_schema.py):
+
+    step        one per optimizer step (TrainStep / HybridTrainStep)
+    scan        one per scanned-layer-group step (scan-over-layers path)
+    serve       one per dispatched serving batch (GenerationEngine)
+    health      one per resolved async health vector (health monitor)
+    event       structured anomaly/lifecycle events (flight recorder)
+    compile     one per AOT-compiled executable signature (aot_warmup)
+    warm        one per resolved warm set (aot_warmup manifests)
+    lint        one per static-analysis finding (tools/lint/paddlelint)
+    seed        one per compile-cache seeding (persistent cache)
+    ckpt        one per checkpoint save/restore/GC (checkpointing)
+    request     ONE per request at its terminal state (serve observatory;
+                outcome "handoff" closes the prefill half of a
+                disaggregated request, the decode half re-emits)
+    route       ONE per router decision: dispatch / reject / handoff
+    kvcache     periodic KV page-pool snapshot (serve observatory)
+    collective  sampled per-collective timing (dist observatory)
+    rankstat    periodic per-rank skew telemetry (dist observatory)
+    journey     ONE per handed-off request at decode-terminal time:
+                queue/prefill/handoff-gap/decode phase split
+                (profiler/fleet_observatory.py)
+    fleet       periodic router-level fleet snapshot: per-engine
+                rollup, shared-pool claims, rates, SLO attainment
+                (fleet observatory)
+    harness     ONE summary per tools/load_harness.py open-loop run
 """
 import collections
 import json

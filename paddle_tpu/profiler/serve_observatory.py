@@ -22,7 +22,12 @@ aggregates alone. Three pieces:
   Outcomes: ``completed`` (result delivered), ``expired`` (deadline
   passed before admission), ``rejected`` (queue-full / stopped-engine
   fast fail at submit), ``cancelled`` (caller cancel, or work shed by
-  `shutdown(wait=False)`), ``error`` (failed onto the future).
+  `shutdown(wait=False)`), ``error`` (failed onto the future),
+  ``handoff`` (prefill half of a disaggregated pair: the chain moved
+  to a decode engine, which opened a fresh trace under the SAME
+  request_id — `handoff_of` names the other engine on both records,
+  and profiler/fleet_observatory.py joins the pair into ONE
+  `kind:"journey"` record at the decode terminal).
 
 - **KV page-pool telemetry** — `record_pool_stats(engine, cache)`
   turns `PagedKVCache.pool_stats()` into a periodic `kind:"kvcache"`
@@ -57,7 +62,8 @@ __all__ = ["RequestTrace", "start_request", "record_pool_stats",
            "register_engine", "requests_tail", "slo_report",
            "debug_payload", "reset", "OUTCOMES", "REQUEST_RING"]
 
-OUTCOMES = ("completed", "expired", "rejected", "error", "cancelled")
+OUTCOMES = ("completed", "expired", "rejected", "error", "cancelled",
+            "handoff")
 
 REQUEST_RING = 512  # terminal request records kept for bundle tails
 
@@ -67,6 +73,9 @@ _requests = collections.deque(maxlen=REQUEST_RING)
 _outcomes = collections.Counter()
 # deadline-carrying requests only: outcome -> [met, total]
 _deadline_by_outcome = {}
+# deadline-carrying requests only: slo class -> [met, total] (the
+# router stamps `trace.slo_class`; engine-only traffic has no class)
+_deadline_by_class = {}
 _engines = collections.OrderedDict()  # name -> weakref(engine)
 MAX_ENGINES = 16
 
@@ -83,7 +92,8 @@ class RequestTrace:
     __slots__ = ("request_id", "engine", "rows", "prompt_tokens",
                  "max_new_tokens", "deadline_s", "prefix_hit_tokens",
                  "generated_tokens", "prefill_chunks", "peak_pages_held",
-                 "t_submit", "t_admit", "t_first", "done")
+                 "t_submit", "t_admit", "t_first", "done",
+                 "slo_class", "handoff_of", "journey")
 
     def __init__(self, engine, rows=1, prompt_tokens=0,
                  max_new_tokens=None, deadline_s=None):
@@ -101,6 +111,10 @@ class RequestTrace:
         self.t_admit = None
         self.t_first = None
         self.done = False
+        self.slo_class = None   # router-stamped SLO class name
+        self.handoff_of = None  # the OTHER engine of a handed-off pair
+        self.journey = None     # fleet_observatory.Journey (decode side
+        #                         of a handoff; emits at terminal)
 
     # -- lifecycle marks (engine loop; pure host arithmetic) -----------
     def admitted(self):
@@ -180,8 +194,19 @@ class RequestTrace:
         }
         if self.max_new_tokens is not None:
             rec["max_new_tokens"] = int(self.max_new_tokens)
+        if self.t_first is not None:
+            rec["ttft_s"] = round(max(self.t_first - self.t_submit,
+                                      0.0), 6)
+        if self.slo_class is not None:
+            rec["slo_class"] = str(self.slo_class)
+        if self.handoff_of is not None:
+            rec["handoff_of"] = str(self.handoff_of)
         met = None
-        if self.deadline_s is not None:
+        # outcome "handoff" is not a terminal state of the REQUEST —
+        # the decode-side trace (same request_id) carries the journey
+        # to its real outcome and does ALL the deadline/goodput
+        # accounting; counting the prefill half too would double-book
+        if self.deadline_s is not None and outcome != "handoff":
             met = outcome == "completed" and latency <= self.deadline_s
             rec["deadline_s"] = round(self.deadline_s, 6)
             rec["deadline_met"] = bool(met)
@@ -194,8 +219,13 @@ class RequestTrace:
                 bucket = _deadline_by_outcome.setdefault(outcome, [0, 0])
                 bucket[0] += 1 if met else 0
                 bucket[1] += 1
+                if self.slo_class is not None:
+                    cbucket = _deadline_by_class.setdefault(
+                        str(self.slo_class), [0, 0])
+                    cbucket[0] += 1 if met else 0
+                    cbucket[1] += 1
         gen = self.generated_tokens
-        if gen:
+        if gen and outcome != "handoff":
             if outcome == "completed":
                 _monitor.counter("serve.goodput_tokens").inc(gen)
             else:
@@ -207,6 +237,11 @@ class RequestTrace:
         _monitor.export_step(rec, kind="request")
         with _lock:
             _requests.append(rec)
+        if self.journey is not None:
+            try:  # the journey emits its own record; its failure must
+                self.journey.complete(rec)  # not lose the request rec
+            except Exception:
+                pass
         return rec
 
 
@@ -293,12 +328,19 @@ def slo_report():
     """Deadline attainment by outcome + the goodput/wasted token split:
     {"requests", "outcomes": {outcome: n}, "deadline": {"requests",
     "met", "attainment"}, "deadline_by_outcome": {outcome: {met,
-    total}}, "goodput_tokens", "wasted_tokens"}. `attainment` is None
-    until a deadline-carrying request finishes."""
+    total}}, "deadline_by_class": {slo class: {met, total,
+    attainment}}, "goodput_tokens", "wasted_tokens"}. `attainment` is
+    None until a deadline-carrying request finishes. A handed-off
+    request counts ONCE in the deadline/goodput aggregates (its
+    decode-side terminal), but its prefill half appears in `outcomes`
+    under "handoff"."""
     with _lock:
         outcomes = dict(_outcomes)
         by_outcome = {k: {"met": v[0], "total": v[1]}
                       for k, v in _deadline_by_outcome.items()}
+        by_class = {k: {"met": v[0], "total": v[1],
+                        "attainment": v[0] / v[1] if v[1] else None}
+                    for k, v in _deadline_by_class.items()}
     met = sum(v["met"] for v in by_outcome.values())
     total = sum(v["total"] for v in by_outcome.values())
     good = _monitor.get_metric("serve.goodput_tokens")
@@ -309,6 +351,7 @@ def slo_report():
         "deadline": {"requests": total, "met": met,
                      "attainment": (met / total) if total else None},
         "deadline_by_outcome": by_outcome,
+        "deadline_by_class": by_class,
         "goodput_tokens": int(good.value) if good else 0,
         "wasted_tokens": int(waste.value) if waste else 0,
     }
@@ -340,3 +383,4 @@ def reset():
         _requests.clear()
         _outcomes.clear()
         _deadline_by_outcome.clear()
+        _deadline_by_class.clear()

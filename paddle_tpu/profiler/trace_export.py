@@ -28,9 +28,15 @@ Track layout (what you see in Perfetto):
   record in its args) with queued/prefill/decode phase sub-slices
   nested inside; concurrent requests spread over a small fixed set of
   lanes so overlapping lifetimes stay readable;
+- a "routing" track with one instant slice per `kind:"route"` decision
+  (dispatch / reject / handoff, the record in its args); handoff
+  decisions additionally draw s/f flow arrows from the prefill
+  request's lane to the decode request's lane, joined on request_id —
+  the disaggregated handoff rendered as the arrow it is;
 - a counter track per metric (queue depth, prefetch depth, device
   memory, host.blocked_s, ...) plus `kv.<engine>.*` page-pool tracks
-  from `kind:"kvcache"` snapshots;
+  from `kind:"kvcache"` snapshots and `fleet.<router>.*` tracks from
+  `kind:"fleet"` snapshots;
 - instant markers for `kind:"event"` anomalies (NaN, loss spike,
   watchdog, ...).
 
@@ -47,7 +53,8 @@ from . import monitor
 
 __all__ = ["chrome_trace_events", "write_chrome_trace",
            "TRAIN_TID", "SERVE_TID", "EVENT_TID", "COMPILE_TID",
-           "REQUEST_TID", "REQUEST_LANES", "CKPT_TID", "COLLECTIVE_TID"]
+           "REQUEST_TID", "REQUEST_LANES", "CKPT_TID", "COLLECTIVE_TID",
+           "ROUTE_TID"]
 
 # synthetic track ids for record-derived events; real thread idents are
 # pointer-sized on linux, so small ints can never collide with them
@@ -61,6 +68,10 @@ CKPT_TID = 20       # "checkpoint" track (after the request lanes)
 COLLECTIVE_TID = 21  # "collectives" track (sampled kind:"collective"
                      # records — the cross-rank lane a merged,
                      # clock-aligned timeline lines up across pids)
+ROUTE_TID = 22       # "routing" track (kind:"route" decision slices;
+                     # handoff decisions additionally draw s/f flow
+                     # arrows from the prefill request lane to the
+                     # decode request lane, joined on request_id)
 
 
 def _sanitize(obj):
@@ -107,6 +118,8 @@ def chrome_trace_events(snap=None, rank=None):
         {"ph": "M", "name": "thread_name", "pid": pid,
          "tid": COLLECTIVE_TID, "ts": 0,
          "args": {"name": "collectives"}},
+        {"ph": "M", "name": "thread_name", "pid": pid,
+         "tid": ROUTE_TID, "ts": 0, "args": {"name": "routing"}},
     ]
     events = []
 
@@ -130,6 +143,7 @@ def chrome_trace_events(snap=None, rank=None):
     # exported records -> synthetic tracks; the record itself rides in
     # args so a slice click shows step/compile/mfu or batch/pad/latency
     request_recs = []  # (start_s, latency_s, record): laned below
+    handoff_routes = []  # handoff route records: flow arrows below
     for rec in snap.get("records", ()):
         kind = rec.get("kind")
         ts = float(rec.get("ts", 0.0))
@@ -179,6 +193,37 @@ def chrome_trace_events(snap=None, rank=None):
             if isinstance(lat, (int, float)) and not isinstance(lat, bool):
                 lat = max(float(lat), 0.0)
                 request_recs.append((ts - lat, lat, rec))
+        elif kind == "route":
+            # the routing track: one zero-duration slice per decision
+            # (the decision is an instant — its CONSEQUENCE is the
+            # request slice it points at), full record in args
+            outcome = rec.get("outcome", "?")
+            if outcome == "handoff":
+                name = (f"handoff {rec.get('from_engine', '?')}"
+                        f"→{rec.get('engine', '?')}")
+                handoff_routes.append(rec)
+            elif outcome == "rejected":
+                name = f"reject [{rec.get('slo_class', '?')}]"
+            else:
+                name = (f"dispatch {rec.get('engine', '?')} "
+                        f"[{rec.get('slo_class', '?')}]")
+            events.append({
+                "name": name, "ph": "X", "cat": "route",
+                "ts": ts * 1e6, "dur": 0.0, "pid": pid,
+                "tid": ROUTE_TID, "args": _sanitize(rec)})
+        elif kind == "fleet":
+            # fleet snapshots -> router-level counter tracks next to
+            # the per-engine kv.* series
+            router = rec.get("router", "router")
+            for key in ("queue_depth", "active", "admittable_pages",
+                        "outstanding_claims"):
+                v = rec.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    events.append({
+                        "name": f"fleet.{router}.{key}", "ph": "C",
+                        "cat": "fleet", "ts": ts * 1e6, "pid": pid,
+                        "tid": 0, "args": {"value": _sanitize(v)}})
         elif kind == "kvcache":
             # page-pool counter tracks, per engine (two engines' pools
             # must not interleave into one series)
@@ -269,6 +314,7 @@ def chrome_trace_events(snap=None, rank=None):
     # prefill -> decode) nest INSIDE their own request only
     lane_busy_until = []
     used_lanes = set()
+    req_slice = {}  # (engine, request_id) -> (tid, start_s, end_s)
     for start, lat, rec in sorted(request_recs, key=lambda r: r[0]):
         lane = next((i for i, end in enumerate(lane_busy_until)
                      if start >= end), None)
@@ -286,6 +332,10 @@ def chrome_trace_events(snap=None, rank=None):
         lane_busy_until[lane] = max(lane_busy_until[lane], start + lat)
         tid = REQUEST_TID + lane
         used_lanes.add(lane)
+        rid = rec.get("request_id")
+        if isinstance(rid, str) and rid:
+            req_slice[(rec.get("engine"), rid)] = (tid, start,
+                                                   start + lat)
         name = (f"{rec.get('engine', 'serve')} "
                 f"{rec.get('request_id', '?')} "
                 f"[{rec.get('outcome', '?')}]")
@@ -311,6 +361,30 @@ def chrome_trace_events(snap=None, rank=None):
             "tid": REQUEST_TID + lane, "ts": 0,
             "args": {"name": "serving requests" if lane == 0
                      else f"serving requests ({lane})"}})
+    # handoff flow arrows: prefill request lane -> decode request lane,
+    # joined on (engine, request_id). The start anchors at the prefill
+    # slice's END (where its trace closed with outcome "handoff"), the
+    # finish at the route decision's stamp inside the decode slice
+    # (clamped forward — an arrow must not point into the past). Arrows
+    # emit only as s/f PAIRS (both slices present), which is exactly
+    # what the trace lint enforces.
+    for i, rec in enumerate(handoff_routes):
+        rid = rec.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            continue
+        pre = req_slice.get((rec.get("from_engine"), rid))
+        dec = req_slice.get((rec.get("engine"), rid))
+        if pre is None or dec is None:
+            continue
+        t_start = pre[2]
+        t_finish = max(float(rec.get("ts", t_start)), t_start)
+        fid = f"handoff:{rid}:{i}"
+        flow = {"name": "handoff", "cat": "handoff", "id": fid,
+                "pid": pid}
+        events.append(dict(flow, ph="s", ts=t_start * 1e6,
+                           tid=pre[0]))
+        events.append(dict(flow, ph="f", bp="e", ts=t_finish * 1e6,
+                           tid=dec[0]))
     # structured anomalies: the events ring is their ONE home —
     # record_event rings them here and exports the JSONL line itself
     # (monitor.export_step _ring=False), so the records ring never

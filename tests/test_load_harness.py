@@ -1,0 +1,136 @@
+"""The open-loop load harness (tools/load_harness.py —
+docs/OBSERVABILITY.md "The fleet observatory").
+
+- the generated trace is deterministic per seed and honestly shaped:
+  non-decreasing arrivals, the burst window compressing inter-arrival
+  gaps, heavy-tailed lengths inside their clips, the tiered SLO mix
+- one real open-loop smoke: a 2-engine disaggregated router driven
+  through a 10x burst on CPU — the summary record is schema-valid,
+  the burst rejects (shed load, open-loop: arrivals never wait), at
+  least one pressure event fires, fleet snapshots ride the same
+  JSONL, and the submit-lateness honesty metric is reported
+
+slow tier: the smoke run spends real wall time decoding through the
+burst — nightly/full runs only (tier-1 runs tests/test_fleet_observatory.py
+instead, which covers the observatory surfaces without the load).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+from paddle_tpu.inference import ServingRouter
+
+pytestmark = [pytest.mark.heavy, pytest.mark.slow]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_metrics_schema as cms  # noqa: E402
+import load_harness as lh  # noqa: E402
+
+
+# -- the trace generator (cheap, but rides the slow module) --------------
+
+class TestGenerateTrace:
+    def test_same_seed_same_trace(self):
+        a = lh.generate_trace(7, 32)
+        b = lh.generate_trace(7, 32)
+        assert len(a) == len(b) == 32
+        for ra, rb in zip(a, b):
+            assert ra["t"] == rb["t"]
+            assert ra["prompt"].tolist() == rb["prompt"].tolist()
+            assert ra["max_new"] == rb["max_new"]
+            assert ra["slo_class"] == rb["slo_class"]
+            assert ra["deadline_ms"] == rb["deadline_ms"]
+        c = lh.generate_trace(8, 32)
+        assert [r["t"] for r in a] != [r["t"] for r in c]
+
+    def test_trace_shape_and_burst(self):
+        trace = lh.generate_trace(3, 200, rate_rps=4.0,
+                                  burst=(0.4, 0.7, 10.0),
+                                  max_prompt=48, max_out=8)
+        ts = [r["t"] for r in trace]
+        assert ts == sorted(ts)  # open-loop schedule, by arrival
+        tiers = {t[0]: t[1] for t in lh.SLO_TIERS}
+        for r in trace:
+            assert 1 <= r["prompt"].size <= 48
+            assert 1 <= r["max_new"] <= 8
+            assert r["slo_class"] in tiers
+            assert r["deadline_ms"] == tiers[r["slo_class"]]
+        # the 10x burst compresses inter-arrival gaps: mean gap inside
+        # the window is a small fraction of the mean outside
+        gaps = np.diff([0.0] + ts)
+        n = len(trace)
+        inside = [g for i, g in enumerate(gaps)
+                  if 0.4 <= i / (n - 1) < 0.7]
+        outside = [g for i, g in enumerate(gaps)
+                   if not 0.4 <= i / (n - 1) < 0.7]
+        assert np.mean(inside) < np.mean(outside) / 3
+        # every tier shows up at 200 draws
+        assert {r["slo_class"] for r in trace} == set(tiers)
+
+
+# -- the open-loop smoke -------------------------------------------------
+
+class TestOpenLoopSmoke:
+    def test_burst_run_reports_and_pressures(self, tmp_path,
+                                             monkeypatch):
+        mfile = tmp_path / "metrics.jsonl"
+        monkeypatch.setenv("PADDLE_TPU_METRICS_FILE", str(mfile))
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=64,
+                        dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        # a small queue bound so the 10x burst actually rejects: the
+        # open-loop schedule keeps arriving regardless
+        router = ServingRouter.disaggregated(
+            model, n_pages=64, page_size=8, max_batch=2,
+            max_new_tokens=4, max_queue=3, name="lh_smoke",
+            fleet_snapshot_s=0.5)
+        trace = lh.generate_trace(0, 14, rate_rps=6.0,
+                                  burst=(0.4, 0.7, 10.0), max_out=4)
+        try:
+            summary = lh.run_harness(router, trace, seed=0,
+                                     drain_timeout_s=300.0)
+        finally:
+            router.shutdown()
+
+        assert cms.validate_line(json.dumps(summary)) == []
+        assert summary["router"] == "lh_smoke"
+        assert summary["seed"] == 0
+        assert summary["requests"] == 14
+        assert summary["completed"] >= 1
+        assert summary["peak_in_flight"] >= 1
+        # the burst overruns the queue bound: load sheds at the door
+        assert summary["rejected_fraction"] > 0
+        assert summary["completed"] + round(
+            summary["rejected_fraction"] * 14) <= 14
+        # ...and the rejection cluster (or sustained saturation) fired
+        # at least one edge-triggered pressure event
+        assert summary["pressure_events"] >= 1
+        # the before/during/after split covers every offered request
+        phases = summary["phases"]
+        assert set(phases) == {"before", "burst", "after"}
+        assert sum(p["requests"] for p in phases.values()) == 14
+        assert phases["burst"]["rejected"] >= 1
+        # open-loop honesty: the harness reports how far IT fell
+        # behind its own schedule
+        assert summary["submit_lateness_p99_s"] >= 0.0
+
+        lines = [json.loads(l) for l in
+                 mfile.read_text().splitlines() if l.strip()]
+        fleets = [r for r in lines if r.get("kind") == "fleet"]
+        assert fleets, "the run must emit fleet snapshots"
+        errs = [e for r in fleets
+                for e in cms.validate_line(json.dumps(r))]
+        assert errs == []
+        assert [r for r in lines if r.get("kind") == "harness"]
+        # the run's rejections are visible in the router's own stats
+        # on the closing snapshot
+        assert fleets[-1]["rejected"] >= 1

@@ -276,7 +276,8 @@ def emit_workload():
     gen.shutdown()
     router.submit(np.array([1, 2, 3, 4]), max_new_tokens=3,
                   deadline_ms=120_000).result(120)
-    router.shutdown()
+    router._fleet_mon.snapshot()  # force ONE kind:"fleet" record: the
+    router.shutdown()             # cadence (5 s) never fires in-gate
     steady = cobs.ledger_signatures()
     if steady != warmed:
         raise AssertionError(
@@ -308,24 +309,47 @@ def emit_workload():
     for r in reqs:
         by_engine.setdefault(r["engine"], []).append(r)
     # the router request's trace is born at the PREFILL engine's submit
+    # and SPLITS at the handoff: the prefill half closes with outcome
+    # "handoff", the decode half carries the request to its terminal —
+    # four records, one per engine, same request_id on the router pair
     if sorted(by_engine) != ["canonical", "canonical_gen",
+                             "canonical_router_decode",
                              "canonical_router_prefill"] or \
             any(len(v) != 1 for v in by_engine.values()):
         raise AssertionError(
-            "expected exactly one request record per submitted request "
-            f"(one per engine), got {[(k, len(v)) for k, v in sorted(by_engine.items())]}")
-    if any(r["outcome"] != "completed" for r in reqs):
+            "expected exactly one request record per engine "
+            f"(prefill+decode halves split), got "
+            f"{[(k, len(v)) for k, v in sorted(by_engine.items())]}")
+    pre_rec = by_engine["canonical_router_prefill"][0]
+    dec_rec = by_engine["canonical_router_decode"][0]
+    if pre_rec["outcome"] != "handoff" or \
+            pre_rec.get("handoff_of") != "canonical_router_decode" or \
+            dec_rec.get("handoff_of") != "canonical_router_prefill" or \
+            pre_rec["request_id"] != dec_rec["request_id"]:
+        raise AssertionError(
+            "the disaggregated pair must cross-name each other via "
+            "handoff_of under ONE request_id: "
+            f"prefill {pre_rec}, decode {dec_rec}")
+    if any(r["outcome"] != "completed" for r in reqs
+           if r["outcome"] != "handoff"):
         raise AssertionError(
             f"canonical requests must complete, got "
             f"{[(r['engine'], r['outcome']) for r in reqs]}")
     gen_total = _pmon.get_metric("serve.generated_tokens")
     gen_total = int(gen_total.value) if gen_total else 0
-    rec_total = sum(r["generated_tokens"] for r in reqs)
+    # terminal records only: the handoff half's tokens are re-counted
+    # by the decode half (seeded at adoption)
+    rec_total = sum(r["generated_tokens"] for r in reqs
+                    if r["outcome"] == "completed")
     if rec_total != gen_total or rec_total != 6:  # 2 x max_new_tokens=3
         raise AssertionError(
             "request-record token counts do not reconcile with the "
             f"engine counters: records {rec_total}, "
             f"serve.generated_tokens {gen_total}, expected 6")
+    if pre_rec["generated_tokens"] != 1:
+        raise AssertionError(
+            "the prefill half streams exactly its first token before "
+            f"handing off, got {pre_rec['generated_tokens']}")
     kv_engines = {r["engine"] for r in kvs}
     if not kvs or "canonical_gen" not in kv_engines:
         raise AssertionError(
@@ -348,6 +372,42 @@ def emit_workload():
         raise AssertionError(
             f"handoff record does not match the canonical request: "
             f"{hoffs}")
+
+    # the fleet-observatory contract: the one handed-off request lands
+    # EXACTLY ONE schema-valid kind:"journey" record joining the route
+    # decision and both request records under one request_id, with the
+    # handoff gap MEASURED (export stamp -> adopt stamp, >= 0), and the
+    # forced pre-shutdown snapshot emitted >= 1 schema-valid
+    # kind:"fleet" record — all in the same ledger the gates read
+    journeys = _load_kind(mfile, "journey")
+    fleets = _load_kind(mfile, "fleet")
+    errs = [e for r in journeys + fleets
+            for e in _cms.validate_line(_json.dumps(r))]
+    if errs:
+        raise AssertionError(
+            f"fleet-observatory records violate the schema: {errs[:5]}")
+    if len(journeys) != 1:
+        raise AssertionError(
+            "expected exactly one kind:'journey' record for the one "
+            f"handed-off request, got {len(journeys)}")
+    j = journeys[0]
+    if j["request_id"] != pre_rec["request_id"] or \
+            j["request_id"] != hoffs[0].get("request_id") or \
+            j["prefill_engine"] != "canonical_router_prefill" or \
+            j["decode_engine"] != "canonical_router_decode":
+        raise AssertionError(
+            "the journey must join the route decision and both request "
+            f"records under one request_id: {j}")
+    if j["handoff_gap_s"] < 0 or j["outcome"] != "completed" or \
+            j["generated_tokens"] != 3 or j["chain_tokens"] != 4:
+        raise AssertionError(
+            f"journey accounting does not match the canonical "
+            f"request: {j}")
+    if not fleets or any(r["router"] != "canonical_router"
+                         for r in fleets):
+        raise AssertionError(
+            f"expected >= 1 kind:'fleet' snapshot from "
+            f"canonical_router, got {fleets[:3]}")
 
     # the distributed-observatory contract: the canonical workload must
     # land ≥1 schema-valid kind:"collective" record (an eager

@@ -229,7 +229,13 @@ Schema (documented in docs/OBSERVABILITY.md):
                   engine       str     emitting engine (non-empty)
                   request_id   str     unique per request (non-empty)
                   outcome      str     completed | expired | rejected |
-                                       error | cancelled
+                                       error | cancelled | handoff
+                                       (handoff = the prefill half of a
+                                       disaggregated request; the decode
+                                       engine opens a fresh record under
+                                       the SAME request_id and the fleet
+                                       observatory joins the pair into
+                                       one kind:"journey" record)
                   rows         int     batch rows (>= 1; generation: 1)
                   prompt_tokens int    >= 0 (inference requests: 0)
                   prefix_hit_tokens int  >= 0, <= prompt_tokens
@@ -250,6 +256,14 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        0 = already expired at submit)
                   deadline_met bool    completed within deadline_s
                   error        str     exception repr (outcome error)
+                  ttft_s       number  >= 0 submit -> first token
+                  slo_class    str     non-empty (router-stamped)
+                  handoff_of   str     non-empty; the OTHER engine of a
+                                       disaggregated pair (on the
+                                       prefill record: the decode
+                                       engine, and vice versa) — how
+                                       tools/obs_report.py reconciles
+                                       the pair's token counts
   kind == "route" (ONE record per routing decision — the serving
                   front door, paddle_tpu/inference/frontdoor.py
                   ServingRouter) additionally requires:
@@ -302,6 +316,87 @@ Schema (documented in docs/OBSERVABILITY.md):
                   refcounts    dict    {refcount: n_pages >= 0}
                   page_size / prefix_nodes / sequences / queue_depth /
                   active       int     >= 0 (page_size >= 1)
+  kind == "journey" (ONE record per handed-off request at its
+                  decode-side terminal — the fleet observatory,
+                  profiler/fleet_observatory.py, joins the prefill and
+                  decode request records) additionally requires:
+                  request_id   str     non-empty; matches BOTH engine
+                                       request records and the handoff
+                                       route record
+                  prefill_engine str   non-empty
+                  decode_engine str    non-empty, != prefill_engine (a
+                                       self-journey means the handoff
+                                       never left the engine)
+                  slo_class    str     interactive | standard | batch
+                  outcome      str     completed | expired | error |
+                                       cancelled (never rejected — a
+                                       rejected request has no journey
+                                       — and never handoff, which is
+                                       not terminal)
+                  prompt_tokens int    >= 0
+                  generated_tokens int >= 0 (decode-side total,
+                                       including the prefill engine's
+                                       first streamed token)
+                  pages_moved  int     >= 1; == ceil(chain_tokens /
+                                       page_size) — same reconciliation
+                                       as the handoff route record
+                  chain_tokens int     >= 1
+                  page_size    int     >= 1
+                  queue_s      number  >= 0 submit -> prefill admit
+                  prefill_s    number  >= 0 admit -> chain export
+                  handoff_gap_s number >= 0 chain export -> decode
+                                       adoption (MEASURED at both ends,
+                                       never inferred)
+                  decode_s     number  >= 0 adoption -> terminal
+                  latency_s    number  >= 0; >= the four phases' sum
+                                       up to rounding (the boundaries
+                                       telescope)
+                  and optionally:
+                  ttft_s       number  >= 0 submit -> the PREFILL
+                                       engine's first streamed token
+                  router       str     non-empty
+                  deadline_s   number  >= 0
+                  deadline_met bool    completed within deadline_s
+  kind == "fleet" (periodic router-level fleet snapshot —
+                  profiler/fleet_observatory.py FleetMonitor over
+                  ServingRouter.load_report) additionally requires:
+                  router       str     non-empty
+                  fleet        list    engine names (non-empty strings)
+                  n_engines    int     >= 1
+                  n_pools      int     >= 1, <= n_engines (shared pools
+                                       deduplicated)
+                  queue_depth / active / slots_free int >= 0 (fleet
+                                       totals)
+                  admittable_pages / free_pages int >= 0
+                  outstanding_claims int >= 0 admission claims over
+                                       unique pools
+                  saturated    list    subset of fleet
+                  engines      dict    per-engine rollup; keys must be
+                                       a subset of fleet
+                  window_s     number  >= 0 seconds since the previous
+                                       snapshot (0 on the first)
+                  arrival_rate / completion_rate / handoff_rate /
+                  rejection_rate number >= 0 per-second over window_s
+                                       (0 on the first snapshot)
+                  slo_attainment dict  {class: fraction in [0, 1]}
+                  requests / dispatched / rejected / handoffs int >= 0
+                                       cumulative router counters
+  kind == "harness" (ONE summary record per tools/load_harness.py
+                  open-loop run) additionally requires:
+                  router       str     non-empty
+                  seed         int     the trace's RNG seed
+                  requests     int     >= 1 requests in the trace
+                  duration_s   number  >= 0 wall seconds of the run
+                  goodput_tokens_per_s number >= 0 (deadline-met
+                                       tokens only)
+                  rejected_fraction / expired_fraction number in [0, 1]
+                  peak_in_flight int   >= 0
+                  ttft_p50_s / ttft_p99_s / tpot_p50_s / tpot_p99_s
+                               number  >= 0 (p99 >= p50 up to rounding)
+                  and optionally:
+                  attainment_by_class dict {class: fraction in [0, 1]}
+                  phases       dict    per-phase (before/burst/after)
+                                       sub-summaries
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
@@ -311,7 +406,9 @@ A FILE whose content is a Chrome trace JSON (an object with a
 `tools/merge_traces.py` output) is validated as a trace instead:
 strictly-parsing JSON (no bare NaN/Infinity tokens), every event a dict
 with a `ph`, numeric `ts` (and `dur` for complete "X" events),
-non-decreasing ts per (pid, tid) track, and matched B/E begin/end pairs.
+non-decreasing ts per (pid, tid) track, matched B/E begin/end pairs, and
+matched s/f flow-arrow pairs per flow id (the routing track's handoff
+arrows — a dangling start or finish is a broken join).
 
 Usage: python tools/check_metrics_schema.py FILE [FILE...]
 Exit 0 when every line of every file validates, 1 otherwise.
@@ -369,12 +466,47 @@ REQUEST_REQUIRED = {"engine": str, "request_id": str, "outcome": str,
                     "prefix_hit_tokens": int, "generated_tokens": int,
                     "queue_s": (int, float), "latency_s": (int, float)}
 REQUEST_OUTCOMES = {"completed", "expired", "rejected", "error",
-                    "cancelled"}
+                    "cancelled", "handoff"}
 ROUTE_REQUIRED = {"engine": str, "fleet": list, "outcome": str,
                   "slo_class": str, "queue_depth": int}
 ROUTE_OUTCOMES = {"dispatched", "rejected", "handoff"}
 ROUTE_HANDOFF_REQUIRED = {"from_engine": str, "pages_moved": int,
                           "chain_tokens": int, "page_size": int}
+JOURNEY_REQUIRED = {"request_id": str, "prefill_engine": str,
+                    "decode_engine": str, "slo_class": str,
+                    "outcome": str, "prompt_tokens": int,
+                    "generated_tokens": int, "pages_moved": int,
+                    "chain_tokens": int, "page_size": int,
+                    "queue_s": (int, float), "prefill_s": (int, float),
+                    "handoff_gap_s": (int, float),
+                    "decode_s": (int, float),
+                    "latency_s": (int, float)}
+# terminal decode-side outcomes only: "rejected" dies before any
+# handoff and "handoff" itself is never terminal
+JOURNEY_OUTCOMES = {"completed", "expired", "error", "cancelled"}
+SLO_CLASSES = {"interactive", "standard", "batch"}
+FLEET_REQUIRED = {"router": str, "fleet": list, "n_engines": int,
+                  "n_pools": int, "queue_depth": int, "active": int,
+                  "slots_free": int, "admittable_pages": int,
+                  "free_pages": int, "outstanding_claims": int,
+                  "saturated": list, "engines": dict,
+                  "window_s": (int, float),
+                  "arrival_rate": (int, float),
+                  "completion_rate": (int, float),
+                  "handoff_rate": (int, float),
+                  "rejection_rate": (int, float),
+                  "slo_attainment": dict, "requests": int,
+                  "dispatched": int, "rejected": int, "handoffs": int}
+HARNESS_REQUIRED = {"router": str, "seed": int, "requests": int,
+                    "duration_s": (int, float),
+                    "goodput_tokens_per_s": (int, float),
+                    "rejected_fraction": (int, float),
+                    "expired_fraction": (int, float),
+                    "peak_in_flight": int,
+                    "ttft_p50_s": (int, float),
+                    "ttft_p99_s": (int, float),
+                    "tpot_p50_s": (int, float),
+                    "tpot_p99_s": (int, float)}
 KVCACHE_REQUIRED = {"engine": str, "n_pages": int, "free_pages": int,
                     "held_pages": int, "shared_pages": int,
                     "registered_pages": int, "pages_drawn": int,
@@ -639,10 +771,21 @@ def validate_line(line, where="<line>"):
             if v is not None and v < 0:
                 errors.append(f"{where}: {key} must be >= 0, got {v}")
         for key in ("queue_s", "prefill_s", "decode_s", "latency_s",
-                    "deadline_s"):
+                    "deadline_s", "ttft_s"):
             v = _rnum(key) if key in rec else None
             if v is not None and v < 0:
                 errors.append(f"{where}: {key} must be >= 0, got {v}")
+        for key in ("slo_class", "handoff_of"):
+            if key in rec and (not isinstance(rec[key], str)
+                               or not rec[key]):
+                errors.append(
+                    f"{where}: {key} must be a non-empty string, got "
+                    f"{rec[key]!r}")
+        if outcome == "handoff" and "handoff_of" not in rec:
+            errors.append(
+                f"{where}: outcome 'handoff' without handoff_of — the "
+                "prefill half of a disaggregated pair must name its "
+                "decode engine or the journey join is impossible")
         # cross-field: token counts must be consistent with the outcome
         hit, prompt = _rint("prefix_hit_tokens"), _rint("prompt_tokens")
         if hit is not None and prompt is not None and hit > prompt:
@@ -760,6 +903,168 @@ def validate_line(line, where="<line>"):
                 errors.append(
                     f"{where}: {key} must be a non-empty string, got "
                     f"{rec[key]!r}")
+    elif rec.get("kind") == "journey":
+        _check_types(rec, JOURNEY_REQUIRED, where, errors)
+        for key in ("request_id", "prefill_engine", "decode_engine"):
+            if isinstance(rec.get(key), str) and not rec[key]:
+                errors.append(f"{where}: {key} must be non-empty")
+        pe, de = rec.get("prefill_engine"), rec.get("decode_engine")
+        if isinstance(pe, str) and isinstance(de, str) and pe \
+                and pe == de:
+            errors.append(
+                f"{where}: prefill_engine == decode_engine ({pe!r}) — "
+                "a journey exists BECAUSE the request crossed engines")
+        cls = rec.get("slo_class")
+        if isinstance(cls, str) and cls not in SLO_CLASSES:
+            errors.append(
+                f"{where}: slo_class {cls!r} not one of "
+                f"{sorted(SLO_CLASSES)}")
+        outcome = rec.get("outcome")
+        if isinstance(outcome, str) and outcome not in JOURNEY_OUTCOMES:
+            errors.append(
+                f"{where}: journey outcome {outcome!r} not one of "
+                f"{sorted(JOURNEY_OUTCOMES)} — rejected requests have "
+                "no journey and 'handoff' is not terminal")
+        for key in ("prompt_tokens", "generated_tokens"):
+            v = _int_val(rec, key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        moved = _int_val(rec, "pages_moved")
+        toks = _int_val(rec, "chain_tokens")
+        psize = _int_val(rec, "page_size")
+        for key, v in (("pages_moved", moved), ("chain_tokens", toks),
+                       ("page_size", psize)):
+            if v is not None and v < 1:
+                errors.append(f"{where}: {key} must be >= 1, got {v}")
+        if None not in (moved, toks, psize) and psize >= 1 and \
+                moved != -(-toks // psize):
+            errors.append(
+                f"{where}: pages_moved {moved} != ceil(chain_tokens "
+                f"{toks} / page_size {psize}) — the journey's page "
+                "count does not reconcile with the tokens it moved")
+        for key in ("queue_s", "prefill_s", "handoff_gap_s", "decode_s",
+                    "latency_s", "ttft_s", "deadline_s"):
+            v = _num_val(rec, key) if key in rec else None
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        lat = _num_val(rec, "latency_s")
+        phases = [_num_val(rec, k) for k in
+                  ("queue_s", "prefill_s", "handoff_gap_s", "decode_s")]
+        if lat is not None and all(p is not None for p in phases) and \
+                sum(phases) > lat + 1e-3:
+            errors.append(
+                f"{where}: phase seconds {sum(phases):.6f} exceed "
+                f"latency_s {lat} — the journey's boundary stamps must "
+                "telescope")
+        if "deadline_met" in rec and not isinstance(
+                rec["deadline_met"], bool):
+            errors.append(
+                f"{where}: deadline_met must be bool, got "
+                f"{rec['deadline_met']!r}")
+        if "router" in rec and (not isinstance(rec["router"], str)
+                                or not rec["router"]):
+            errors.append(
+                f"{where}: router must be a non-empty string, got "
+                f"{rec['router']!r}")
+    elif rec.get("kind") == "fleet":
+        _check_types(rec, FLEET_REQUIRED, where, errors)
+        if isinstance(rec.get("router"), str) and not rec["router"]:
+            errors.append(f"{where}: router must be non-empty")
+        fleet = rec.get("fleet")
+        fleet_ok = isinstance(fleet, list) and fleet and \
+            all(isinstance(n, str) and n for n in fleet)
+        if isinstance(fleet, list) and not fleet_ok:
+            errors.append(
+                f"{where}: fleet must be a non-empty list of non-empty "
+                f"engine names, got {fleet!r}")
+        for key in ("n_engines", "n_pools"):
+            v = _int_val(rec, key)
+            if v is not None and v < 1:
+                errors.append(f"{where}: {key} must be >= 1, got {v}")
+        ne, np_ = _int_val(rec, "n_engines"), _int_val(rec, "n_pools")
+        if None not in (ne, np_) and np_ > ne:
+            errors.append(
+                f"{where}: n_pools {np_} > n_engines {ne} — pools are "
+                "shared across engines, never multiplied")
+        for key in ("queue_depth", "active", "slots_free",
+                    "admittable_pages", "free_pages",
+                    "outstanding_claims", "requests", "dispatched",
+                    "rejected", "handoffs"):
+            v = _int_val(rec, key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        for key in ("window_s", "arrival_rate", "completion_rate",
+                    "handoff_rate", "rejection_rate"):
+            v = _num_val(rec, key)
+            if v is not None and (v < 0 or math.isinf(v)
+                                  or math.isnan(v)):
+                errors.append(
+                    f"{where}: {key} must be finite and >= 0, got {v}")
+        if fleet_ok:
+            sat = rec.get("saturated")
+            if isinstance(sat, list):
+                extra = [n for n in sat if n not in fleet]
+                if extra:
+                    errors.append(
+                        f"{where}: saturated engines {extra} not in "
+                        f"fleet {fleet}")
+            engines = rec.get("engines")
+            if isinstance(engines, dict):
+                extra = [n for n in engines if n not in fleet]
+                if extra:
+                    errors.append(
+                        f"{where}: engines keys {extra} not in fleet "
+                        f"{fleet} — the rollup reports engines the "
+                        "router does not own")
+        attain = rec.get("slo_attainment")
+        if isinstance(attain, dict):
+            for cls, v in attain.items():
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or not 0 <= v <= 1:
+                    errors.append(
+                        f"{where}: slo_attainment[{cls!r}] must be in "
+                        f"[0, 1], got {v!r}")
+    elif rec.get("kind") == "harness":
+        _check_types(rec, HARNESS_REQUIRED, where, errors)
+        if isinstance(rec.get("router"), str) and not rec["router"]:
+            errors.append(f"{where}: router must be non-empty")
+        v = _int_val(rec, "requests")
+        if v is not None and v < 1:
+            errors.append(f"{where}: requests must be >= 1, got {v}")
+        v = _int_val(rec, "peak_in_flight")
+        if v is not None and v < 0:
+            errors.append(
+                f"{where}: peak_in_flight must be >= 0, got {v}")
+        for key in ("duration_s", "goodput_tokens_per_s", "ttft_p50_s",
+                    "ttft_p99_s", "tpot_p50_s", "tpot_p99_s"):
+            v = _num_val(rec, key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        for key in ("rejected_fraction", "expired_fraction"):
+            v = _num_val(rec, key)
+            if v is not None and not 0 <= v <= 1:
+                errors.append(
+                    f"{where}: {key} must be in [0, 1], got {v}")
+        for lo, hi in (("ttft_p50_s", "ttft_p99_s"),
+                       ("tpot_p50_s", "tpot_p99_s")):
+            a, b = _num_val(rec, lo), _num_val(rec, hi)
+            if None not in (a, b) and b + 1e-9 < a:
+                errors.append(
+                    f"{where}: {hi} {b} < {lo} {a} — percentiles must "
+                    "be ordered")
+        if "attainment_by_class" in rec:
+            abc = rec["attainment_by_class"]
+            if not isinstance(abc, dict):
+                errors.append(
+                    f"{where}: attainment_by_class must be a dict, got "
+                    f"{type(abc).__name__}")
+            else:
+                for cls, v in abc.items():
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool) or not 0 <= v <= 1:
+                        errors.append(
+                            f"{where}: attainment_by_class[{cls!r}] "
+                            f"must be in [0, 1], got {v!r}")
     elif rec.get("kind") == "kvcache":
         _check_types(rec, KVCACHE_REQUIRED, where, errors)
 
@@ -1002,6 +1307,8 @@ def validate_trace(path, text=None):
         return [f"{path}: empty trace (no events)"]
     last_ts = {}     # (pid, tid) -> last non-meta ts
     open_b = {}      # (pid, tid) -> count of unmatched B events
+    flow_s = {}      # flow id -> count of "s" starts
+    flow_f = {}      # flow id -> count of "f" finishes
     for i, e in enumerate(events):
         where = f"{path}: event {i}"
         if not isinstance(e, dict):
@@ -1032,6 +1339,15 @@ def validate_trace(path, text=None):
                               f"track {key}")
             else:
                 open_b[key] -= 1
+        elif ph in ("s", "t", "f"):
+            fid = e.get("id")
+            if fid is None:
+                errors.append(f"{where}: flow event ph={ph!r} "
+                              "missing id")
+            elif ph == "s":
+                flow_s[fid] = flow_s.get(fid, 0) + 1
+            elif ph == "f":
+                flow_f[fid] = flow_f.get(fid, 0) + 1
         if key in last_ts and ts < last_ts[key]:
             errors.append(
                 f"{where}: ts {ts} < previous {last_ts[key]} on track "
@@ -1041,6 +1357,14 @@ def validate_trace(path, text=None):
         if n:
             errors.append(f"{path}: {n} unmatched B event(s) on track "
                           f"{key}")
+    # flow arrows pair per id: a dangling start never lands and a
+    # dangling finish came from nowhere — both mean a broken join
+    for fid in sorted(set(flow_s) | set(flow_f), key=str):
+        ns, nf = flow_s.get(fid, 0), flow_f.get(fid, 0)
+        if ns != nf:
+            errors.append(
+                f"{path}: flow id {fid!r} has {ns} start(s) but {nf} "
+                "finish(es) — s/f arrows must pair")
     return errors
 
 

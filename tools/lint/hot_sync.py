@@ -104,6 +104,10 @@ HOT_REGIONS = {
     # probe's two deliberate syncs live in jit/api.py, fenced +
     # allowlisted there, NOT here)
     "paddle_tpu/profiler/dist_observatory.py": ["*"],
+    # the fleet observatory: journeys complete on the decode
+    # scheduler's emit path and fleet snapshots run on submit — the
+    # whole module must stay pure host arithmetic (no device reads)
+    "paddle_tpu/profiler/fleet_observatory.py": ["*"],
     # eager collectives are host-visible waits by design, but the
     # instrumentation AROUND them must never add a sync of its own
     "paddle_tpu/distributed/collective.py": [

@@ -6,6 +6,9 @@ PADDLE_TPU_METRICS_FILE export — docs/OBSERVABILITY.md): training step
 rollup (+ measured device time when the probe sampled), the compile
 ledger per executable, the serving SLO/goodput rollup, the front-door
 routing section (per-engine placements, handoffs, fleet SLO), the
+cross-engine journey section (kind:"journey" phase splits + the
+journey-vs-request-pair token reconciliation), the fleet snapshot /
+load-harness section, the
 distributed
 observatory's collective top-k by wall time and per-rank skew table,
 every anomaly event (stragglers, spikes, retraces, NaNs) in order, and
@@ -114,7 +117,11 @@ def section_serve(recs, out):
     for r in reqs:
         outcomes[r.get("outcome", "?")] = \
             outcomes.get(r.get("outcome", "?"), 0) + 1
-    gen = sum(int(r.get("generated_tokens", 0)) for r in reqs)
+    # a "handoff" record is the NON-terminal prefill half of a
+    # disaggregated pair — its tokens are re-counted by the decode-side
+    # record (seeded at adoption), so it stays out of the token math
+    gen = sum(int(r.get("generated_tokens", 0)) for r in reqs
+              if r.get("outcome") != "handoff")
     good = sum(int(r.get("generated_tokens", 0)) for r in reqs
                if r.get("outcome") == "completed")
     dl = [r for r in reqs if "deadline_met" in r]
@@ -188,6 +195,125 @@ def section_routing(recs, out):
                         for k, b in sorted(by_eng.items()))
         out.append(f"  fleet slo: {met}/{total} "
                    f"({met / total:.3f})  [{per}]")
+    out.append("")
+
+
+def section_journeys(recs, out):
+    """Cross-engine request journeys (kind:"journey" — the fleet
+    observatory, profiler/fleet_observatory.py): the phase split of
+    every handed-off request, per prefill->decode pair, plus the
+    reconciliation of each journey against its TWO request records
+    (joined on request_id, cross-named by handoff_of) — a pair whose
+    token counts disagree means the adoption seeding lied."""
+    js = [r for r in recs if r.get("kind") == "journey"]
+    if not js:
+        return
+    gaps = sorted(float(r.get("handoff_gap_s", 0.0)) for r in js)
+    lats = sorted(float(r.get("latency_s", 0.0)) for r in js)
+    out.append(f"== journeys ==  ({len(js)} handed-off requests)")
+    out.append(f"  latency p50 {_fmt_s(_pct(lats, 50))}  "
+               f"p99 {_fmt_s(_pct(lats, 99))}  handoff gap p50 "
+               f"{_fmt_s(_pct(gaps, 50))}  p99 {_fmt_s(_pct(gaps, 99))}")
+    for key in ("queue_s", "prefill_s", "handoff_gap_s", "decode_s"):
+        vals = sorted(float(r.get(key, 0.0)) for r in js)
+        out.append(f"  {key:<14} p50 {_fmt_s(_pct(vals, 50))}")
+    pairs = {}
+    for r in js:
+        key = (r.get("prefill_engine", "?"), r.get("decode_engine", "?"))
+        p = pairs.setdefault(key, {"n": 0, "pages": 0, "met": 0,
+                                   "dl": 0})
+        p["n"] += 1
+        p["pages"] += int(r.get("pages_moved", 0))
+        if "deadline_met" in r:
+            p["dl"] += 1
+            p["met"] += 1 if r.get("deadline_met") else 0
+    for (src, dst), p in sorted(pairs.items()):
+        slo = f"  slo {p['met']}/{p['dl']}" if p["dl"] else ""
+        out.append(f"  {src} -> {dst}: x{p['n']}  "
+                   f"{p['pages']} pages{slo}")
+    # pair reconciliation: journey vs its two request records
+    by_rid = {}
+    for r in recs:
+        if r.get("kind") == "request" and r.get("request_id"):
+            by_rid.setdefault(r["request_id"], []).append(r)
+    ok, bad = 0, []
+    for j in js:
+        rid = j.get("request_id")
+        sides = by_rid.get(rid, [])
+        pre = [r for r in sides if r.get("outcome") == "handoff"
+               and r.get("engine") == j.get("prefill_engine")]
+        dec = [r for r in sides if r.get("outcome") != "handoff"
+               and r.get("engine") == j.get("decode_engine")]
+        if len(pre) != 1 or len(dec) != 1:
+            bad.append(f"{rid}: {len(pre)} prefill / {len(dec)} decode "
+                       "record(s), expected 1+1")
+            continue
+        p, d = pre[0], dec[0]
+        pgen = int(p.get("generated_tokens", 0))
+        dgen = int(d.get("generated_tokens", 0))
+        if p.get("handoff_of") != j.get("decode_engine") or \
+                d.get("handoff_of") != j.get("prefill_engine"):
+            bad.append(f"{rid}: handoff_of cross-naming broken "
+                       f"({p.get('handoff_of')!r} / "
+                       f"{d.get('handoff_of')!r})")
+        elif dgen < pgen or dgen != int(j.get("generated_tokens", 0)):
+            bad.append(
+                f"{rid}: tokens do not reconcile (prefill {pgen}, "
+                f"decode {dgen}, journey "
+                f"{j.get('generated_tokens')}) — the decode side is "
+                "seeded with the prefill tokens and must carry the "
+                "journey total")
+        else:
+            ok += 1
+    out.append(f"  pair reconciliation: {ok}/{len(js)} journeys "
+               "match their request-record pairs")
+    for msg in bad[:5]:
+        out.append(f"  MISMATCH {msg}")
+    out.append("")
+
+
+def section_fleet(recs, out):
+    """Fleet snapshots (kind:"fleet") + load-harness summaries
+    (kind:"harness"): the latest per-router snapshot's load and rates,
+    and each harness run's goodput/SLO line."""
+    fleets = [r for r in recs if r.get("kind") == "fleet"]
+    harness = [r for r in recs if r.get("kind") == "harness"]
+    if not fleets and not harness:
+        return
+    out.append(f"== fleet ==  ({len(fleets)} snapshot(s), "
+               f"{len(harness)} harness run(s))")
+    latest = {}
+    for r in fleets:
+        latest[r.get("router", "?")] = r  # file order: last wins
+    for name in sorted(latest):
+        r = latest[name]
+        sat = r.get("saturated") or []
+        sat_txt = f"  SATURATED {sat}" if sat else ""
+        out.append(
+            f"  {name}: {r.get('n_engines', '?')} engines / "
+            f"{r.get('n_pools', '?')} pool(s)  queue "
+            f"{r.get('queue_depth', 0)}  active {r.get('active', 0)}  "
+            f"claims {r.get('outstanding_claims', 0)}{sat_txt}")
+        out.append(
+            f"    rates/s: in {r.get('arrival_rate', 0)}  done "
+            f"{r.get('completion_rate', 0)}  handoff "
+            f"{r.get('handoff_rate', 0)}  reject "
+            f"{r.get('rejection_rate', 0)}")
+        att = r.get("slo_attainment") or {}
+        if att:
+            out.append("    slo attainment: " + "  ".join(
+                f"{k}={v:.3f}" for k, v in sorted(att.items())))
+    for r in harness:
+        out.append(
+            f"  harness seed={r.get('seed', '?')} "
+            f"{r.get('requests', '?')} reqs in "
+            f"{float(r.get('duration_s', 0.0)):.1f}s: goodput "
+            f"{float(r.get('goodput_tokens_per_s', 0.0)):.1f} tok/s  "
+            f"ttft p50 {_fmt_s(float(r.get('ttft_p50_s', 0.0)))} "
+            f"p99 {_fmt_s(float(r.get('ttft_p99_s', 0.0)))}  rejected "
+            f"{float(r.get('rejected_fraction', 0.0)):.3f}  expired "
+            f"{float(r.get('expired_fraction', 0.0)):.3f}  peak "
+            f"in-flight {r.get('peak_in_flight', '?')}")
     out.append("")
 
 
@@ -304,6 +430,8 @@ def render(recs, top=5):
     section_compiles(recs, out, top)
     section_serve(recs, out)
     section_routing(recs, out)
+    section_journeys(recs, out)
+    section_fleet(recs, out)
     section_collectives(recs, out, top)
     section_ranks(recs, out)
     section_events(recs, out, top)
