@@ -1012,8 +1012,9 @@ def _serve_load_workload():
                     dropout=0.0)
     model = GPTForCausalLM(cfg)
     model.eval()
+    burst = (0.4, 0.7, 10.0)
     trace = _lh.generate_trace(seed, n_reqs, rate_rps=rate,
-                               burst=(0.4, 0.7, 10.0),
+                               burst=burst,
                                max_prompt=48, max_out=max_new,
                                vocab=256)
     # small admission queue on purpose: the 10x burst must actually
@@ -1025,7 +1026,7 @@ def _serve_load_workload():
         fleet_snapshot_s=0.5)
     try:
         summary = _lh.run_harness(router, trace, seed=seed,
-                                  drain_timeout_s=300.0)
+                                  drain_timeout_s=300.0, burst=burst)
     finally:
         router.shutdown()
     return summary

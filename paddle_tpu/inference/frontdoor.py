@@ -221,23 +221,20 @@ class ServingRouter:
         last_exc = None
         for eng in ranked:
             try:
+                # slo_class/router ride the submit call so the engine
+                # stamps them (and handle.request_id, the trace id)
+                # BEFORE the request is visible to its scheduler
+                # thread — a post-submit stamp would race a fast
+                # prefill that streams/exports/finishes immediately,
+                # leaving journey records with router=None and
+                # request/journey records missing the class
                 handle = eng.submit(
                     prompt, max_new_tokens=max_new_tokens,
                     eos_token_id=eos_token_id, deadline_ms=deadline_ms,
-                    sampling=sampling)
+                    sampling=sampling, slo_class=cls, router=self.name)
             except (QueueFullError, EngineStopped) as e:
                 last_exc = e  # load-shed THIS engine; try the next
                 continue
-            # the stable request identity: born at the prefill
-            # engine's submit (the trace id), stamped onto the handle
-            # so it rides the exported chain and the adopted
-            # decode-side trace — route record, both engine-side
-            # request records, and the journey all join on it
-            handle.request_id = getattr(handle.trace, "request_id",
-                                        None)
-            handle.router = self.name
-            if handle.trace is not None:
-                handle.trace.slo_class = cls
             affinity = affinity_of.get(eng.name, 0)
             with self._lock:
                 self._stats["dispatched"] += 1

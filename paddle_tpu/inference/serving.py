@@ -988,10 +988,13 @@ class GenerationHandle:
         self.trace = None     # serve_observatory RequestTrace
         self.sampling = GREEDY  # SamplingParams (submit sampling=)
         self.key = None         # uint32[2] per-request base PRNG key
-        self.request_id = None  # router-stamped stable id: rides the
-        # handle, the exported KVChainHandle, and the adopted decode
-        # trace, so route + both request records + the journey join
-        self.router = None      # ServingRouter name (fleet telemetry)
+        self.request_id = None  # stable id (the trace id), stamped in
+        # engine submit BEFORE the enqueue: rides the handle, the
+        # exported KVChainHandle, and the adopted decode trace, so
+        # route + both request records + the journey join
+        self.router = None      # ServingRouter name (fleet telemetry),
+        # stamped in engine submit via the router= kwarg — never after
+        # the scheduler can already be acting on the request
 
     def _push(self, tok):
         with self._cv:
@@ -1155,7 +1158,8 @@ class GenerationEngine(_SchedulerLifecycle):
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
-               deadline_ms=None, sampling=None):
+               deadline_ms=None, sampling=None, slo_class=None,
+               router=None):
         """Queue one prompt (1-D int array) for generation; returns a
         GenerationHandle. Rejects immediately (QueueFullError) when the
         queue is full, and validates the context limit up front. A
@@ -1164,6 +1168,13 @@ class GenerationEngine(_SchedulerLifecycle):
         in-flight generation is never killed by its deadline, but the
         request record states whether it was met (`deadline_met`), and
         the SLO aggregates count it.
+
+        `slo_class` / `router` carry the ServingRouter's identity
+        stamps: they (and `handle.request_id`) land on the handle and
+        trace HERE, before the enqueue makes the request visible to
+        the scheduler thread — a fast prefill may stream, export, even
+        finish the instant it is queued, and its records must already
+        carry the id/class (a post-submit stamp would race).
 
         `sampling` (SamplingParams) picks this request's decode
         strategy: the default is greedy (temperature 0, bit-exact with
@@ -1219,6 +1230,11 @@ class GenerationEngine(_SchedulerLifecycle):
             max_new_tokens=max_new,
             deadline_s=None if deadline_ms is None
             else float(deadline_ms) / 1000.0)
+        handle.request_id = handle.trace.request_id
+        if slo_class is not None:
+            handle.trace.slo_class = str(slo_class)
+        if router is not None:
+            handle.router = str(router)
         reject = None
         with self._cv:
             if self._stopping:

@@ -48,6 +48,7 @@ observatory".
 """
 import collections
 import json
+import math
 import os
 import threading
 import time
@@ -290,8 +291,12 @@ class FleetMonitor:
                     interval_s = json.loads(env)  # (hot-sync fence)
                 except ValueError:
                     interval_s = None
+        # json.loads happily parses NaN/Infinity tokens, and
+        # `now - t < nan` is always False — a NaN interval would fire
+        # a full load_report sweep on EVERY submit; reject non-finite
         if not isinstance(interval_s, (int, float)) \
-                or isinstance(interval_s, bool):
+                or isinstance(interval_s, bool) \
+                or not math.isfinite(interval_s):
             interval_s = self.DEFAULT_INTERVAL_S
         self.interval_s = max(interval_s * 1.0, 0.0)
         self._router = weakref.ref(router)
@@ -303,6 +308,13 @@ class FleetMonitor:
         # snapshot now (the gate workload, the load harness's closing
         # report) force one via snapshot()
         self._t_last = time.perf_counter()
+        # the rate window anchors on the PREVIOUS SNAPSHOT's time, kept
+        # apart from _t_last: maybe_snapshot() overwrites _t_last to
+        # claim the cadence window BEFORE the snapshot runs, and a
+        # window measured from the claim would span only the
+        # milliseconds load_report() took — inflating every rate by the
+        # interval/milliseconds ratio (~1000x at the 5 s default)
+        self._t_prev_snap = self._t_last
         self._prev_stats = None   # router routing stats at last snapshot
         self._prev_completed = 0  # global completed count at last snapshot
         self.pressure = FleetPressure(getattr(router, "name", "router"))
@@ -353,9 +365,8 @@ class FleetMonitor:
         with self._mlock:
             prev_stats, prev_completed = self._prev_stats, \
                 self._prev_completed
-            t_prev = self._t_last
-        window = 0.0 if prev_stats is None \
-            else max(now - t_prev, 0.0) if t_prev is not None else 0.0
+            t_prev = self._t_prev_snap
+        window = 0.0 if prev_stats is None else max(now - t_prev, 0.0)
 
         def rate(key):
             if prev_stats is None or window <= 0.0:
@@ -422,6 +433,7 @@ class FleetMonitor:
         _monitor.export_step(rec, kind="fleet")
         with self._mlock:
             self._t_last = now
+            self._t_prev_snap = now
             self._prev_stats = stats
             self._prev_completed = completed
             self.last_snapshot = rec

@@ -186,6 +186,63 @@ class TestJourneyEndToEnd:
         finally:
             eng.shutdown()
 
+    def test_maybe_snapshot_rate_window_spans_the_interval(self):
+        """maybe_snapshot claims the cadence window (overwriting
+        _t_last) BEFORE the snapshot runs — the rate window must still
+        reach back to the PREVIOUS snapshot, not the milliseconds the
+        claim-to-report gap took, or every rate inflates by the
+        interval/milliseconds ratio (~1000x at the 5 s default)."""
+        eng = GenerationEngine(MODEL, n_pages=16, page_size=4,
+                               max_batch=1, max_new_tokens=4,
+                               name="fo_win_eng")
+        try:
+            router = ServingRouter([eng], name="fo_win")
+            mon = fobs.FleetMonitor(router, interval_s=1000.0)
+            assert mon.snapshot() is not None  # anchors the window
+            t0 = time.perf_counter()
+            with router._lock:  # three arrivals inside the window
+                router._stats["requests"] += 3
+            time.sleep(0.25)
+            mon._t_last -= 2000.0  # cadence due: the production path
+            rec = mon.maybe_snapshot()
+            elapsed = time.perf_counter() - t0
+            assert rec is not None
+            assert 0.25 <= rec["window_s"] <= elapsed + 0.05
+            # the rate is delta / THAT window — ~12/s here, not the
+            # ~1000x-inflated delta / load_report-milliseconds figure
+            assert rec["arrival_rate"] == pytest.approx(
+                3 / rec["window_s"], rel=0.01)
+            assert rec["arrival_rate"] < 100.0
+        finally:
+            eng.shutdown()
+
+
+# -- the snapshot-interval env knob --------------------------------------
+
+class _RouterStub:
+    """weakref-able stand-in: interval parsing never touches the
+    router beyond its name/engines."""
+    name = "fo_env"
+    engines = ()
+
+
+class TestSnapshotIntervalEnv:
+    def test_rejects_non_finite_and_junk(self, monkeypatch):
+        # json.loads parses NaN/Infinity tokens, and `now - t < nan`
+        # is always False — an accepted NaN would snapshot on EVERY
+        # submit; all of these must fall back to the default cadence
+        for tok in ("NaN", "Infinity", "-Infinity", "bogus", "true",
+                    "[1]", "null"):
+            monkeypatch.setenv("PADDLE_TPU_FLEET_SNAPSHOT_EVERY_S", tok)
+            mon = fobs.FleetMonitor(_RouterStub())
+            assert mon.interval_s == fobs.FleetMonitor.DEFAULT_INTERVAL_S, tok
+
+    def test_accepts_finite_numbers(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLEET_SNAPSHOT_EVERY_S", "2.5")
+        assert fobs.FleetMonitor(_RouterStub()).interval_s == 2.5
+        monkeypatch.setenv("PADDLE_TPU_FLEET_SNAPSHOT_EVERY_S", "0")
+        assert fobs.FleetMonitor(_RouterStub()).interval_s == 0.0
+
 
 # -- schema tables -------------------------------------------------------
 

@@ -625,6 +625,27 @@ class TestRouterPlacement:
         finally:
             router.shutdown()
 
+    def test_identity_stamped_before_scheduler_visibility(self):
+        """request_id / router / slo_class land inside engine submit,
+        BEFORE the enqueue makes the request visible to the scheduler
+        thread — a post-submit stamp races a fast prefill, which can
+        stream/export/finish the instant it is queued, producing
+        journeys with router=None and records missing the class."""
+        eng = GenerationEngine(MODEL, n_pages=16, page_size=4,
+                               max_batch=1, max_new_tokens=4,
+                               name="fd_stamp_eng")
+        try:
+            h = eng.submit(np.arange(1, 5), max_new_tokens=2,
+                           deadline_ms=60_000,
+                           slo_class="standard", router="fd_stamp")
+            # stamped by submit itself — no router post-processing ran
+            assert h.request_id == h.trace.request_id
+            assert h.router == "fd_stamp"
+            assert h.trace.slo_class == "standard"
+            h.result(300)
+        finally:
+            eng.shutdown()
+
 
 # -- schema + report -----------------------------------------------------
 

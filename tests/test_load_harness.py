@@ -73,6 +73,29 @@ class TestGenerateTrace:
         # every tier shows up at 200 draws
         assert {r["slo_class"] for r in trace} == set(tiers)
 
+    def test_phase_buckets_follow_the_burst_window(self):
+        """A trace generated with a non-default burst window must not
+        be phase-labeled by hardcoded (0.4, 0.7) fractions — the
+        window threads through the harness."""
+        from paddle_tpu.profiler import monitor as _pmon
+        from paddle_tpu.profiler import serve_observatory as _sobs
+        burst = (0.1, 0.2, 5.0)
+        trace = lh.generate_trace(1, 10, burst=burst)
+        h = lh.OpenLoopHarness(object(), trace, burst=burst)
+        # phase bucketing is pure index math over the OFFERED set —
+        # stage an all-rejected run, no engines needed
+        h._submitted = [(None, r["t"], 0.0, i)
+                        for i, r in enumerate(trace)]
+        h._rejected = len(trace)
+        rec = h._summarize(1.0, _pmon, _sobs)
+        ph = rec["phases"]
+        # fractions over index space 0/9..9/9: only i=0 is before 0.1,
+        # only i=1 falls in [0.1, 0.2) — the default window would put
+        # four requests in "before" and three in "burst"
+        assert ph["before"]["requests"] == 1
+        assert ph["burst"]["requests"] == 1
+        assert ph["after"]["requests"] == 8
+
 
 # -- the open-loop smoke -------------------------------------------------
 

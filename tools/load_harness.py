@@ -111,10 +111,18 @@ class OpenLoopHarness:
     add_done_callback — tiny callbacks that stamp an outcome under the
     harness lock, so in-flight accounting never waits on a result."""
 
-    def __init__(self, router, trace, drain_timeout_s=120.0):
+    def __init__(self, router, trace, drain_timeout_s=120.0,
+                 burst=(0.4, 0.7)):
         self.router = router
         self.trace = list(trace)
         self.drain_timeout_s = drain_timeout_s
+        # the burst window the TRACE was generated with, as index
+        # fractions — the before/burst/after phase buckets derive from
+        # it, so a trace built with a non-default window must hand the
+        # same tuple here or its phase stats mislabel. generate_trace's
+        # 3-tuple (lo, hi, factor) is accepted as-is.
+        self.burst_lo = float(burst[0])
+        self.burst_hi = float(burst[1])
         self._lock = threading.Lock()
         self._in_flight = 0
         self._peak_in_flight = 0
@@ -206,8 +214,8 @@ class OpenLoopHarness:
 
         def _phase_of(i):
             frac = i / n_idx
-            return "before" if frac < 0.4 else \
-                "burst" if frac < 0.7 else "after"
+            return "before" if frac < self.burst_lo else \
+                "burst" if frac < self.burst_hi else "after"
 
         # every OFFERED request lands in its phase bucket — a rejected
         # one has no engine record but its rejection is the phase's
@@ -281,11 +289,14 @@ class OpenLoopHarness:
 
 
 def run_harness(router, trace, seed=0, drain_timeout_s=120.0,
-                snapshot_after=True):
+                snapshot_after=True, burst=(0.4, 0.7)):
     """Convenience wrapper: run the harness, force a closing fleet
     snapshot (so the run's last window lands in the JSONL), and return
-    the summary record."""
-    h = OpenLoopHarness(router, trace, drain_timeout_s=drain_timeout_s)
+    the summary record. `burst` is the window the trace was generated
+    with (generate_trace's 3-tuple is accepted) — the phase buckets
+    in the summary derive from it."""
+    h = OpenLoopHarness(router, trace, drain_timeout_s=drain_timeout_s,
+                        burst=burst)
     h.seed = int(seed)
     summary = h.run()
     mon = getattr(router, "_fleet_mon", None)
@@ -333,14 +344,15 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    burst = (0.4, 0.7, args.burst_factor)
     trace = generate_trace(args.seed, args.requests,
-                           rate_rps=args.rate,
-                           burst=(0.4, 0.7, args.burst_factor),
+                           rate_rps=args.rate, burst=burst,
                            max_out=args.max_new)
     router = _build_router(args)
     try:
         summary = run_harness(router, trace, seed=args.seed,
-                              drain_timeout_s=args.drain_timeout)
+                              drain_timeout_s=args.drain_timeout,
+                              burst=burst)
     finally:
         router.shutdown()
     print(json.dumps(summary, default=str), flush=True)
