@@ -24,6 +24,7 @@ from ..framework.core import Tensor
 from .serving import (InferenceEngine, GenerationEngine, GenerationHandle,
                       BucketLadder, ServingError, QueueFullError,
                       DeadlineExceeded, EngineStopped, SamplingParams)
+from .speculative import SpeculativeConfig
 from .frontdoor import ServingRouter
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
@@ -35,6 +36,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "InferenceEngine", "GenerationEngine", "GenerationHandle",
            "BucketLadder", "ServingError", "QueueFullError",
            "DeadlineExceeded", "EngineStopped", "SamplingParams",
+           # speculative decoding (draft-propose, verify-as-one-row)
+           "SpeculativeConfig",
            # the serving front door (multi-engine router)
            "ServingRouter"]
 

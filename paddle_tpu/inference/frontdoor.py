@@ -155,8 +155,21 @@ class ServingRouter:
         decode-role engine (adopted chains only), with `max_batch`
         decode slots and `prefill_batch` (default max_batch) prefill
         slots. Returns the wired ServingRouter; the engines are
-        reachable as `router.engines`."""
+        reachable as `router.engines`.
+
+        A `speculative=SpeculativeConfig(...)` kwarg makes the pair
+        draft-capable: both engines share ONE draft page pool (built
+        here, like the target pool) so a mid-speculation chain's draft
+        rider hands off by page id exactly like the target chain —
+        draft pages cannot cross pools any more than target pages
+        can."""
         cache = model.make_paged_cache(n_pages, page_size)
+        spec = engine_kw.get("speculative")
+        if spec is not None and "draft_cache" not in engine_kw:
+            engine_kw["draft_cache"] = \
+                spec.draft_model.make_paged_cache(
+                    spec.draft_pages or n_pages,
+                    spec.draft_page_size or page_size)
         pre = GenerationEngine(
             model, cache=cache, max_batch=prefill_batch or max_batch,
             name=f"{name}_prefill", **engine_kw)
@@ -433,6 +446,20 @@ class ServingRouter:
                 "admittable_pages": admittable,
                 "free_pages": free_pages,
                 "saturated": saturated,
+                # fleet-wide speculation quality: accepted/proposed
+                # summed over engines (a rate-of-rates would weight an
+                # idle engine's 0.0 the same as a busy one's)
+                "proposed_tokens": sum(
+                    int(r.get("proposed_tokens", 0))
+                    for r in reports.values()),
+                "accepted_tokens": sum(
+                    int(r.get("accepted_tokens", 0))
+                    for r in reports.values()),
+                "accept_rate": (
+                    sum(int(r.get("accepted_tokens", 0))
+                        for r in reports.values())
+                    / max(sum(int(r.get("proposed_tokens", 0))
+                              for r in reports.values()), 1)),
             },
             "routing": stats,
         }

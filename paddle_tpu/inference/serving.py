@@ -82,6 +82,20 @@ streams the first token, then moves the KV chain to a decode-role
 engine via `PagedKVCache.export_chain` / `adopt()` without copying a
 page (both engines share one pool; see docs/SERVING.md "The front
 door").
+
+With `speculative=SpeculativeConfig(draft_model, k)` the ragged loop
+runs SPECULATIVE DECODING (inference/speculative.py, docs/SERVING.md
+"Speculative decoding"): a small draft model proposes k tokens per
+active sequence per iteration and the target verifies all k+1
+positions as ONE prefill-shaped row through the same `serve.
+ragged_step` executable — the MIN_Q_TOKENS token-bucket floor means a
+k<=7 verify row pads into the signature a 1-token decode row already
+warmed, so steady state adds zero executables. Accepted tokens are
+bit-identical to the non-speculative stream (position-keyed draws);
+rejected tails roll back the KV write cursor only. `kind:"serve"` and
+`kind:"request"` records carry `proposed_tokens` / `accepted_tokens`
+/ `accept_rate` (zeros on non-speculative paths), and `load_report()`
+exposes the engine's cumulative accept rate.
 """
 import itertools
 import threading
@@ -98,6 +112,7 @@ from ..framework.core import Tensor
 from ..profiler import monitor as _monitor
 from ..profiler import serve_observatory as _obs
 from ..profiler import statistic as _stat
+from .speculative import accept_length
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
            "EngineStopped", "BucketLadder", "InferenceEngine",
@@ -1033,7 +1048,8 @@ class GenerationHandle:
 
 class _ActiveSeq:
     __slots__ = ("sid", "handle", "generated", "last", "reserve",
-                 "cached", "filled", "sampling", "key")
+                 "cached", "filled", "sampling", "key", "draft_sid",
+                 "dlen")
 
     def __init__(self, sid, handle, reserve, cached=0):
         self.sid = sid
@@ -1045,6 +1061,14 @@ class _ActiveSeq:
         self.filled = cached    # prompt tokens whose KV is in the pool
         self.sampling = handle.sampling  # SamplingParams
         self.key = handle.key            # uint32[2] base PRNG key
+        # speculative decoding (inference/speculative.py): the DRAFT
+        # cache's twin sequence id (None = this request decodes
+        # non-speculatively) and the draft's committed KV length — an
+        # independent cursor over the SAME token history, because the
+        # draft computes KV for prompt tokens the target served from
+        # its prefix cache
+        self.draft_sid = None
+        self.dlen = 0
 
 
 class GenerationEngine(_SchedulerLifecycle):
@@ -1103,7 +1127,8 @@ class GenerationEngine(_SchedulerLifecycle):
     def __init__(self, model, n_pages=256, page_size=16, max_batch=8,
                  max_queue=64, max_new_tokens=64, eos_token_id=None,
                  cache=None, name=None, ragged=None, prefill_chunk=32,
-                 prefix_cache=True, kv_snapshot_every=8):
+                 prefix_cache=True, kv_snapshot_every=8,
+                 speculative=None, draft_cache=None):
         self.name = name or f"gen{next(_ENGINE_IDS)}"
         for need in ("paged_decode_step", "make_paged_cache"):
             if not hasattr(model, need):
@@ -1123,6 +1148,33 @@ class GenerationEngine(_SchedulerLifecycle):
             raise TypeError("ragged=True needs model.paged_ragged_step()")
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.prefix_cache = bool(prefix_cache) and self.ragged
+        # speculative decoding (inference/speculative.py): a draft
+        # model + its own page pool. `draft_cache` lets a disaggregated
+        # pair SHARE one draft pool (the mid-speculation handoff rider
+        # moves draft page ids, which cannot cross pools).
+        self.speculative = speculative
+        self._draft_cache = None
+        self._spec_proposed = 0  # draft tokens proposed (this engine)
+        self._spec_accepted = 0  # draft tokens accepted (this engine)
+        if speculative is not None:
+            from .speculative import SpeculativeConfig
+            if not isinstance(speculative, SpeculativeConfig):
+                raise TypeError(
+                    "speculative must be a SpeculativeConfig, got "
+                    f"{type(speculative).__name__}")
+            if not self.ragged:
+                raise ValueError(
+                    "speculative decoding needs the ragged engine "
+                    "path — the verify row rides the mixed "
+                    "prefill/decode step")
+            if not hasattr(speculative.draft_model, "paged_ragged_step"):
+                raise TypeError(
+                    "SpeculativeConfig.draft_model needs "
+                    "paged_ragged_step() (e.g. GPTForCausalLM)")
+            self._draft_cache = draft_cache if draft_cache is not None \
+                else speculative.draft_model.make_paged_cache(
+                    speculative.draft_pages or n_pages,
+                    speculative.draft_page_size or page_size)
         # attention-slot accounting: how many kv score slots each step
         # COMPUTES vs how many were USEFUL (inside some row's causal
         # bound). The bucketed path computes pad_rows x full table
@@ -1213,6 +1265,28 @@ class GenerationEngine(_SchedulerLifecycle):
                 f"pages (prompt {prompt.size} + max_new {max_new}) but the "
                 f"cache only has {usable} usable — it could NEVER be "
                 "admitted; grow n_pages or shorten the request")
+        if self._draft_cache is not None:
+            # the draft twin must ALSO always fit: its worst-case KV is
+            # prompt + max_new + k tokens (the admission claim), and
+            # its own context limit bounds the catch-up cursor
+            dlimit = getattr(
+                getattr(self.speculative.draft_model, "cfg", None),
+                "max_position_embeddings", None)
+            if dlimit is not None and prompt.size + max_new > dlimit:
+                raise ValueError(
+                    f"prompt {prompt.size} + max_new_tokens {max_new} "
+                    f"exceeds the DRAFT model's "
+                    f"max_position_embeddings {dlimit}")
+            dneed = self._draft_cache.pages_needed(
+                prompt.size + max_new + self.speculative.k)
+            dusable = self._draft_cache.n_pages - 1
+            if dneed > dusable:
+                raise ValueError(
+                    f"request needs {dneed} DRAFT pages (prompt "
+                    f"{prompt.size} + max_new {max_new} + k "
+                    f"{self.speculative.k}) but the draft cache only "
+                    f"has {dusable} usable — it could NEVER be "
+                    "admitted; grow draft_pages or shorten the request")
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         handle = GenerationHandle(prompt, max_new, eos)
         handle.sampling = sp
@@ -1259,9 +1333,16 @@ class GenerationEngine(_SchedulerLifecycle):
     # -- the scheduler/decode loop --------------------------------------
     def _model_traces(self):
         """The model's trace-time compile counters (legacy decode +
-        ragged step), folded into serve.retraces by _sync_retraces."""
-        return getattr(self.model, "_paged_decode_traces", 0) \
+        ragged step), folded into serve.retraces by _sync_retraces.
+        The DRAFT model's counter is included: a steady-state draft
+        compile is just as much a retrace-contract violation as a
+        target one."""
+        n = getattr(self.model, "_paged_decode_traces", 0) \
             + getattr(self.model, "_ragged_traces", 0)
+        if self.speculative is not None:
+            n += getattr(self.speculative.draft_model,
+                         "_ragged_traces", 0)
+        return n
 
     def _loop_once(self):
         """One admit+step iteration (False = thread exits). The
@@ -1436,6 +1517,49 @@ class GenerationEngine(_SchedulerLifecycle):
         self._next_sid += 1
         return sid
 
+    # -- speculative decoding plumbing (inference/speculative.py) -------
+    def _free_draft(self, seq):
+        """Free a sequence's DRAFT-cache twin (every target free site
+        calls this — a leaked draft claim would starve two-pool
+        admission). Idempotent: clears seq.draft_sid."""
+        dsid = seq.draft_sid
+        if dsid is None or self._draft_cache is None:
+            return
+        seq.draft_sid = None
+        try:
+            with self._draft_cache.lock:
+                self._draft_cache.free_sequence(dsid)
+        except KeyError:
+            pass  # already freed (e.g. _fail_all racing a free site)
+
+    def _free_draft_sid(self, dsid):
+        """_free_draft for detached (handle, sid) tuples that no longer
+        carry the _ActiveSeq."""
+        if dsid is None or self._draft_cache is None:
+            return
+        try:
+            with self._draft_cache.lock:
+                self._draft_cache.free_sequence(dsid)
+        except KeyError:
+            pass
+
+    def _release_chain_pair(self, chain):
+        """Release a handed-off chain AND its draft rider back to their
+        pools (cancelled adoptions, dispatcher failures, shutdown).
+        Lock order target-cache -> draft-cache, taken sequentially."""
+        try:
+            with self.cache.lock:
+                self.cache.release_chain(chain)
+        except Exception:
+            pass
+        dchain = getattr(chain, "draft_chain", None)
+        if dchain is not None and self._draft_cache is not None:
+            try:
+                with self._draft_cache.lock:
+                    self._draft_cache.release_chain(dchain)
+            except Exception:
+                pass
+
     # -- prefill/decode disaggregation (the front door) ------------------
     def set_handoff(self, fn):
         """Wire this engine as the PREFILL role of a disaggregated
@@ -1484,6 +1608,11 @@ class GenerationEngine(_SchedulerLifecycle):
             new_trace.slo_class = old_trace.slo_class
             new_trace.prefix_hit_tokens = old_trace.prefix_hit_tokens
             new_trace.generated_tokens = len(generated)
+            # speculation counts survive the handoff split: the decode
+            # trace keeps accumulating where the prefill trace stopped,
+            # so journey reconciliation sees one request's totals
+            new_trace.proposed_tokens = old_trace.proposed_tokens
+            new_trace.accepted_tokens = old_trace.accepted_tokens
             new_trace.handoff_of = old_trace.engine
             old_trace.handoff_of = self.name
             journey = _fobs.Journey(
@@ -1523,8 +1652,7 @@ class GenerationEngine(_SchedulerLifecycle):
                 handle, chain, last, generated, cached = \
                     self._adopted.popleft()
             if handle.future.cancelled():
-                with self.cache.lock:
-                    self.cache.release_chain(chain)
+                self._release_chain_pair(chain)
                 if handle.trace is not None:
                     handle.trace.finish("cancelled")
                 handle._close()
@@ -1532,6 +1660,39 @@ class GenerationEngine(_SchedulerLifecycle):
             sid = self._new_sid()
             with self.cache.lock:
                 self.cache.adopt_chain(sid, chain)
+            # speculative rider: adopt the draft chain alongside the
+            # target one (same draft pool — a disaggregated pair shares
+            # it via the draft_cache= constructor arg). A rider from a
+            # FOREIGN pool cannot adopt (page ids don't cross pools):
+            # release it and rebuild draft state below. A chain with no
+            # rider (prefill engine ran non-speculatively) gets a fresh
+            # draft twin when the pool has room, or decodes
+            # non-speculatively — adoption must never block on the
+            # draft pool.
+            draft_sid, dlen = None, 0
+            dchain = getattr(chain, "draft_chain", None)
+            if self._draft_cache is not None:
+                dc = self._draft_cache
+                if dchain is not None:
+                    try:
+                        with dc.lock:
+                            dc.adopt_chain(f"{sid}.d", dchain)
+                        draft_sid, dlen = f"{sid}.d", int(dchain.length)
+                    except ValueError:
+                        with dc.lock:
+                            dc.release_chain(dchain)
+                        dchain = None
+                if draft_sid is None:
+                    dneed = dc.pages_needed(
+                        handle.prompt.size + handle.max_new_tokens
+                        + self.speculative.k)
+                    with dc.lock:
+                        if dneed + dc.outstanding_claims() <= \
+                                dc.n_free_pages() \
+                                + dc.n_evictable_pages():
+                            draft_sid = f"{sid}.d"
+                            dc.add_sequence(draft_sid)
+                            dc.set_claim(draft_sid, dneed)
             trace = handle.trace
             if trace is not None:
                 trace.admitted()  # decode-side admission boundary
@@ -1544,6 +1705,8 @@ class GenerationEngine(_SchedulerLifecycle):
             seq.generated = list(generated)
             seq.last = last
             seq.filled = int(handle.prompt.size)
+            seq.draft_sid = draft_sid
+            seq.dlen = dlen
             self._active.append(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (adoption), same contract as the admission append
 
     def _handoff_seq(self, seq, tok):
@@ -1557,6 +1720,7 @@ class GenerationEngine(_SchedulerLifecycle):
         if h.future.cancelled():
             with self.cache.lock:
                 self.cache.free_sequence(seq.sid)
+            self._free_draft(seq)
             if h.trace is not None:
                 h.trace.finish("cancelled")
             h._close()
@@ -1576,6 +1740,7 @@ class GenerationEngine(_SchedulerLifecycle):
                 if self.prefix_cache and seq.filled >= h.prompt.size:
                     self.cache.register_prefix(seq.sid, h.prompt)
                 self.cache.free_sequence(seq.sid)
+            self._free_draft(seq)
             _monitor.histogram("serve.latency_s").observe(
                 time.perf_counter() - h.t_submit)
             if h.trace is not None:
@@ -1593,13 +1758,21 @@ class GenerationEngine(_SchedulerLifecycle):
             chain.request_id = getattr(h.trace, "request_id", None) \
                 or h.request_id
             chain.t_export = time.perf_counter()
+            # speculative rider: export the draft twin alongside — the
+            # decode role adopts both in one unit (a mid-speculation
+            # chain keeps its catch-up cursor, no re-prefill)
+            if seq.draft_sid is not None and \
+                    self._draft_cache is not None:
+                with self._draft_cache.lock:
+                    chain.draft_chain = \
+                        self._draft_cache.export_chain(seq.draft_sid)
+                seq.draft_sid = None
             try:
                 # NOT holding any lock: the dispatcher enqueues on the
                 # decode engine (its _cv) and emits the route record
                 self._handoff_fn(seq, chain)
             except Exception as e:
-                with self.cache.lock:
-                    self.cache.release_chain(chain)
+                self._release_chain_pair(chain)
                 _reject_future(h.future, e)
                 _finish_trace(h.trace, e)
                 h._close()
@@ -1648,6 +1821,8 @@ class GenerationEngine(_SchedulerLifecycle):
              "pad_token_fraction": max(0.0, 1.0 - useful / computed),
              "prefix_hits": 0, "shared_pages": 0,
              "chunked_prefill_tokens": 0,
+             "proposed_tokens": 0, "accepted_tokens": 0,
+             "accept_rate": 0.0,  # bucketed path never speculates
              # for decode batches latency_s is the mean IN-FLIGHT age of
              # the step's requests (they are not finished yet)
              "latency_s": sum(now - s.handle.t_submit
@@ -1732,6 +1907,31 @@ class GenerationEngine(_SchedulerLifecycle):
                                 sid, handle.prompt,
                                 max_tokens=handle.prompt.size - 1)
                         self.cache.set_claim(sid, need)
+                        # TWO-POOL admission (speculative decoding):
+                        # the draft model's cache is a second claims
+                        # ledger — gate + claim it here, still under
+                        # the TARGET pool's lock (lock order
+                        # target-cache -> draft-cache everywhere), so
+                        # two engines over the shared pools can never
+                        # interleave between the gates. A full draft
+                        # pool unwinds the target claim and waits —
+                        # admission must never half-book a request.
+                        draft_sid = None
+                        if self._draft_cache is not None:
+                            dc = self._draft_cache
+                            dneed = dc.pages_needed(
+                                handle.prompt.size
+                                + handle.max_new_tokens
+                                + self.speculative.k)
+                            with dc.lock:
+                                if dneed + dc.outstanding_claims() > \
+                                        dc.n_free_pages() \
+                                        + dc.n_evictable_pages():
+                                    self.cache.free_sequence(sid)
+                                    return
+                                draft_sid = f"{sid}.d"
+                                dc.add_sequence(draft_sid)
+                                dc.set_claim(draft_sid, dneed)
                     self._pending.popleft()
                     _monitor.gauge("serve.queue_depth").set(
                         len(self._pending))
@@ -1745,32 +1945,159 @@ class GenerationEngine(_SchedulerLifecycle):
                     # appended UNDER self._cv: pop->prefilling is one
                     # atomic transition, so drain() never observes
                     # "queue empty, nothing in flight" mid-admission
-                    self._prefilling.append(
-                        _ActiveSeq(sid, handle, need, cached=cached))
+                    seq = _ActiveSeq(sid, handle, need, cached=cached)
+                    seq.draft_sid = draft_sid
+                    self._prefilling.append(seq)
                     continue
             if doomed is not None:
                 self._close_doomed(doomed)
 
+    def _hist_slice(self, s, start, stop):
+        """Token ids [start:stop) of a sequence's FULL history (prompt
+        then generated) as host ints — the draft catch-up feed. Pure
+        host indexing; neither array is copied whole."""
+        p = s.handle.prompt
+        ps = int(p.size)
+        out = []
+        if start < ps:
+            out.extend(int(t) for t in p[start:min(stop, ps)])
+        if stop > ps:
+            out.extend(int(t)
+                       for t in s.generated[max(start - ps, 0):stop - ps])
+        return out
+
+    def _spec_rows(self, rows, seqs):
+        """One DRAFT-model ragged step (scheduler thread; same
+        token/row bucketing rules as the target step so the draft's
+        warm schedule covers it) returning each row's next-token
+        sample as host ints. Rows draw with their request's own
+        sampling config — `draft_temperature` overriding the
+        temperature, the bench's accept-rate knob — keyed by the same
+        fold_in(request_key, position) the target's acceptance draw
+        uses; catch-up-only rows' samples are simply discarded."""
+        spec = self.speculative
+        from ..ops.pallas.attention_core import MIN_Q_TOKENS
+        t_real = sum(len(t) for _, t in rows)
+        b_real = len(rows)
+        pad_t = max(self._pow2(t_real), MIN_Q_TOKENS)
+        pad_b = min(self._pow2(b_real), self._pow2(self.max_batch))
+        temps = np.zeros((pad_b,), np.float32)
+        top_ks = np.zeros((pad_b,), np.int32)
+        top_ps = np.ones((pad_b,), np.float32)
+        keys = np.zeros((pad_b, 2), np.uint32)
+        for i, s in enumerate(seqs):
+            sp = s.sampling
+            t_eff = 0.0 if sp is None else float(sp.temperature)  # hot-sync-ok: host float of a SamplingParams field, not a device read
+            if spec.draft_temperature is not None:
+                t_eff = spec.draft_temperature
+            if t_eff > 0:
+                temps[i] = t_eff
+                top_ks[i] = (sp.top_k or 0) if sp is not None else 0
+                top_ps[i] = 1.0 if sp is None or sp.top_p is None \
+                    else sp.top_p
+                keys[i] = s.key
+        _, nxt = spec.draft_model.paged_ragged_step(
+            self._draft_cache, rows, pad_to_tokens=pad_t,
+            pad_to_rows=pad_b,
+            sampling=(temps, top_ks, top_ps, keys))
+        return [int(t) for t in jax.device_get(nxt)]  # hot-sync-ok: draft proposal sync — b_real int32s, each feeds the next draft step's input tokens
+
+    def _spec_propose(self):
+        """Draft-model proposal pass (scheduler thread), ONE iteration:
+
+        phase 1 — one CATCH-UP row per draft-backed sequence feeds the
+        draft the history tokens its cursor (seq.dlen) hasn't written
+        KV for: prefix-cache-hit prompt tokens the target never
+        computed, a whole adopted prompt after a rider-less handoff,
+        the 2-token lag a fully-accepted (bonus) verify row leaves —
+        capped at max(prefill_chunk, 2) tokens so a cold draft admits
+        incrementally exactly like target prefill. A row that reaches
+        the anchor token (seq.last) makes the sequence READY: its
+        final sample IS the first proposal d_1.
+
+        steps 2..k — each feeds the previous proposal back as a
+        1-token row per ready sequence, producing d_j keyed at the
+        same absolute position as the target's v_{j-1} draw.
+
+        Returns {sid: [d_1..d_k_eff]} for the sequences whose next
+        target row should be a VERIFY row (k_eff = min(k,
+        remaining - 1); the last token of a request is never worth
+        drafting). Sequences still catching up are absent — the
+        target decodes them non-speculatively this iteration — and
+        draft KV past the accepted prefix is rolled back by
+        _ragged_step once the verdict is in."""
+        spec = self.speculative
+        cap = max(self.prefill_chunk, 2)
+        plans, rows = [], []
+        for s in list(self._active) + list(self._prefilling):
+            if s.draft_sid is None:
+                continue
+            n_hist = int(s.handle.prompt.size) + len(s.generated)
+            take = min(n_hist - s.dlen, cap)
+            if take <= 0:
+                continue  # prefilling twin fully caught up: no anchor yet
+            remaining = s.handle.max_new_tokens - len(s.generated)
+            k_eff = 0 if s.last is None else min(spec.k, remaining - 1)
+            ready = s.dlen + take == n_hist and k_eff >= 1 \
+                and s in self._active
+            rows.append((s.draft_sid,
+                         self._hist_slice(s, s.dlen, s.dlen + take)))
+            plans.append((s, k_eff, ready, take))
+        if not rows:
+            return {}
+        drafts, live = {}, []
+        toks = self._spec_rows(rows, [p[0] for p in plans])
+        for (s, k_eff, ready, take), tok in zip(plans, toks):
+            s.dlen += take
+            if ready:
+                drafts[s.sid] = [tok]
+                live.append((s, k_eff))
+        for j in range(2, spec.k + 1):
+            feed = [(s, k_eff) for s, k_eff in live if k_eff >= j]
+            if not feed:
+                break
+            rows = [(s.draft_sid, [drafts[s.sid][-1]]) for s, _ in feed]
+            toks = self._spec_rows(rows, [s for s, _ in feed])
+            for (s, _), tok in zip(feed, toks):
+                s.dlen += 1
+                drafts[s.sid].append(tok)
+        return drafts
+
     def _ragged_step(self):
         """ONE jitted mixed step over the Pallas ragged kernel: every
-        active sequence's decode token plus up to `prefill_chunk`
-        prompt tokens of the prefilling set, token/row counts padded to
-        power-of-two buckets whose pad slots the kernel SKIPS (bound
-        0) — fixed compiled shapes with zero attention work on
-        padding. Sampling is an on-device argmax; the host reads back
-        one int32 per row through a copy launched at dispatch."""
+        active sequence's decode token — or, with speculative decoding
+        on, its anchor + k-token draft proposal VERIFIED as one
+        prefill-shaped row — plus up to `prefill_chunk` prompt tokens
+        of the prefilling set, token/row counts padded to power-of-two
+        buckets whose pad slots the kernel SKIPS (bound 0) — fixed
+        compiled shapes with zero attention work on padding. Sampling
+        is an on-device argmax (or the seeded per-position draw); the
+        host reads back one int32 per row — per TOKEN when verifying
+        drafts — through a copy launched at dispatch."""
         for s in list(self._prefilling):  # cancelled mid-prefill: evict
             if s.handle.future.cancelled():
                 with self.cache.lock:
                     self.cache.free_sequence(s.sid)
+                self._free_draft(s)
                 self._prefilling.remove(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers take GIL-atomic list() snapshots, remove() is C-level atomic
                 if s.handle.trace is not None:
                     s.handle.trace.finish("cancelled")
                 s.handle._close()
+        spec_on = self._draft_cache is not None
+        drafts = self._spec_propose() if spec_on else {}
         rows, metas = [], []
         for s in self._active:
-            rows.append((s.sid, [s.last]))
-            metas.append(("decode", s, 1))
+            d = drafts.get(s.sid)
+            if d:
+                # verify row: the anchor token (whose KV the target
+                # hasn't written yet) + the draft's proposals, one
+                # prefill-shaped row — its k+1 <= MIN_Q_TOKENS tokens
+                # pad into the same bucket a 1-token decode row does
+                rows.append((s.sid, [s.last] + d))
+                metas.append(("verify", s, 1 + len(d)))
+            else:
+                rows.append((s.sid, [s.last]))
+                metas.append(("decode", s, 1))
         budget = self.prefill_chunk
         # shortest-remaining-first: a short chat's 4 tokens must not
         # queue behind a long document's 15 chunks — the short one
@@ -1831,10 +2158,21 @@ class GenerationEngine(_SchedulerLifecycle):
                 top_ks[i] = sp.top_k or 0
                 top_ps[i] = 1.0 if sp.top_p is None else sp.top_p
                 keys[i] = s.key
-        _, nxt = self.model.paged_ragged_step(
-            self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b,
-            sampling=(temps, top_ks, top_ps, keys))
-        nxt.copy_to_host_async()  # overlap with the bookkeeping below
+        if spec_on:
+            # same executable — the jitted step always computes the
+            # per-token sample lane; return_per_token only changes
+            # which Python-level outputs we keep
+            _, nxt, nxt_tok = self.model.paged_ragged_step(
+                self.cache, rows, pad_to_tokens=pad_t,
+                pad_to_rows=pad_b,
+                sampling=(temps, top_ks, top_ps, keys),
+                return_per_token=True)
+            nxt_tok.copy_to_host_async()  # overlap with bookkeeping below
+        else:
+            _, nxt = self.model.paged_ragged_step(
+                self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b,
+                sampling=(temps, top_ks, top_ps, keys))
+            nxt.copy_to_host_async()  # overlap with the bookkeeping below
         self._sync_retraces()
         now = time.perf_counter()
         prefill_toks = sum(n for k, _, n in metas if k == "prefill")
@@ -1845,29 +2183,63 @@ class GenerationEngine(_SchedulerLifecycle):
         shared = self.cache.shared_page_count()
         _monitor.gauge("serve.shared_pages").set(shared)
         hits, self._step_prefix_hits = self._step_prefix_hits, 0
-        _monitor.export_step(
-            {"engine": self.name, "requests": b_real,
-             "batch_size": b_real, "bucket_batch": int(pad_b),
-             "queue_depth": len(self._pending),
-             # pad SLOTS exist (pad_t - t_real) but carry bound 0: the
-             # kernel computes zero attention blocks for them, so the
-             # compute-bearing pad count — what serve.pad_tokens has
-             # always measured — is 0 by construction on this path,
-             # and the slot fraction is only the intra-page remainder
-             "pad_tokens": 0,
-             "pad_token_fraction": max(0.0, 1.0 - useful / computed)
-             if computed else 0.0,
-             "pad_slots": int(pad_t - t_real),
-             "prefix_hits": hits, "shared_pages": shared,
-             "chunked_prefill_tokens": prefill_toks,
-             "latency_s": sum(now - s.handle.t_submit
-                              for _, s, _ in metas) / b_real},
-            kind="serve")
-        toks = jax.device_get(nxt)  # hot-sync-ok: the step's one sync — b_real int32s, copy launched at dispatch
-        i = 0
+        rec = {"engine": self.name, "requests": b_real,
+               "batch_size": b_real, "bucket_batch": int(pad_b),
+               "queue_depth": len(self._pending),
+               # pad SLOTS exist (pad_t - t_real) but carry bound 0: the
+               # kernel computes zero attention blocks for them, so the
+               # compute-bearing pad count — what serve.pad_tokens has
+               # always measured — is 0 by construction on this path,
+               # and the slot fraction is only the intra-page remainder
+               "pad_tokens": 0,
+               "pad_token_fraction": max(0.0, 1.0 - useful / computed)
+               if computed else 0.0,
+               "pad_slots": int(pad_t - t_real),
+               "prefix_hits": hits, "shared_pages": shared,
+               "chunked_prefill_tokens": prefill_toks,
+               "latency_s": sum(now - s.handle.t_submit
+                                for _, s, _ in metas) / b_real}
+        if spec_on:
+            per_tok = jax.device_get(nxt_tok)  # hot-sync-ok: the step's one sync — t_real int32s (the per-token verify lane), copy launched at dispatch
+        else:
+            toks = jax.device_get(nxt)  # hot-sync-ok: the step's one sync — b_real int32s, copy launched at dispatch
+        step_prop = step_acc = 0
+        i = off = 0
         for kind, s, n in metas:
-            tok = int(toks[i])
+            row0 = off
+            off += n
+            tok = int(per_tok[row0 + n - 1]) if spec_on else int(toks[i])
             i += 1
+            if kind == "verify":
+                d = drafts[s.sid]
+                samples = [int(per_tok[row0 + j]) for j in range(n)]
+                m = accept_length(d, samples)
+                k_eff = n - 1
+                step_prop += k_eff
+                step_acc += m - 1
+                # roll back BOTH write cursors BEFORE emitting: an
+                # eos/max_new finish inside the emit loop frees the
+                # sequence, and the cursors must already sit at the
+                # accepted boundary when prefix registration walks the
+                # pages. Target wrote k_eff+1 tokens, m were real;
+                # the draft consumed k_eff-1 proposals, m-1 were real
+                # (a fully-accepted row needs no draft rollback — the
+                # bonus token leaves a 2-token catch-up lag instead).
+                with self.cache.lock:
+                    self.cache.rollback(s.sid, (k_eff + 1) - m)
+                if s.draft_sid is not None:
+                    over = max(k_eff - m, 0)
+                    if over:
+                        with self._draft_cache.lock:
+                            self._draft_cache.rollback(s.draft_sid, over)
+                        s.dlen -= over
+                if s.handle.trace is not None:
+                    s.handle.trace.note_speculation(k_eff, m - 1)
+                for t in samples[:m]:
+                    self._emit(s, int(t))
+                    if s not in self._active:
+                        break  # finished/cancelled mid-acceptance
+                continue
             if kind == "decode":
                 self._emit(s, tok)
                 continue
@@ -1889,6 +2261,14 @@ class GenerationEngine(_SchedulerLifecycle):
                 continue
             self._active.append(s)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list; readers take GIL-atomic list() snapshots (load_report)
             self._emit(s, tok)
+        self._spec_proposed += step_prop  # lint-ok[unlocked-shared-state]: loop-thread-owned monotonic counters, same contract as _attn_computed
+        self._spec_accepted += step_acc  # lint-ok[unlocked-shared-state]: paired with _spec_proposed above
+        # the serve record is exported AFTER the verdict so it can
+        # carry this step's speculation outcome (zeros when off)
+        rec["proposed_tokens"] = int(step_prop)
+        rec["accepted_tokens"] = int(step_acc)
+        rec["accept_rate"] = (step_acc / step_prop) if step_prop else 0.0
+        _monitor.export_step(rec, kind="serve")
         self._note_kv_step()
 
     def _note_kv_step(self):
@@ -1961,6 +2341,13 @@ class GenerationEngine(_SchedulerLifecycle):
             "ttft_p99_s": ttft.percentile(99) if ttft else 0.0,
             "tpot_p50_s": tpot.percentile(50) if tpot else 0.0,
             "tpot_p99_s": tpot.percentile(99) if tpot else 0.0,
+            # speculation quality (cumulative): the front door's fleet
+            # snapshot surfaces accept_rate per engine
+            "speculative": self._draft_cache is not None,
+            "proposed_tokens": int(self._spec_proposed),
+            "accepted_tokens": int(self._spec_accepted),
+            "accept_rate": (self._spec_accepted / self._spec_proposed)
+            if self._spec_proposed else 0.0,
         }
 
     def observatory_snapshot(self):
@@ -2018,8 +2405,40 @@ class GenerationEngine(_SchedulerLifecycle):
                 t_bucket //= 2
         for k in range(max_new - 1):  # decode k writes token total+k
             sigs.append((MIN_Q_TOKENS, 1, width(total + k + 1)))
-        return [self.model.warm_ragged(self.cache, *sig)
-                for sig in dict.fromkeys(sigs)]
+        handles = [self.model.warm_ragged(self.cache, *sig)
+                   for sig in dict.fromkeys(sigs)]
+        if self._draft_cache is not None:
+            # the DRAFT schedule: catch-up rows walk the prompt in
+            # max(prefill_chunk, 2)-token chunks over the draft pool's
+            # own width buckets (sub-chunk remainders included — the
+            # post-bonus 2-token lag and the final partial chunk land
+            # there), then 1-token proposal steps out to
+            # prompt + max_new + k held tokens. The verify rows
+            # themselves need nothing new: k+1 <= MIN_Q_TOKENS tokens
+            # pad into the decode signatures warmed above.
+            # Over-warming is harmless (the ledger only grows); a
+            # steady-state draft compile is not.
+            dc = self._draft_cache
+            cap = max(self.prefill_chunk, 2)
+
+            def dwidth(tokens):  # draft-pool width bucket
+                return self._pow2(-(-tokens // dc.page_size))
+
+            dsigs, dfilled = [], 0
+            while dfilled < total:
+                n = min(cap, total - dfilled)
+                dfilled += n
+                t_bucket = self._pow2(n)
+                w = dwidth(dfilled)
+                while t_bucket >= 1:
+                    dsigs.append((max(t_bucket, MIN_Q_TOKENS), 1, w))
+                    t_bucket //= 2
+            for j in range(max_new + self.speculative.k):
+                dsigs.append((MIN_Q_TOKENS, 1, dwidth(total + j + 1)))
+            handles += [
+                self.speculative.draft_model.warm_ragged(dc, *sig)
+                for sig in dict.fromkeys(dsigs)]
+        return handles
 
     def _emit(self, seq, tok):
         """Record one decoded token; stream it; evict on finish — or on
@@ -2029,6 +2448,7 @@ class GenerationEngine(_SchedulerLifecycle):
         if h.future.cancelled():
             with self.cache.lock:
                 self.cache.free_sequence(seq.sid)
+            self._free_draft(seq)
             self._active.remove(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (cancel eviction); remove() is C-level atomic under the GIL
             if h.trace is not None:  # tokens already generated = waste
                 h.trace.finish("cancelled")
@@ -2058,6 +2478,7 @@ class GenerationEngine(_SchedulerLifecycle):
                 if self.prefix_cache and seq.filled >= h.prompt.size:
                     self.cache.register_prefix(seq.sid, h.prompt)
                 self.cache.free_sequence(seq.sid)
+            self._free_draft(seq)
             self._active.remove(seq)  # lint-ok[unlocked-shared-state]: scheduler-thread-owned list (completion retirement); remove() is C-level atomic under the GIL
             _monitor.histogram("serve.latency_s").observe(
                 time.perf_counter() - h.t_submit)
@@ -2098,16 +2519,13 @@ class GenerationEngine(_SchedulerLifecycle):
                     self.cache.free_sequence(seq.sid)
             except Exception:
                 pass
+            self._free_draft(seq)
             _reject_future(seq.handle.future, exc)
             _finish_trace(seq.handle.trace, exc)
             seq.handle._close()
         for item in adopted:
             handle, chain = item[0], item[1]
-            try:
-                with self.cache.lock:
-                    self.cache.release_chain(chain)
-            except Exception:
-                pass
+            self._release_chain_pair(chain)
             _reject_future(handle.future, exc)
             _finish_trace(handle.trace, exc)
             handle._close()
@@ -2123,7 +2541,7 @@ class GenerationEngine(_SchedulerLifecycle):
 
     def _take_pending(self):
         self._abort = True  # the loop thread fails _active itself
-        out = [(h, None) for h in self._pending]
+        out = [(h, None, None) for h in self._pending]
         self._pending.clear()
         return out
 
@@ -2131,28 +2549,26 @@ class GenerationEngine(_SchedulerLifecycle):
         # the loop thread is gone (or dying) with the engine, so the
         # _abort flag set by _take_pending has no reader — detach the
         # active set too or their handles hang forever. Queued
-        # adoptions release their chains back to the (shared) pool.
+        # adoptions release their chains (draft riders included) back
+        # to the (shared) pools.
         out = self._take_pending()
-        out += [(s.handle, s.sid)
+        out += [(s.handle, s.sid, s.draft_sid)
                 for s in self._active + self._prefilling]
         self._active, self._prefilling = [], []
         while self._adopted:
             item = self._adopted.popleft()
-            try:
-                with self.cache.lock:
-                    self.cache.release_chain(item[1])
-            except Exception:
-                pass
-            out.append((item[0], None))
+            self._release_chain_pair(item[1])
+            out.append((item[0], None, None))
         return out
 
     def _reject_detached(self, items, exc):
-        for h, sid in items:
+        for h, sid, dsid in items:
             if sid is not None:
                 try:
                     self.cache.free_sequence(sid)
                 except Exception:
                     pass
+            self._free_draft_sid(dsid)
             _reject_future(h.future, exc)
             _finish_trace(h.trace, exc)
             h._close()

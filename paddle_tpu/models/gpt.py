@@ -749,14 +749,21 @@ class GPTForCausalLM(nn.Layer):
                         "position_ids": Tensor(pos[None, :])},
                 training=False)
             last = logits[0][out_idx]          # [B, vocab]
-            # sampling ON DEVICE: seeded temperature/top-k/top-p per
-            # row (temperature 0 rows take the argmax lane bit-exactly)
-            # — the host reads back B int32s, never the [B, vocab]
-            # logits (serving satellite: no vocab-sized D2H in the
-            # decode loop)
-            nxt = sample_token_rows(last, temps, top_ks, top_ps,
-                                    rng_keys, pos[out_idx])
-            return (last, nxt, [s.k for s in out_slots],
+            # sampling ON DEVICE, PER TOKEN: every slot t samples from
+            # its own next-token logits under its OWNING ROW's config,
+            # keyed fold_in(row_key, position[t]) — exactly the draw
+            # the engine would make after consuming token t, which is
+            # what lets a speculative verify row read the target's
+            # sample at all k+1 positions from one step
+            # (inference/speculative.py). Every op in sample_token_rows
+            # is row-independent, so the out_idx gather reproduces the
+            # old per-row result bit-exactly; the host still reads back
+            # int32s, never vocab-sized logits
+            nxt_tok = sample_token_rows(
+                logits[0], temps[tok_seq], top_ks[tok_seq],
+                top_ps[tok_seq], rng_keys[tok_seq], pos)
+            nxt = nxt_tok[out_idx]
+            return (last, nxt, nxt_tok, [s.k for s in out_slots],
                     [s.v for s in out_slots])
 
         fn = self._ragged_jit_fn = jax.jit(step, donate_argnums=(1, 2))
@@ -841,7 +848,8 @@ class GPTForCausalLM(nn.Layer):
                                    thunk, inline=inline)
 
     def paged_ragged_step(self, cache, rows, pad_to_tokens=None,
-                          pad_to_rows=None, sampling=None):
+                          pad_to_rows=None, sampling=None,
+                          return_per_token=False):
         """ONE continuous-batching step over mixed rows: `rows` is a
         list of (seq_id, token_ids) where decode rows carry one token
         and prefill-chunk rows carry a slice of their prompt — all
@@ -859,7 +867,15 @@ class GPTForCausalLM(nn.Layer):
         rng_keys) tuple of PADDED-row-shaped host arrays (f32 [B],
         i32 [B], f32 [B], u32 [B, 2] — see `sample_token_rows`); None
         means every row decodes greedily (temperature 0), bit-exact
-        with the pre-sampling argmax path."""
+        with the pre-sampling argmax path.
+
+        `return_per_token=True` appends the full padded [T] int32
+        device array of PER-TOKEN samples (slot t's draw from its own
+        next-token logits under its owning row's config, keyed by slot
+        t's absolute position) — what a speculative verify row reads to
+        compare the target's sample at every draft position
+        (inference/speculative.py). The same one executable serves both
+        callers; the flag only changes what the host unpacks."""
         if cache.k is None:
             raise RuntimeError(
                 "this PagedKVCache was poisoned by an earlier failed "
@@ -924,7 +940,7 @@ class GPTForCausalLM(nn.Layer):
                     jnp.asarray(plan["blk_start"]),
                     jnp.asarray(plan["blk_n"]))
             try:
-                last, nxt, new_k, new_v = compiled(*args)
+                last, nxt, nxt_tok, new_k, new_v = compiled(*args)
             except Exception as e:
                 # donation only consumes the pools once the program
                 # EXECUTES; a dispatch failure before that leaves them
@@ -943,6 +959,8 @@ class GPTForCausalLM(nn.Layer):
             for s, t in rows:
                 cache.advance(s, len(t))
             n = plan["n_rows"]
+        if return_per_token:
+            return Tensor(last[:n]), nxt[:n], nxt_tok
         return Tensor(last[:n]), nxt[:n]
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,

@@ -130,7 +130,7 @@ class KVChainHandle:
     copies) or `release_chain`."""
 
     __slots__ = ("chain_id", "pages", "length", "drawn", "claim",
-                 "consumed", "request_id", "t_export")
+                 "consumed", "request_id", "t_export", "draft_chain")
 
     def __init__(self, pages, length, drawn, claim):
         self.chain_id = next(_CHAIN_IDS)
@@ -145,6 +145,13 @@ class KVChainHandle:
         # export site, never inferred downstream
         self.request_id = None
         self.t_export = None
+        # speculative-decoding rider (inference/speculative.py): the
+        # DRAFT model's exported chain for the same request, carried
+        # alongside the target chain so a mid-speculation handoff moves
+        # both caches' state in one unit. None for non-speculative
+        # engines and for cross-pool adoptions (the decode engine then
+        # rebuilds draft state from the token history)
+        self.draft_chain = None
 
 
 class PagedKVCache:
@@ -642,6 +649,31 @@ class PagedKVCache:
     def advance(self, seq_id, n_tokens):
         """Commit n_tokens appended to EVERY layer."""
         self._len[seq_id] += n_tokens
+
+    def rollback(self, seq_id, n_tokens):
+        """Un-commit the LAST n_tokens of seq_id: move the write cursor
+        back without touching page tables, refcounts, or claims — the
+        speculative-decoding rejection path (inference/speculative.py).
+
+        Pages stay held (the admission claim already reserved them, and
+        the cursor will advance over the same slots again next step);
+        stale k/v past the cursor is dead by construction — every read
+        is bounded by the pre-write length the ragged planner snapshots
+        from `_len`, and the slots are overwritten before the cursor
+        ever crosses them again. Shared (CoW) pages cannot be affected:
+        `_ensure_capacity` materialized a private copy before any write
+        in the rolled-back range, so a prefix sharer never observes a
+        speculated-then-rejected token."""
+        n_tokens = int(n_tokens)
+        if n_tokens < 0:
+            raise ValueError(f"rollback of {n_tokens} tokens")
+        if seq_id not in self._len:
+            raise KeyError(f"unknown sequence {seq_id!r}")
+        if n_tokens > self._len[seq_id]:
+            raise ValueError(
+                f"rollback of {n_tokens} tokens exceeds sequence "
+                f"{seq_id!r} length {self._len[seq_id]}")
+        self._len[seq_id] -= n_tokens
 
     def plan_decode(self, seq_ids, pad_to=None):
         """Host-side plan for ONE fully-jitted decode step: allocate

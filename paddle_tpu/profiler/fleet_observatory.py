@@ -154,6 +154,12 @@ class Journey:
             "outcome": outcome,
             "prompt_tokens": self.prompt_tokens,
             "generated_tokens": int(rrec.get("generated_tokens", 0)),
+            # speculation totals reconcile across the handoff split:
+            # the decode-side trace inherited the prefill half's counts
+            # (GenerationEngine.adopt), so this is the whole journey's
+            "proposed_tokens": int(rrec.get("proposed_tokens", 0)),
+            "accepted_tokens": int(rrec.get("accepted_tokens", 0)),
+            "accept_rate": float(rrec.get("accept_rate", 0.0)),  # hot-sync-ok: host dict field, not a device read
             "pages_moved": self.pages_moved,
             "chain_tokens": self.chain_tokens,
             "page_size": self.page_size,
@@ -382,6 +388,9 @@ class FleetMonitor:
                 "queue_depth": int(rep.get("queue_depth", 0)),
                 "active": int(rep.get("active", 0)),
                 "slots_free": int(rep.get("slots_free", 0)),
+                # per-engine speculation quality (0.0 when the engine
+                # never speculated — the front door's accept view)
+                "accept_rate": float(rep.get("accept_rate", 0.0)),  # hot-sync-ok: host dict field, not a device read
             }
             if "unavailable" in rep:
                 eng_rec["unavailable"] = str(rep["unavailable"])[:120]

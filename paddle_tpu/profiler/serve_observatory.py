@@ -92,6 +92,7 @@ class RequestTrace:
     __slots__ = ("request_id", "engine", "rows", "prompt_tokens",
                  "max_new_tokens", "deadline_s", "prefix_hit_tokens",
                  "generated_tokens", "prefill_chunks", "peak_pages_held",
+                 "proposed_tokens", "accepted_tokens",
                  "t_submit", "t_admit", "t_first", "done",
                  "slo_class", "handoff_of", "journey")
 
@@ -107,6 +108,11 @@ class RequestTrace:
         self.generated_tokens = 0
         self.prefill_chunks = 0
         self.peak_pages_held = 0
+        # speculative decoding (inference/speculative.py): draft tokens
+        # this request was offered vs the ones the target's verify row
+        # accepted — zeros on every non-speculative path
+        self.proposed_tokens = 0
+        self.accepted_tokens = 0
         self.t_submit = time.perf_counter()
         self.t_admit = None
         self.t_first = None
@@ -140,6 +146,13 @@ class RequestTrace:
         self.generated_tokens += 1
         if pages_held > self.peak_pages_held:
             self.peak_pages_held = int(pages_held)
+
+    def note_speculation(self, proposed, accepted):
+        """One verify row's verdict: `proposed` draft tokens went in,
+        `accepted` survived (the bonus sample is a generated token,
+        not an accepted one — accepted <= proposed always)."""
+        self.proposed_tokens += int(proposed)
+        self.accepted_tokens += int(accepted)
 
     # -- terminal state -------------------------------------------------
     def finish(self, outcome, error=None):
@@ -187,6 +200,10 @@ class RequestTrace:
             "generated_tokens": self.generated_tokens,
             "prefill_chunks": self.prefill_chunks,
             "peak_pages_held": self.peak_pages_held,
+            "proposed_tokens": self.proposed_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "accept_rate": (self.accepted_tokens / self.proposed_tokens)
+            if self.proposed_tokens else 0.0,
             "queue_s": round(queue_s, 6),
             "prefill_s": round(prefill_s, 6),
             "decode_s": round(decode_s, 6),

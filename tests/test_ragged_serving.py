@@ -537,12 +537,14 @@ class TestTelemetryAndFences:
         assert "np.asarray(logits" not in src
         assert "hot-sync-ok" not in src
         assert "hot-sync-ok" not in inspect.getsource(GE._admit_ragged)
-        # the ragged step keeps exactly ONE marked sync — the int32
-        # token read whose copy was launched at dispatch — and the
-        # fence's device_get pattern catches any other
+        # the ragged step keeps exactly ONE executed sync per step —
+        # an if/else picks the per-token verify-lane read (speculative)
+        # or the last-token read (plain), so the SOURCE carries exactly
+        # two marked int32 reads, both copies launched at dispatch —
+        # and the fence's device_get pattern catches any other
         step_src = inspect.getsource(GE._ragged_step)
-        assert step_src.count("hot-sync-ok") == 1
-        assert step_src.count("device_get") == 1
+        assert step_src.count("hot-sync-ok") == 2
+        assert step_src.count("device_get") == 2
 
     def test_hot_sync_lint_covers_ragged_loop(self):
         sys.path.insert(0, os.path.join(REPO, "tools"))

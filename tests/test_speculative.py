@@ -1,0 +1,336 @@
+"""Speculative decoding through the fixed-shape ragged step
+(inference/speculative.py + the GenerationEngine surgery).
+
+The whole subsystem's correctness contract is an EQUALITY, not a
+distribution argument: position-keyed sampling (fold_in(request_key,
+absolute_position)) makes the non-speculative token stream a pure
+function of (seed, history), so every accepted speculative token must
+be bit-identical to it — greedy AND sampled, through admit/evict
+churn, prefix-cache sharing, and a disaggregated handoff. Covered:
+
+- accept_length (the longest-prefix + bonus rule) and
+  SpeculativeConfig validation (k bounded by the MIN_Q_TOKENS bucket)
+- PagedKVCache.rollback: write-cursor only — pages, refcounts, and
+  claims untouched (the rejected-tail protocol)
+- engine equality vs the non-speculative stream under mid-stream
+  admit/evict, greedy and sampled in one batch
+- rejected tails never corrupt a registered CoW prefix: sharers
+  admitted after a speculating sequence still match the oracle
+- two-pool admission accounting drains clean (no leaked draft claims)
+- mid-speculation handoff: the draft rider crosses the
+  prefill->decode boundary and the journey still matches
+- telemetry: request records carry proposed/accepted (zeros when
+  speculation is off), load_report exposes accept_rate
+- zero-new-executables: warm_async covers the draft schedule and a
+  speculative steady state adds no (tag, signature) pairs
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+from paddle_tpu.inference import (GenerationEngine, ServingRouter,
+                                  SamplingParams, SpeculativeConfig)
+from paddle_tpu.inference.speculative import accept_length
+from paddle_tpu.ops.pallas.attention_core import MIN_Q_TOKENS
+from paddle_tpu.profiler import serve_observatory as sobs
+
+pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick gate no
+
+
+def _tiny_lm(seed=0, layers=2):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=layers,
+                    num_heads=4, max_position_embeddings=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _draft_for(seed=0):
+    """A 1-layer draft over the same vocab — seeded like the target so
+    its argmax agrees often enough to exercise BOTH accept and reject
+    paths (equality must hold at any accept rate)."""
+    return _tiny_lm(seed=seed, layers=1)
+
+
+def _spec_engine(target, draft, k=4, **kw):
+    kw.setdefault("n_pages", 64)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_new_tokens", 10)
+    return GenerationEngine(target, speculative=SpeculativeConfig(draft, k=k),
+                            **kw)
+
+
+# -- the acceptance rule (pure host) ------------------------------------
+
+class TestAcceptLength:
+    def test_longest_prefix_plus_bonus(self):
+        # v_0 always accepted; each d_i == v_{i-1} extends the prefix
+        assert accept_length([7, 8], [7, 8, 9]) == 3   # all + bonus
+        assert accept_length([7, 8], [7, 9, 1]) == 2   # d_2 missed
+        assert accept_length([7, 8], [5, 8, 9]) == 1   # d_1 missed
+        assert accept_length([], [4]) == 1             # anchor only
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            accept_length([1, 2], [1, 2])
+
+    def test_config_bounds_k_to_the_token_bucket(self):
+        d = object()
+        with pytest.raises(ValueError):
+            SpeculativeConfig(d, k=0)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(d, k=MIN_Q_TOKENS)  # k+1 would overflow
+        with pytest.raises(ValueError):
+            SpeculativeConfig(None, k=2)
+        assert SpeculativeConfig(d, k=MIN_Q_TOKENS - 1).k \
+            == MIN_Q_TOKENS - 1
+
+
+# -- the rollback protocol (pool level) ---------------------------------
+
+class TestRollback:
+    def _cache(self):
+        m = _tiny_lm()
+        return m.make_paged_cache(n_pages=16, page_size=4)
+
+    def test_cursor_only_pages_and_claims_untouched(self):
+        c = self._cache()
+        c.add_sequence("s")
+        c.set_claim("s", 3)
+        c.plan_ragged([("s", 6)])  # draw pages for 6 tokens
+        c.advance("s", 6)
+        held = c.pages_held("s")
+        drawn = c.pages_drawn("s")
+        claims = c.outstanding_claims()
+        c.rollback("s", 4)  # reject a speculated tail
+        assert c.length("s") == 2
+        assert c.pages_held("s") == held          # pages stay drawn
+        assert c.pages_drawn("s") == drawn
+        assert c.outstanding_claims() == claims   # ledger untouched
+        # the freed cursor range is rewritable without a new draw
+        c.plan_ragged([("s", 4)])
+        c.advance("s", 4)
+        assert c.length("s") == 6
+
+    def test_bounds_checked(self):
+        c = self._cache()
+        c.add_sequence("s")
+        c.plan_ragged([("s", 2)])
+        c.advance("s", 2)
+        with pytest.raises(ValueError):
+            c.rollback("s", 3)      # more than was ever written
+        with pytest.raises(ValueError):
+            c.rollback("s", -1)
+        with pytest.raises(KeyError):
+            c.rollback("ghost", 1)
+        c.rollback("s", 0)          # no-op is legal
+        assert c.length("s") == 2
+
+
+# -- engine equality ----------------------------------------------------
+
+def _nonspec_outputs(model, jobs):
+    """Oracle: the SAME requests through a non-speculative engine."""
+    eng = GenerationEngine(model, n_pages=64, page_size=4, max_batch=2,
+                           max_new_tokens=10)
+    try:
+        hs = [eng.submit(p, max_new_tokens=n, sampling=sp)
+              for p, n, sp in jobs]
+        return [h.result(timeout=300).tolist() for h in hs]
+    finally:
+        eng.shutdown()
+
+
+class TestSpeculativeEngine:
+    def test_equality_greedy_and_sampled_under_churn(self):
+        """Three requests (greedy + two seeded sampled) over 2 slots:
+        admission churn, eviction mid-stream, and every emitted token
+        bit-identical to the non-speculative stream."""
+        target, draft = _tiny_lm(), _draft_for()
+        rng = np.random.RandomState(5)
+        jobs = [
+            (rng.randint(0, 64, (4,)), 8, None),
+            (rng.randint(0, 64, (6,)), 10,
+             SamplingParams(temperature=0.9, top_k=16, seed=11)),
+            (rng.randint(0, 64, (3,)), 6,
+             SamplingParams(temperature=0.7, top_p=0.9, seed=23)),
+        ]
+        refs = _nonspec_outputs(target, jobs)
+        eng = _spec_engine(target, draft, k=4)
+        try:
+            hs = [eng.submit(p, max_new_tokens=n, sampling=sp)
+                  for p, n, sp in jobs]
+            outs = [h.result(timeout=300).tolist() for h in hs]
+            rep = eng.load_report()
+        finally:
+            eng.shutdown()
+        assert outs == refs
+        assert rep["speculative"] is True
+        assert 0 <= rep["accepted_tokens"] <= rep["proposed_tokens"]
+        assert 0.0 <= rep["accept_rate"] <= 1.0
+
+    def test_rejected_tails_under_admit_evict_churn_drain_clean(self):
+        """A tiny draft pool + queue pressure: sequences join, evict,
+        and reject tails continuously; afterwards BOTH pools are fully
+        free — no leaked pages, no leaked claims in either ledger."""
+        target, draft = _tiny_lm(), _draft_for(seed=9)  # disagreeing draft
+        rng = np.random.RandomState(6)
+        jobs = [(rng.randint(0, 64, (rng.randint(2, 7),)),
+                 int(rng.randint(2, 8)), None) for _ in range(5)]
+        refs = _nonspec_outputs(target, jobs)
+        eng = _spec_engine(target, draft, k=3, n_pages=32, max_batch=2)
+        try:
+            hs = [eng.submit(p, max_new_tokens=n, sampling=sp)
+                  for p, n, sp in jobs]
+            outs = [h.result(timeout=300).tolist() for h in hs]
+            dc = eng._draft_cache
+            eng.drain(timeout=60)
+            assert dc.outstanding_claims() == 0
+            assert dc.n_free_pages() == dc.n_pages - 1  # all but pad page
+        finally:
+            eng.shutdown()
+        assert outs == refs
+
+    def test_cow_prefix_sharers_never_observe_rejected_writes(self):
+        """A registered prefix is shared copy-on-write; a speculating
+        sharer writes (then rejects) tokens PAST the shared range. A
+        sharer admitted afterwards must still decode the oracle stream
+        — any speculated write leaking into a registered page would
+        corrupt its attention over the prefix KV."""
+        target, draft = _tiny_lm(), _draft_for(seed=9)
+        sys_prompt = np.random.RandomState(7).randint(0, 64, (8,))
+        ref = _nonspec_outputs(target, [(sys_prompt, 8, None)])[0]
+        eng = _spec_engine(target, draft, k=4, n_pages=64)
+        try:
+            # seed the registry, then two sharers in sequence: the
+            # second attends over pages the first speculated across
+            assert eng.submit(sys_prompt, max_new_tokens=8
+                              ).result(timeout=300).tolist() == ref
+            h1 = eng.submit(sys_prompt, max_new_tokens=8)
+            assert h1.result(timeout=300).tolist() == ref
+            h2 = eng.submit(sys_prompt, max_new_tokens=8)
+            assert h2.result(timeout=300).tolist() == ref
+            # the second sharer really did hit the prefix cache
+            tail = [r for r in sobs.requests_tail()
+                    if r["outcome"] == "completed"]
+            assert any(r["prefix_hit_tokens"] > 0 for r in tail)
+        finally:
+            eng.shutdown()
+
+    def test_zero_new_executables_after_warm(self):
+        """warm_async covers the draft's catch-up/proposal schedule and
+        the verify rows reuse the decode signatures — a warmed
+        speculative engine adds ZERO (tag, signature) pairs in steady
+        state, and retraces_after_warm == 0 (draft compiles counted)."""
+        from paddle_tpu.profiler import compile_observatory as cobs
+        target, draft = _tiny_lm(), _draft_for()
+        eng = _spec_engine(target, draft, k=4, prefix_cache=False,
+                           max_new_tokens=6)
+        try:
+            eng.warm(5, 6)
+            warmed = cobs.ledger_signatures()
+            # model-level trace counters: warm's own compiles are done
+            # (warm blocks), so any growth below is a steady-state
+            # retrace — target's or the draft's
+            traces0 = getattr(target, "_ragged_traces", 0) \
+                + getattr(draft, "_ragged_traces", 0)
+            eng.submit(np.random.RandomState(8).randint(0, 64, (5,)),
+                       max_new_tokens=6).result(timeout=300)
+            eng.submit(np.random.RandomState(9).randint(0, 64, (5,)),
+                       max_new_tokens=6,
+                       sampling=SamplingParams(temperature=0.8, seed=3)
+                       ).result(timeout=300)
+            steady = cobs.ledger_signatures()
+            assert steady == warmed, sorted(steady - warmed)
+            assert getattr(target, "_ragged_traces", 0) \
+                + getattr(draft, "_ragged_traces", 0) == traces0
+        finally:
+            eng.shutdown()
+
+
+# -- telemetry ----------------------------------------------------------
+
+class TestSpeculativeTelemetry:
+    def test_request_records_carry_spec_fields(self):
+        target, draft = _tiny_lm(), _draft_for()
+        eng = _spec_engine(target, draft, k=4)
+        try:
+            eng.submit(np.array([3, 1, 4, 1, 5]), max_new_tokens=6
+                       ).result(timeout=300)
+        finally:
+            eng.shutdown()
+        rec = [r for r in sobs.requests_tail()
+               if r["outcome"] == "completed"][-1]
+        assert rec["proposed_tokens"] >= 1
+        assert 0 <= rec["accepted_tokens"] <= rec["proposed_tokens"]
+        assert 0.0 <= rec["accept_rate"] <= 1.0
+
+    def test_nonspec_records_carry_zeros(self):
+        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+                               max_batch=2, max_new_tokens=4)
+        try:
+            eng.submit(np.array([2, 7, 1])).result(timeout=300)
+            rep = eng.load_report()
+        finally:
+            eng.shutdown()
+        rec = [r for r in sobs.requests_tail()
+               if r["outcome"] == "completed"][-1]
+        assert rec["proposed_tokens"] == 0
+        assert rec["accepted_tokens"] == 0
+        assert rec["accept_rate"] == 0.0
+        assert rep["speculative"] is False
+        assert rep["accept_rate"] == 0.0
+
+    def test_config_rejects_nonragged_and_bad_draft(self):
+        target = _tiny_lm()
+        with pytest.raises(ValueError):
+            GenerationEngine(target, ragged=False,
+                             speculative=SpeculativeConfig(_draft_for()))
+        with pytest.raises(TypeError):
+            GenerationEngine(target, speculative="not-a-config")
+        with pytest.raises(TypeError):
+            GenerationEngine(
+                target, speculative=SpeculativeConfig(object()))
+
+
+# -- the disaggregated handoff ------------------------------------------
+
+class TestSpeculativeHandoff:
+    def test_mid_speculation_chain_handoff_equality(self):
+        """Prefill role catches the draft up over the prompt, exports
+        the chain WITH its draft rider; the decode role adopts both
+        and keeps speculating — greedy and sampled streams both match
+        the single-engine non-speculative oracle, and the journey
+        record reconciles accepted <= proposed."""
+        target, draft = _tiny_lm(), _draft_for()
+        rng = np.random.RandomState(10)
+        jobs = [
+            (rng.randint(0, 64, (6,)), 8, None),
+            (rng.randint(0, 64, (4,)), 8,
+             SamplingParams(temperature=0.8, top_k=12, seed=31)),
+        ]
+        refs = _nonspec_outputs(target, jobs)
+        router = ServingRouter.disaggregated(
+            target, n_pages=64, page_size=4, max_batch=2,
+            max_new_tokens=10, name="spec_rt",
+            speculative=SpeculativeConfig(draft, k=4))
+        try:
+            # both engines share ONE draft pool: the rider's page ids
+            # stay valid across the handoff
+            pre, dec = router.engines
+            assert pre._draft_cache is dec._draft_cache
+            hs = [router.submit(p, max_new_tokens=n, sampling=sp)
+                  for p, n, sp in jobs]
+            outs = [h.result(timeout=300).tolist() for h in hs]
+            rep = router.load_report()
+        finally:
+            router.shutdown()
+        assert outs == refs
+        fleet = rep["fleet"]
+        assert 0 <= fleet["accepted_tokens"] <= fleet["proposed_tokens"]
+        assert 0.0 <= fleet["accept_rate"] <= 1.0
+        # the decode role did the speculating (prefill never decodes)
+        assert rep["engines"]["spec_rt_decode"]["proposed_tokens"] >= 1

@@ -10,10 +10,13 @@ metrics JSONL (`--ledger file.jsonl`), but the apples-to-apples source
 is the CANONICAL WORKLOAD here: a fixed tiny GPT train step (per-step,
 scanned run_steps, scanned accumulate), a two-bucket serving engine,
 the ragged paged-attention serving step (serve.ragged_step: the
-Pallas mixed prefill+decode program behind GenerationEngine), and a
+Pallas mixed prefill+decode program behind GenerationEngine), a
 2-engine DISAGGREGATED ServingRouter (prefill/decode roles over one
 shared page pool — the router tier adds zero executables and lands
-real kind:"route" records in the tier-1-linted ledger),
+real kind:"route" records in the tier-1-linted ledger), and a
+SPECULATIVE engine (1-layer draft, k=2 — the verify rows pad into the
+warmed decode signature, so speculation too must add zero target
+executables AND zero steady-state draft traces),
 compiled cold (persistent cache off) on the single-device CPU backend —
 same model, same shapes, same flags every run, so fusion counts and
 bytes-accessed are deterministic and compile seconds are comparable.
@@ -256,15 +259,39 @@ def emit_workload():
     router = ServingRouter.disaggregated(
         gen_model, n_pages=8, page_size=16, max_batch=2,
         max_new_tokens=3, name="canonical_router")
+    # SPECULATIVE decoding through the same ragged step
+    # (inference/speculative.py): a 1-layer draft proposes k=2 tokens
+    # and the target verifies them as one k+1-token row — which pads
+    # into the SAME (8, 1, 1) signature as every other row above, so
+    # the speculative engine must add ZERO target executables, and its
+    # draft's own schedule compiles entirely inside the warm set
+    from paddle_tpu.inference import SpeculativeConfig
+    paddle.seed(1)
+    draft_cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, max_position_embeddings=16,
+                          dropout=0.0)
+    draft_model = GPTForCausalLM(draft_cfg)
+    draft_model.eval()
+    spec = GenerationEngine(gen_model, n_pages=8, page_size=16,
+                            max_batch=2, max_new_tokens=3,
+                            name="canonical_spec",
+                            speculative=SpeculativeConfig(draft_model,
+                                                          k=2))
     handles = [
         step.warm(ids, ids),                       # train.step
         step.warm_run_steps(2, ids, ids),          # train.run_steps
         step.warm_accumulate(2, stacked, stacked),  # train.accumulate
     ] + eng.warm_async(x_serve) \
       + gen.warm_async(4, 3) \
-      + router.warm_async(4, 3)                    # serve.ragged_step
+      + router.warm_async(4, 3) \
+      + spec.warm_async(4, 3)                      # serve.ragged_step
     summary = jwarm.join(handles)                  # kind:"warm" record
     warmed = cobs.ledger_signatures()
+    # the draft shares the target's RAGGED_TAG, so the ledger-pair
+    # check alone cannot see a steady-state DRAFT compile — the
+    # per-model trace counters can, and must not move either
+    traces0 = getattr(gen_model, "_ragged_traces", 0) \
+        + getattr(draft_model, "_ragged_traces", 0)
 
     # steady state over the warmed executables
     float(step(ids, ids).item())
@@ -274,6 +301,8 @@ def emit_workload():
     eng.shutdown()
     gen.submit(np.array([1, 2, 3, 4]), max_new_tokens=3).result(120)
     gen.shutdown()
+    spec.submit(np.array([1, 2, 3, 4]), max_new_tokens=3).result(120)
+    spec.shutdown()
     router.submit(np.array([1, 2, 3, 4]), max_new_tokens=3,
                   deadline_ms=120_000).result(120)
     router._fleet_mon.snapshot()  # force ONE kind:"fleet" record: the
@@ -284,6 +313,13 @@ def emit_workload():
             "executable-sharing warmup contract violated: steady state "
             f"compiled {sorted(steady - warmed)} beyond the warmed set "
             f"(warm summary: {summary})")
+    traces1 = getattr(gen_model, "_ragged_traces", 0) \
+        + getattr(draft_model, "_ragged_traces", 0)
+    if traces1 != traces0:
+        raise AssertionError(
+            "speculative steady state retraced the ragged step "
+            f"({traces0} -> {traces1} model-level traces) — the draft "
+            "schedule or the verify-row bucketing missed a signature")
 
     # the serving observatory contract: every request submitted to
     # either engine lands EXACTLY ONE schema-valid kind:"request"
@@ -314,7 +350,8 @@ def emit_workload():
     # four records, one per engine, same request_id on the router pair
     if sorted(by_engine) != ["canonical", "canonical_gen",
                              "canonical_router_decode",
-                             "canonical_router_prefill"] or \
+                             "canonical_router_prefill",
+                             "canonical_spec"] or \
             any(len(v) != 1 for v in by_engine.values()):
         raise AssertionError(
             "expected exactly one request record per engine "
@@ -341,11 +378,42 @@ def emit_workload():
     # by the decode half (seeded at adoption)
     rec_total = sum(r["generated_tokens"] for r in reqs
                     if r["outcome"] == "completed")
-    if rec_total != gen_total or rec_total != 6:  # 2 x max_new_tokens=3
+    if rec_total != gen_total or rec_total != 9:  # 3 x max_new_tokens=3
         raise AssertionError(
             "request-record token counts do not reconcile with the "
             f"engine counters: records {rec_total}, "
-            f"serve.generated_tokens {gen_total}, expected 6")
+            f"serve.generated_tokens {gen_total}, expected 9")
+    # the speculative contract: the canonical_spec request carries the
+    # schema-valid proposed/accepted trio with real proposals, every
+    # NON-speculative record stamps zeros, and >= 1 kind:"serve" step
+    # record from canonical_spec reports its verify-row verdict — so
+    # tier-1 lints real speculative records in the same ledger
+    spec_rec = by_engine["canonical_spec"][0]
+    if spec_rec.get("proposed_tokens", 0) < 1 or \
+            spec_rec["accepted_tokens"] > spec_rec["proposed_tokens"]:
+        raise AssertionError(
+            "the canonical_spec request must propose >= 1 draft token "
+            f"and accept at most what it proposed: {spec_rec}")
+    for r in reqs:
+        if r["engine"] != "canonical_spec" and (
+                r.get("proposed_tokens", 0) != 0
+                or r.get("accepted_tokens", 0) != 0
+                or r.get("accept_rate", 0.0) != 0.0):
+            raise AssertionError(
+                "non-speculative request records must stamp zero "
+                f"speculative counts: {r['engine']} -> {r}")
+    serves = _load_kind(mfile, "serve")
+    spec_steps = [r for r in serves if r.get("engine") == "canonical_spec"
+                  and r.get("proposed_tokens", 0) >= 1]
+    if not spec_steps:
+        raise AssertionError(
+            "expected >= 1 kind:'serve' record from canonical_spec "
+            "with proposed_tokens >= 1 (did the draft propose at all?)")
+    errs = [e for r in serves
+            for e in _cms.validate_line(_json.dumps(r))]
+    if errs:
+        raise AssertionError(
+            f"serve records violate the schema: {errs[:5]}")
     if pre_rec["generated_tokens"] != 1:
         raise AssertionError(
             "the prefill half streams exactly its first token before "

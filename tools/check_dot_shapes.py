@@ -130,6 +130,40 @@ def check_planner(min_rows):
     return violations
 
 
+def check_verify_rows(min_rows):
+    """Speculative verify-row geometry (inference/speculative.py): a
+    k-draft verify row carries k+1 tokens, and SpeculativeConfig caps
+    k at MIN_Q_TOKENS - 1 precisely so that every legal depth pads
+    into the (MIN_Q_TOKENS, ...) token bucket — the same warm decode
+    signature, still MXU-shaped. Walk every legal k and assert the
+    padded bucket and its q-block both hold, so a future change to the
+    k cap, the pad floor, or choose_q_block cannot silently ship
+    sub-tile verify dots (or mint per-depth executables)."""
+    from paddle_tpu.ops.pallas.attention_core import (
+        MIN_Q_TOKENS, MXU_ROWS, choose_q_block)
+
+    def pow2(n):
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    violations = []
+    for k in range(1, MIN_Q_TOKENS):  # every legal SpeculativeConfig.k
+        t = max(pow2(k + 1), MIN_Q_TOKENS)  # the engine's pad rule
+        if t != MIN_Q_TOKENS:
+            violations.append(
+                f"verify-row: k={k} ({k + 1} tokens) pads to bucket "
+                f"{t} != MIN_Q_TOKENS {MIN_Q_TOKENS} — speculation "
+                "would mint a new executable per depth")
+        bq = choose_q_block(t, cap=MXU_ROWS)
+        if bq < min_rows:
+            violations.append(
+                f"verify-row: k={k} bucket {t} yields q_block {bq} < "
+                f"{min_rows} — sub-MXU verify dots")
+    return violations
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         "check_dot_shapes",
@@ -159,6 +193,7 @@ def main(argv=None):
             for shape in dot_result_dims(text):
                 print(f"  dot -> {'x'.join(map(str, shape))}")
     violations += check_planner(args.min_rows)
+    violations += check_verify_rows(args.min_rows)
     for v in violations:
         print(f"FAIL: {v}")
     if violations:

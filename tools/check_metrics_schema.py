@@ -61,6 +61,17 @@ Schema (documented in docs/OBSERVABILITY.md):
                   shared_pages int     >= 0 KV pages with > 1 holder
                   chunked_prefill_tokens int  >= 0 prompt tokens admitted
                                        via chunked prefill this step
+                  proposed_tokens / accepted_tokens int >= 0 — draft
+                                       tokens proposed / accepted by
+                                       this step's verify rows
+                                       (speculative decoding,
+                                       inference/speculative.py);
+                                       accepted <= proposed, and a
+                                       non-speculative step stamps
+                                       zeros
+                  accept_rate  number  in [0, 1]; must equal
+                                       accepted/proposed (0.0 when
+                                       nothing proposed)
   kind == "health" (one record per resolved health vector —
                   TrainStep/HybridTrainStep monitor_health=True)
                   additionally requires:
@@ -264,6 +275,14 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        engine, and vice versa) — how
                                        tools/obs_report.py reconciles
                                        the pair's token counts
+                  proposed_tokens / accepted_tokens int >= 0 —
+                                       speculative-decoding counts for
+                                       THIS request (accepted <=
+                                       proposed, accepted <=
+                                       generated_tokens; zeros when
+                                       speculation is off)
+                  accept_rate  number  in [0, 1] == accepted/proposed
+                                       (0.0 when nothing proposed)
   kind == "route" (ONE record per routing decision — the serving
                   front door, paddle_tpu/inference/frontdoor.py
                   ServingRouter) additionally requires:
@@ -357,6 +376,11 @@ Schema (documented in docs/OBSERVABILITY.md):
                   router       str     non-empty
                   deadline_s   number  >= 0
                   deadline_met bool    completed within deadline_s
+                  proposed_tokens / accepted_tokens / accept_rate —
+                                       same speculative trio as the
+                                       request record (copied from the
+                                       decode-side record; accepted <=
+                                       generated_tokens)
   kind == "fleet" (periodic router-level fleet snapshot —
                   profiler/fleet_observatory.py FleetMonitor over
                   ServingRouter.load_report) additionally requires:
@@ -372,7 +396,10 @@ Schema (documented in docs/OBSERVABILITY.md):
                                        unique pools
                   saturated    list    subset of fleet
                   engines      dict    per-engine rollup; keys must be
-                                       a subset of fleet
+                                       a subset of fleet; a member's
+                                       optional accept_rate (the
+                                       engine's cumulative speculative
+                                       accept rate) must be in [0, 1]
                   window_s     number  >= 0 seconds since the previous
                                        snapshot (0 on the first)
                   arrival_rate / completion_rate / handoff_rate /
@@ -555,6 +582,45 @@ def _check_types(rec, required, where, errors):
                           f"{type(val).__name__}, expected {types}")
 
 
+def _check_spec_fields(rec, where, errors):
+    """The speculative-decoding trio (optional on serve, request, and
+    journey records — inference/speculative.py): proposed_tokens /
+    accepted_tokens int >= 0 with accepted <= proposed (a verify step
+    can never accept drafts nobody proposed), accept_rate a number in
+    [0, 1] that reconciles with the counts — exactly accepted/proposed
+    when anything was proposed, and EXACTLY zero on a non-speculative
+    record (nonspec engines must stamp zeros, not omit arithmetic)."""
+    prop = rec.get("proposed_tokens")
+    acc = rec.get("accepted_tokens")
+    rate = rec.get("accept_rate")
+
+    def _i(v):
+        return v if isinstance(v, int) and not isinstance(v, bool) \
+            else None
+
+    for key, v in (("proposed_tokens", prop), ("accepted_tokens", acc)):
+        if key in rec and (_i(v) is None or v < 0):
+            errors.append(
+                f"{where}: {key} must be an int >= 0, got {v!r}")
+    if _i(prop) is not None and _i(acc) is not None and acc > prop:
+        errors.append(
+            f"{where}: accepted_tokens {acc} > proposed_tokens {prop} "
+            "— acceptance cannot outrun the draft")
+    if "accept_rate" in rec:
+        if not isinstance(rate, (int, float)) or isinstance(rate, bool) \
+                or not 0.0 <= rate <= 1.0:
+            errors.append(
+                f"{where}: accept_rate must be a number in [0, 1], "
+                f"got {rate!r}")
+        elif _i(prop) is not None and _i(acc) is not None:
+            want = (acc / prop) if prop else 0.0
+            if abs(rate - want) > 1e-6:
+                errors.append(
+                    f"{where}: accept_rate {rate} does not reconcile "
+                    f"with accepted/proposed = {want:.6f} — the ratio "
+                    "and the counters must be the same measurement")
+
+
 def validate_line(line, where="<line>"):
     """Errors (list of strings, empty = valid) for one JSONL line."""
     errors = []
@@ -649,6 +715,7 @@ def validate_line(line, where="<line>"):
                 errors.append(
                     f"{where}: pad_token_fraction must be a number in "
                     f"[0, 1], got {v!r}")
+        _check_spec_fields(rec, where, errors)
     elif rec.get("kind") == "health":
         _check_types(rec, HEALTH_REQUIRED, where, errors)
         if isinstance(rec.get("step"), int) and \
@@ -822,6 +889,16 @@ def validate_line(line, where="<line>"):
             errors.append(
                 f"{where}: deadline_met must be bool, got "
                 f"{rec['deadline_met']!r}")
+        _check_spec_fields(rec, where, errors)
+        # cross-field: a request cannot accept more speculated tokens
+        # than it generated (every accepted token IS an emitted token)
+        sacc, sgen = _rint("accepted_tokens") \
+            if "accepted_tokens" in rec else None, gen
+        if sacc is not None and sgen is not None and sacc > sgen:
+            errors.append(
+                f"{where}: accepted_tokens {sacc} > generated_tokens "
+                f"{sgen} — accepted speculative tokens are a subset of "
+                "the generated stream")
     elif rec.get("kind") == "route":
         _check_types(rec, ROUTE_REQUIRED, where, errors)
         for key in ("engine", "slo_class"):
@@ -956,6 +1033,15 @@ def validate_line(line, where="<line>"):
                 f"{where}: phase seconds {sum(phases):.6f} exceed "
                 f"latency_s {lat} — the journey's boundary stamps must "
                 "telescope")
+        _check_spec_fields(rec, where, errors)
+        jacc = _int_val(rec, "accepted_tokens") \
+            if "accepted_tokens" in rec else None
+        jgen = _int_val(rec, "generated_tokens")
+        if jacc is not None and jgen is not None and jacc > jgen:
+            errors.append(
+                f"{where}: accepted_tokens {jacc} > generated_tokens "
+                f"{jgen} — the journey's speculative accounting must "
+                "reconcile with its decode record")
         if "deadline_met" in rec and not isinstance(
                 rec["deadline_met"], bool):
             errors.append(
@@ -1016,6 +1102,15 @@ def validate_line(line, where="<line>"):
                         f"{where}: engines keys {extra} not in fleet "
                         f"{fleet} — the rollup reports engines the "
                         "router does not own")
+                for n, eng_rec in engines.items():
+                    if isinstance(eng_rec, dict) and \
+                            "accept_rate" in eng_rec:
+                        v = eng_rec["accept_rate"]
+                        if not isinstance(v, (int, float)) or \
+                                isinstance(v, bool) or not 0 <= v <= 1:
+                            errors.append(
+                                f"{where}: engines[{n!r}].accept_rate "
+                                f"must be in [0, 1], got {v!r}")
         attain = rec.get("slo_attainment")
         if isinstance(attain, dict):
             for cls, v in attain.items():

@@ -89,7 +89,21 @@ HOT_REGIONS = {
         # adopt entry are all host dict/list math — the chain moves
         # page IDS, never page contents
         "GenerationEngine._handoff_seq",
-        "GenerationEngine._drain_adopted", "GenerationEngine.adopt"],
+        "GenerationEngine._drain_adopted", "GenerationEngine.adopt",
+        # speculative decoding runs entirely on the scheduler thread:
+        # draft proposal steps sync k times per iteration (int32s per
+        # ready row, marked hot-sync-ok — each feeds the next step's
+        # input tokens), the verify verdict reads the per-token lane
+        # once, and the rollback/free plumbing is pure host ledger math
+        "GenerationEngine._spec_propose",
+        "GenerationEngine._spec_rows",
+        "GenerationEngine._hist_slice",
+        "GenerationEngine._free_draft",
+        "GenerationEngine._free_draft_sid",
+        "GenerationEngine._release_chain_pair"],
+    # speculative decoding config + the acceptance rule: pure host
+    # token comparison (the equality contract), no device reads ever
+    "paddle_tpu/inference/speculative.py": ["*"],
     # the serving front door: routing decisions and the handoff
     # dispatcher run on caller/scheduler threads against load_report
     # snapshots — pure host scoring, never a device read

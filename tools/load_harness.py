@@ -35,6 +35,12 @@ Standalone CLI (CPU-friendly tiny GPT, 2-engine disaggregated router):
     python tools/load_harness.py --seed 0 --requests 24 --rate 4 \
         --burst-factor 10
 
+`--speculate` drives the SAME trace through the fleet twice — once
+plain, once with a SpeculativeConfig threaded through the router
+(docs/SERVING.md "Speculative decoding") — and prints both goodputs
+next to the fleet accept rate, so burst-regime speculation overhead
+is measured against an identical arrival schedule.
+
 `bench.py --serve` runs the same harness as its load stage
 (BENCH_SERVE_LOAD=0 skips) and persists the headline numbers in
 serve_history.
@@ -306,7 +312,7 @@ def run_harness(router, trace, seed=0, drain_timeout_s=120.0,
     return summary
 
 
-def _build_router(args):
+def _build_router(args, speculative=None, name="harness_router"):
     """CPU-friendly tiny disaggregated fleet for the CLI."""
     import paddle_tpu as paddle
     from paddle_tpu.inference import ServingRouter
@@ -321,7 +327,26 @@ def _build_router(args):
     return ServingRouter.disaggregated(
         model, n_pages=64, page_size=8, max_batch=2,
         max_new_tokens=args.max_new, max_queue=args.max_queue,
-        name="harness_router", fleet_snapshot_s=args.snapshot_s)
+        name=name, fleet_snapshot_s=args.snapshot_s,
+        speculative=speculative)
+
+
+def _spec_config(args):
+    """The --speculate draft: a 1-layer sibling of the target (same
+    vocab/width — random-init stand-in for a distilled draft; the
+    harness measures the speculation MACHINERY under burst load, not a
+    tuned accept rate)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import SpeculativeConfig
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+
+    paddle.seed(1)
+    dcfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                     num_heads=2, max_position_embeddings=64,
+                     dropout=0.0)
+    draft = GPTForCausalLM(dcfg)
+    draft.eval()
+    return SpeculativeConfig(draft, k=args.spec_k)
 
 
 def main(argv=None):
@@ -341,6 +366,14 @@ def main(argv=None):
     ap.add_argument("--snapshot-s", type=float, default=0.5,
                     help="fleet snapshot cadence during the run")
     ap.add_argument("--drain-timeout", type=float, default=120.0)
+    ap.add_argument("--speculate", action="store_true",
+                    help="drive the SAME trace twice — speculative "
+                         "decoding off, then on — and report both "
+                         "goodputs side by side with the fleet accept "
+                         "rate (each pass exports its own harness "
+                         "record)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculation depth for --speculate")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -355,6 +388,38 @@ def main(argv=None):
                               burst=burst)
     finally:
         router.shutdown()
+    if args.speculate:
+        # same seed, same schedule, same prompts — the only variable
+        # is the speculative pipeline, so the goodput delta is real
+        spec_router = _build_router(args, speculative=_spec_config(args),
+                                    name="harness_router_spec")
+        try:
+            spec_summary = run_harness(
+                spec_router, trace, seed=args.seed,
+                drain_timeout_s=args.drain_timeout, burst=burst)
+            rep = spec_router.load_report()
+        finally:
+            spec_router.shutdown()
+        engines = rep.get("engines", {}) if isinstance(rep, dict) else {}
+        prop = sum(int(e.get("proposed_tokens", 0))
+                   for e in engines.values())
+        acc = sum(int(e.get("accepted_tokens", 0))
+                  for e in engines.values())
+        off = float(summary.get("goodput_tokens_per_s", 0.0))
+        on = float(spec_summary.get("goodput_tokens_per_s", 0.0))
+        summary = {
+            "spec_off": summary,
+            "spec_on": spec_summary,
+            "speculate": {
+                "k": int(args.spec_k),
+                "goodput_off_tokens_per_s": off,
+                "goodput_on_tokens_per_s": on,
+                "goodput_ratio": round(on / off, 4) if off else None,
+                "proposed_tokens": prop,
+                "accepted_tokens": acc,
+                "accept_rate": round(acc / prop, 4) if prop else 0.0,
+            },
+        }
     print(json.dumps(summary, default=str), flush=True)
     return 0
 
