@@ -1155,6 +1155,106 @@ def _serve_spec_workload():
     }
 
 
+def _serve_ssm_workload():
+    """The SECOND-MODEL-FAMILY stage behind `bench.py --serve`
+    (docs/SERVING.md "Cache strategies"): a pure-SSM model (models/
+    ssm.py, RecurrentStateCache) against a same-width paged GPT at an
+    EQUAL cache memory budget. The headline is capacity: a recurrent
+    sequence costs one fixed-size state blob regardless of context, so
+    the same bytes admit far more concurrent sequences than paged KV
+    at long context — reported as concurrent_capacity_ratio alongside
+    measured decode tokens/s through the same GenerationEngine path
+    (and the hybrid's blended capacity, attention layers paying KV
+    while SSM layers stay O(1))."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+    from paddle_tpu.models.ssm import SSMConfig, SSMForCausalLM
+    from paddle_tpu.inference import GenerationEngine
+    from paddle_tpu.jit import warm as jwarm
+
+    n_reqs = int(os.environ.get("BENCH_SERVE_SSM_REQS", "4"))
+    max_new = int(os.environ.get("BENCH_SERVE_SSM_NEW", "16"))
+    # the capacity context: how long a conversation each admitted
+    # sequence is budgeted for (the paged side pays KV for all of it,
+    # the recurrent side pays the same blob no matter what)
+    ctx = int(os.environ.get("BENCH_SERVE_SSM_CTX", "4096"))
+    budget = int(os.environ.get("BENCH_SERVE_SSM_BUDGET_MB", "64")) \
+        * (1 << 20)
+    hidden, layers, heads, page_size = 256, 4, 8, 16
+    paddle.seed(0)
+    gcfg = GPTConfig(vocab_size=256, hidden_size=hidden,
+                     num_layers=layers, num_heads=heads,
+                     max_position_embeddings=128, dropout=0.0)
+    gpt = GPTForCausalLM(gcfg)
+    gpt.eval()
+    paddle.seed(0)
+    scfg = SSMConfig(vocab_size=256, hidden_size=hidden,
+                     num_layers=layers, d_state=16, d_conv=4, expand=2,
+                     max_position_embeddings=128)
+    ssm = SSMForCausalLM(scfg)
+    ssm.eval()
+
+    # equal-memory capacity accounting (f32 pools, the same dtype the
+    # engines below serve with)
+    kv_bytes_per_token = layers * hidden * 2 * 4     # K + V rows
+    kv_bytes_per_seq = -(-ctx // page_size) * page_size \
+        * kv_bytes_per_token
+    probe = ssm.make_paged_cache(4, page_size)
+    state_bytes_per_seq = probe.state_bytes_per_slot()
+    paged_capacity = budget // kv_bytes_per_seq
+    recurrent_capacity = budget // state_bytes_per_seq
+    # hybrid (attn_every=2): half the layers pay per-token KV, half
+    # pay the fixed blob — the blend long-context serving actually buys
+    hyb_kv = (layers // 2) * hidden * 2 * 4
+    hyb_bytes_per_seq = -(-ctx // page_size) * page_size * hyb_kv \
+        + (state_bytes_per_seq * (layers - layers // 2)) // layers
+    hybrid_capacity = budget // hyb_bytes_per_seq
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 256, (8,)) for _ in range(n_reqs)]
+
+    def run(model, name):
+        eng = GenerationEngine(model, n_pages=64, page_size=page_size,
+                               max_batch=4, max_new_tokens=max_new,
+                               prefix_cache=False, name=name)
+        try:
+            jwarm.join(eng.warm_async(prompts[0].size, max_new))
+            for h in [eng.submit(p, max_new_tokens=max_new)
+                      for p in prompts]:        # untimed shakeout
+                h.result(timeout=600)
+            t0 = time.perf_counter()
+            handles = [eng.submit(p, max_new_tokens=max_new)
+                       for p in prompts]
+            outs = [h.result(timeout=600).tolist() for h in handles]
+            wall = time.perf_counter() - t0
+            rep = eng.load_report()
+        finally:
+            eng.shutdown()
+        toks = sum(len(o) for o in outs)
+        return {"cache_strategy": rep["cache_strategy"],
+                "decode_tokens_per_s": round(toks / wall, 1)
+                if wall else 0.0,
+                "wall_s": round(wall, 3),
+                "retraces_after_warm": eng.retraces}
+
+    gpt_run = run(gpt, "bench_ssm_paged")
+    ssm_run = run(ssm, "bench_ssm_recurrent")
+    return {
+        "prompts": n_reqs, "max_new_tokens": max_new,
+        "capacity_context_tokens": ctx,
+        "memory_budget_mb": budget >> 20,
+        "kv_bytes_per_seq": kv_bytes_per_seq,
+        "state_bytes_per_seq": state_bytes_per_seq,
+        "paged_capacity": int(paged_capacity),
+        "recurrent_capacity": int(recurrent_capacity),
+        "hybrid_capacity": int(hybrid_capacity),
+        "concurrent_capacity_ratio": round(
+            recurrent_capacity / max(paged_capacity, 1), 1),
+        "paged": gpt_run, "recurrent": ssm_run,
+        "ssm_decode_tokens_per_s": ssm_run["decode_tokens_per_s"],
+    }
+
+
 def _run_serve():
     """`bench.py --serve`: continuous-batching serving micro-benchmark
     (docs/SERVING.md). N concurrent closed-loop client threads drive one
@@ -1313,6 +1413,16 @@ def _run_serve():
             speculate = _serve_spec_workload()
         except Exception as e:
             speculate = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+    # second model family: SSM capacity-at-equal-memory vs paged GPT +
+    # decode tokens/s (BENCH_SERVE_SSM=0 skips; failures degrade to an
+    # error key, never a dead bench)
+    ssm = None
+    if os.environ.get("BENCH_SERVE_SSM", "1") != "0":
+        _phase("ssm")
+        try:
+            ssm = _serve_ssm_workload()
+        except Exception as e:
+            ssm = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
     _phase("done", serve_s=serve_s)
 
     lat.sort()
@@ -1377,8 +1487,15 @@ def _run_serve():
             if k in speculate:
                 headline[f"spec_{k}" if not k.startswith("spec_")
                          else k] = speculate[k]
+    if ssm is not None:
+        headline["ssm"] = ssm
+        for k in ("concurrent_capacity_ratio", "recurrent_capacity",
+                  "paged_capacity", "ssm_decode_tokens_per_s"):
+            if k in ssm:
+                headline[f"ssm_{k}" if not k.startswith("ssm_")
+                         else k] = ssm[k]
     if gen is not None or router is not None or load is not None \
-            or speculate is not None:
+            or speculate is not None or ssm is not None:
         # serve trajectory ACROSS rounds (the compile_history twin):
         # bench_state.json keeps the last 10 rounds of the headline
         # serving numbers so a regression in pad fraction / prefix hit
@@ -1411,6 +1528,12 @@ def _run_serve():
             if speculate is not None and k in speculate:
                 entry[f"spec_{k}" if not k.startswith("spec_")
                       else k] = speculate[k]
+        for k in ("concurrent_capacity_ratio", "recurrent_capacity",
+                  "paged_capacity", "hybrid_capacity",
+                  "ssm_decode_tokens_per_s"):
+            if ssm is not None and k in ssm:
+                entry[f"ssm_{k}" if not k.startswith("ssm_")
+                      else k] = ssm[k]
         history.append(entry)
         state["serve_history"] = history[-10:]
         _save_state(state)

@@ -1,0 +1,549 @@
+"""Pluggable decode-cache strategies for the serving stack.
+
+The continuous-batching engine (inference/serving.py), the router
+(inference/frontdoor.py), and both observatories were written against
+`ops.paged_attention.PagedKVCache` — but everything they actually call
+is a narrow allocator/ledger surface, not attention-specific at all:
+
+    admission accounting   pages_needed / can_allocate / set_claim /
+                           outstanding_claims (a generic cost+claims
+                           ledger; "pages" is just the cost unit)
+    sequence lifecycle     add_sequence / free_sequence / length /
+                           advance / rollback / pages_held
+    prefix cache           match_prefix(_credit) / acquire_prefix /
+                           register_prefix (may be inert)
+    disaggregation         export_chain / adopt_chain / release_chain
+    telemetry              pool_stats / shared_page_count / n_pages /
+                           n_free_pages / n_evictable_pages / page_size
+
+This module names that surface a CACHE STRATEGY and adds the second
+implementation the SSM family needs (PAPERS.md "Compiler-First State
+Space Duality and Portable O(1) Autoregressive Caching"):
+
+    PagedKVCache         strategy "paged"     cost = ceil(tokens/P)
+    RecurrentStateCache  strategy "recurrent" cost = 1 slot, O(1) in
+                         sequence length — a fixed-size state blob
+                         (conv tail + SSM state matrix) per sequence
+    HybridCache          strategy "hybrid"    both ledgers at once for
+                         models interleaving SSM and attention layers
+
+`strategy_of(cache)` is how the engine/router/schema stamp records;
+every strategy's `pool_stats()` carries its own `cache_strategy` so
+the kvcache telemetry self-describes (tools/check_metrics_schema.py
+validates the strategy-conditional shape).
+"""
+import itertools
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["strategy_of", "RecurrentChainHandle", "RecurrentStateCache",
+           "HybridChainHandle", "HybridCache"]
+
+
+def strategy_of(cache):
+    """The cache's strategy name ("paged" | "recurrent" | "hybrid").
+    Defaults to "paged" for strategy-unaware caches (duck-typed
+    test doubles, older pools)."""
+    return str(getattr(cache, "strategy", "paged"))
+
+
+_CHAIN_IDS = itertools.count()
+
+
+class RecurrentChainHandle:
+    """A detached SSM decode state in flight between two sequences —
+    the recurrent strategy's handoff unit, duck-compatible with
+    `ops.paged_attention.KVChainHandle` (same ledger fields, same
+    journey-telemetry riders) except that what moves is ONE fixed-size
+    state blob per layer instead of a page-id list: `pages` is always
+    empty, `state_bytes` is the blob's size. While the handle is live
+    the pool counts its slot claim in `outstanding_claims()`, so the
+    handoff window cannot be double-booked. Consume exactly once via
+    `adopt_chain` (same pool) or `release_chain`."""
+
+    __slots__ = ("chain_id", "pages", "length", "drawn", "claim",
+                 "consumed", "request_id", "t_export", "draft_chain",
+                 "conv_state", "ssm_state", "state_bytes")
+
+    strategy = "recurrent"
+
+    def __init__(self, length, claim, conv_state, ssm_state,
+                 state_bytes):
+        self.chain_id = next(_CHAIN_IDS)
+        self.pages = ()          # no pages move — the blob is the chain
+        self.length = length
+        # the slot was FREED at export (the state left the pool as a
+        # blob); drawn=0 against claim=1 keeps one slot reserved in
+        # outstanding_claims() for re-adoption — the same limbo
+        # accounting the paged chain gets from its held pages
+        self.drawn = 0
+        self.claim = claim
+        self.consumed = False
+        self.request_id = None
+        self.t_export = None
+        self.draft_chain = None
+        self.conv_state = conv_state    # [L, d_conv-1, d_inner]
+        self.ssm_state = ssm_state      # [L, d_inner, d_state]
+        self.state_bytes = state_bytes
+
+
+class RecurrentStateCache:
+    """Host-side slot allocator + device-side per-layer state pools
+    for the SSM decode cache: each sequence owns ONE fixed-size slot
+    regardless of its length — a conv tail [d_conv-1, d_inner] and an
+    SSM state [d_inner, d_state] per layer. Admission cost is the
+    constant 1, so `pages_needed` (kept under the historical name the
+    engine calls — the unit here is SLOTS) never grows with
+    prompt + max_new_tokens: the O(1) capacity play.
+
+    Slot 0 is reserved as the pad slot (pad rows of the fixed-shape
+    serving step gather/scatter it harmlessly), mirroring the paged
+    pool's reserved page 0 — so `n_pages` (= n_slots + 1) keeps the
+    engine's `usable = n_pages - 1` arithmetic exact. The prefix-cache
+    surface is inert (a recurrent state at a page boundary is not
+    addressable the way KV pages are): match/acquire/register all
+    report misses."""
+
+    strategy = "recurrent"
+
+    def __init__(self, n_layers, n_slots, d_inner, d_state, d_conv,
+                 dtype=jnp.float32, page_size=16):
+        self.n_layers = int(n_layers)
+        self.n_slots = int(n_slots)
+        if self.n_slots < 1:
+            raise ValueError("RecurrentStateCache needs n_slots >= 1")
+        self.n_pages = self.n_slots + 1   # slot 0 = reserved pad slot
+        self.page_size = int(page_size)   # token bucketing only — no
+        # memory meaning here; the engine's warm/step token math and
+        # the route records still quote it
+        self.d_inner = int(d_inner)
+        self.d_state = int(d_state)
+        self.d_conv = int(d_conv)
+        self.dtype = dtype
+        S = self.n_pages
+        self.conv = [jnp.zeros((S, self.d_conv - 1, self.d_inner),
+                               dtype) for _ in range(self.n_layers)]
+        self.ssm = [jnp.zeros((S, self.d_inner, self.d_state), dtype)
+                    for _ in range(self.n_layers)]
+        # same role as PagedKVCache.lock: serializes the host
+        # allocator + the donated-pool swap across engines
+        self.lock = threading.RLock()
+        self._free = list(range(1, S))
+        self._slot = {}    # seq_id -> slot
+        self._len = {}     # seq_id -> tokens consumed so far
+        self._claims = {}  # seq_id -> slots reserved at admission
+        self._chains = {}  # chain_id -> in-flight RecurrentChainHandle
+        self._stats = {"slots_drawn": 0}
+
+    # ---- geometry ----------------------------------------------------
+    def state_bytes_per_slot(self):
+        """Bytes of ONE sequence's decode state — the O(1) constant
+        the capacity comparison vs paged KV is about."""
+        per_layer = ((self.d_conv - 1) * self.d_inner
+                     + self.d_inner * self.d_state)
+        return int(self.n_layers * per_layer
+                   * np.dtype(self.dtype).itemsize)
+
+    def exec_signature(self):
+        """Pool-geometry component of the serving executable's cache
+        key (ssm.warm_ragged) — two engines over one model with
+        different pools must not collide on compiled programs."""
+        return ("recurrent", self.n_pages, self.d_inner, self.d_state,
+                self.d_conv,
+                str(self.conv[0].dtype) if self.conv else "poisoned")
+
+    # ---- allocator ----------------------------------------------------
+    def add_sequence(self, seq_id):
+        if seq_id in self._slot:
+            raise ValueError(f"sequence {seq_id!r} already present")
+        if not self._free:
+            raise RuntimeError(
+                "RecurrentStateCache out of state slots — free "
+                "finished sequences or grow n_slots")
+        self._slot[seq_id] = self._free.pop()
+        self._len[seq_id] = 0
+        self._stats["slots_drawn"] += 1
+
+    def free_sequence(self, seq_id):
+        self._free.append(self._slot.pop(seq_id))
+        self._len.pop(seq_id)
+        self._claims.pop(seq_id, None)
+
+    def length(self, seq_id):
+        return self._len[seq_id]
+
+    def slot(self, seq_id):
+        return self._slot[seq_id]
+
+    def advance(self, seq_id, n_tokens):
+        self._len[seq_id] += n_tokens
+
+    def rollback(self, seq_id, n_tokens):
+        """Recurrent state folds every consumed token into one blob —
+        there is nothing to un-commit, so speculative rejection cannot
+        run on this strategy (the engine refuses the combination at
+        construction)."""
+        if int(n_tokens) > 0:
+            raise RuntimeError(
+                "recurrent decode state is not rewindable — "
+                "speculative decoding requires the paged strategy")
+
+    # ---- admission ledger (slot units under the page-era names) ------
+    def pages_needed(self, n_tokens):
+        """Admission cost of a fresh sequence: one slot, whatever the
+        token count — the recurrent strategy's defining constant."""
+        return 1
+
+    def pages_held(self, seq_id):
+        self._slot[seq_id]  # KeyError on unknown, like the paged pool
+        return 1
+
+    def n_free_pages(self):
+        return len(self._free)
+
+    def n_evictable_pages(self):
+        return 0   # no best-effort retention to reclaim
+
+    def shared_page_count(self):
+        return 0   # slots are never shared
+
+    def can_allocate(self, n_tokens, reserved=0):
+        return 1 + int(reserved) <= len(self._free)
+
+    def set_claim(self, seq_id, n_pages):
+        if seq_id not in self._slot:
+            raise KeyError(f"set_claim: unknown sequence {seq_id!r}")
+        self._claims[seq_id] = int(n_pages)
+
+    def outstanding_claims(self):
+        """Slots admission promised but the pool has not handed out:
+        a live sequence draws its slot AT admission (add_sequence), so
+        only in-flight exported chains — whose slots were freed with
+        the state blob detached — contribute."""
+        out = sum(max(c - 1, 0) for s, c in list(self._claims.items())
+                  if s in self._slot)
+        out += sum(max(h.claim - h.drawn, 0)
+                   for h in list(self._chains.values()))
+        return out
+
+    # ---- prefix cache (inert) ----------------------------------------
+    def match_prefix(self, token_ids, max_tokens=None):
+        return 0, 0
+
+    def match_prefix_credit(self, token_ids, max_tokens=None):
+        return 0, 0, 0
+
+    def acquire_prefix(self, seq_id, token_ids, max_tokens=None):
+        return 0
+
+    def register_prefix(self, seq_id, token_ids):
+        return None
+
+    # ---- chain handoff (prefill/decode disaggregation) ----------------
+    def export_chain(self, seq_id):
+        """Detach a sequence's decode state into a RecurrentChainHandle:
+        the per-layer state rows are gathered into ONE blob pair, the
+        slot returns to the free list, and the handle's claim keeps one
+        slot reserved (outstanding_claims) for re-adoption. No token is
+        recomputed — the blob IS the whole history."""
+        slot = self._slot.pop(seq_id)
+        conv_blob = jnp.stack([pool[slot] for pool in self.conv])
+        ssm_blob = jnp.stack([pool[slot] for pool in self.ssm])
+        handle = RecurrentChainHandle(
+            length=self._len.pop(seq_id),
+            claim=max(self._claims.pop(seq_id, 1), 1),
+            conv_state=conv_blob, ssm_state=ssm_blob,
+            state_bytes=self.state_bytes_per_slot())
+        self._free.append(slot)
+        self._chains[handle.chain_id] = handle
+        return handle
+
+    def adopt_chain(self, seq_id, chain):
+        """Attach an exported state blob to a FRESH sequence id on the
+        SAME pool: allocate a slot (the chain's reserved claim
+        guarantees one), scatter the blob back in, resume the claim.
+        Returns the adopted token length."""
+        if chain.consumed:
+            raise ValueError("adopt_chain: chain handle already "
+                             "consumed (adopted or released)")
+        if self._chains.pop(chain.chain_id, None) is None:
+            raise ValueError(
+                "adopt_chain: chain was not exported from THIS pool — "
+                "share the RecurrentStateCache between the two engines "
+                "instead")
+        if seq_id in self._slot:
+            raise ValueError(f"adopt_chain: sequence {seq_id!r} "
+                             "already present")
+        chain.consumed = True
+        self.add_sequence(seq_id)
+        slot = self._slot[seq_id]
+        for l in range(self.n_layers):
+            self.conv[l] = self.conv[l].at[slot].set(
+                chain.conv_state[l].astype(self.conv[l].dtype))
+            self.ssm[l] = self.ssm[l].at[slot].set(
+                chain.ssm_state[l].astype(self.ssm[l].dtype))
+        self._len[seq_id] = chain.length
+        if chain.claim:
+            self._claims[seq_id] = chain.claim
+        return chain.length
+
+    def release_chain(self, chain):
+        if chain.consumed:
+            return
+        chain.consumed = True
+        self._chains.pop(chain.chain_id, None)
+
+    # ---- telemetry ----------------------------------------------------
+    def pool_stats(self):
+        """The pool observatory's snapshot (`kind:"kvcache"` record via
+        profiler/serve_observatory.record_pool_stats). Strategy-shaped:
+        SLOT gauges plus the per-sequence state-blob size — no page
+        fields at all, which is exactly what the schema's recurrent
+        branch checks. Snapshot-copies (C-level dict()/list()) make it
+        callable from any thread."""
+        held = len(dict(self._slot))
+        return {
+            "cache_strategy": "recurrent",
+            "n_slots": int(self.n_slots),
+            "free_slots": len(list(self._free)),
+            "held_slots": held,
+            "sequences": held,
+            "slots_drawn": int(self._stats["slots_drawn"]),
+            "state_bytes": self.state_bytes_per_slot(),
+            "state_bytes_total": self.state_bytes_per_slot()
+            * int(self.n_slots),
+        }
+
+    # ---- serving-step plan -------------------------------------------
+    def plan_step(self, rows, pad_to_tokens=None, pad_to_rows=None):
+        """HOST-side (numpy) plan for one fixed-shape ragged SSM step
+        over mixed rows (`rows` = [(seq_id, n_tokens)]; decode rows
+        carry 1, prefill-chunk rows a prompt slice). Shapes depend
+        only on (T, B) = (pad_to_tokens, pad_to_rows), so a serving
+        executable keyed on them stays one executable:
+
+            positions [T]  absolute position of each token (sampling
+                           keys + hybrid wpe)
+            token_seq [T]  owning ROW of each token (pads -> row 0 —
+                           harmless: their dt is masked to identity)
+            chunk_pos [T]  index of the token within its row's chunk
+                           (the conv window's new/saved boundary)
+            tok_valid [T]  f32 1/0 — zeroes dt on pads in the step
+            slot_ids  [B]  state-pool slot per row (pads -> slot 0)
+            row_end   [B]  one past the row's last token in the stream
+            row_len   [B]  real tokens the row contributes
+            out_idx   [B]  each row's LAST token (next-token readout)
+            n_rows         real row count (host slicing)
+        """
+        n_real = len(rows)
+        t_real = sum(int(n) for _, n in rows)
+        T = int(pad_to_tokens) if pad_to_tokens else max(t_real, 1)
+        B = int(pad_to_rows) if pad_to_rows else max(n_real, 1)
+        if t_real > T or n_real > B:
+            raise ValueError(
+                f"plan_step: {t_real} tokens / {n_real} rows exceed "
+                f"padded shape ({T}, {B})")
+        i32 = np.int32
+        positions = np.zeros((T,), i32)
+        token_seq = np.zeros((T,), i32)
+        chunk_pos = np.zeros((T,), i32)
+        tok_valid = np.zeros((T,), np.float32)
+        slot_ids = np.zeros((B,), i32)
+        row_end = np.zeros((B,), i32)
+        row_len = np.zeros((B,), i32)
+        out_idx = np.zeros((B,), i32)
+        off = 0
+        for r, (sid, n) in enumerate(rows):
+            n = int(n)
+            start = self._len[sid]
+            positions[off:off + n] = start + np.arange(n, dtype=i32)
+            token_seq[off:off + n] = r
+            chunk_pos[off:off + n] = np.arange(n, dtype=i32)
+            tok_valid[off:off + n] = 1.0
+            slot_ids[r] = self._slot[sid]
+            row_len[r] = n
+            off += n
+            row_end[r] = off
+            out_idx[r] = off - 1
+        return {"positions": positions, "token_seq": token_seq,
+                "chunk_pos": chunk_pos, "tok_valid": tok_valid,
+                "slot_ids": slot_ids, "row_end": row_end,
+                "row_len": row_len, "out_idx": out_idx,
+                "n_rows": n_real}
+
+
+class HybridChainHandle:
+    """Handoff unit of the hybrid strategy: the paged sub-chain (page
+    ids) and the recurrent sub-chain (state blob) move as ONE unit.
+    Ledger fields mirror the paged chain (pages/claim/drawn are the
+    page-side numbers — the dominant, length-proportional cost);
+    `state_bytes` rides from the recurrent side."""
+
+    __slots__ = ("chain_id", "pages", "length", "drawn", "claim",
+                 "consumed", "request_id", "t_export", "draft_chain",
+                 "paged_chain", "rec_chain", "state_bytes")
+
+    strategy = "hybrid"
+
+    def __init__(self, paged_chain, rec_chain):
+        self.chain_id = next(_CHAIN_IDS)
+        self.pages = paged_chain.pages
+        self.length = paged_chain.length
+        self.drawn = paged_chain.drawn
+        self.claim = paged_chain.claim
+        self.consumed = False
+        self.request_id = None
+        self.t_export = None
+        self.draft_chain = None
+        self.paged_chain = paged_chain
+        self.rec_chain = rec_chain
+        self.state_bytes = rec_chain.state_bytes
+
+
+class HybridCache:
+    """Both ledgers at once for models interleaving SSM and attention
+    layers: a PagedKVCache over the ATTENTION layers and a
+    RecurrentStateCache over the SSM layers, admitted together (a
+    sequence needs its worst-case pages AND one state slot), exported
+    together (HybridChainHandle), freed together. One lock object
+    covers the pair — the engine's lock discipline (plan through
+    donated-pool swap) spans both pools in one acquire.
+
+    Admission accounting is page-denominated (the length-proportional
+    side dominates and keeps the router's page math meaningful); the
+    slot side is a secondary gate in can_allocate. With
+    n_slots = n_pages - 1 the slot pool can never be the binding
+    constraint before pages are, so the page-only outstanding_claims
+    stays a safe reservation bound. The prefix surface is inert: KV
+    pages at a prefix boundary are addressable but the SSM state there
+    was never saved, so a hybrid prefix hit cannot be honored."""
+
+    strategy = "hybrid"
+
+    def __init__(self, paged, recurrent):
+        self.paged = paged
+        self.recurrent = recurrent
+        self.lock = paged.lock
+        self.recurrent.lock = paged.lock   # one lock for the pair
+        self.n_pages = paged.n_pages
+        self.page_size = paged.page_size
+
+    def exec_signature(self):
+        return (("hybrid", self.paged.n_pages, self.paged.page_size,
+                 str(self.paged.k[0].dtype) if self.paged.k
+                 else "poisoned")
+                + self.recurrent.exec_signature())
+
+    # ---- allocator / ledger ------------------------------------------
+    def add_sequence(self, seq_id):
+        self.paged.add_sequence(seq_id)
+        try:
+            self.recurrent.add_sequence(seq_id)
+        except Exception:
+            self.paged.free_sequence(seq_id)
+            raise
+
+    def free_sequence(self, seq_id):
+        self.paged.free_sequence(seq_id)
+        self.recurrent.free_sequence(seq_id)
+
+    def length(self, seq_id):
+        return self.paged.length(seq_id)
+
+    def advance(self, seq_id, n_tokens):
+        self.paged.advance(seq_id, n_tokens)
+        self.recurrent.advance(seq_id, n_tokens)
+
+    def rollback(self, seq_id, n_tokens):
+        # the paged half could rewind, the recurrent half cannot —
+        # the pair inherits the stricter contract
+        self.recurrent.rollback(seq_id, n_tokens)
+
+    def pages_needed(self, n_tokens):
+        return self.paged.pages_needed(n_tokens)
+
+    def pages_held(self, seq_id):
+        return self.paged.pages_held(seq_id)
+
+    def n_free_pages(self):
+        return self.paged.n_free_pages()
+
+    def n_evictable_pages(self):
+        return self.paged.n_evictable_pages()
+
+    def shared_page_count(self):
+        return self.paged.shared_page_count()
+
+    def can_allocate(self, n_tokens, reserved=0):
+        return (self.paged.can_allocate(n_tokens, reserved=reserved)
+                and self.recurrent.can_allocate(n_tokens))
+
+    def set_claim(self, seq_id, n_pages):
+        self.paged.set_claim(seq_id, n_pages)
+
+    def outstanding_claims(self):
+        return self.paged.outstanding_claims()
+
+    # ---- prefix cache (inert — see class doc) ------------------------
+    def match_prefix(self, token_ids, max_tokens=None):
+        return 0, 0
+
+    def match_prefix_credit(self, token_ids, max_tokens=None):
+        return 0, 0, 0
+
+    def acquire_prefix(self, seq_id, token_ids, max_tokens=None):
+        return 0
+
+    def register_prefix(self, seq_id, token_ids):
+        return None
+
+    # ---- chain handoff -----------------------------------------------
+    def export_chain(self, seq_id):
+        pc = self.paged.export_chain(seq_id)
+        rc = self.recurrent.export_chain(seq_id)
+        return HybridChainHandle(pc, rc)
+
+    def adopt_chain(self, seq_id, chain):
+        if chain.consumed:
+            raise ValueError("adopt_chain: chain handle already "
+                             "consumed (adopted or released)")
+        n = self.paged.adopt_chain(seq_id, chain.paged_chain)
+        self.recurrent.adopt_chain(seq_id, chain.rec_chain)
+        chain.consumed = True
+        return n
+
+    def release_chain(self, chain):
+        if chain.consumed:
+            return
+        chain.consumed = True
+        self.paged.release_chain(chain.paged_chain)
+        self.recurrent.release_chain(chain.rec_chain)
+
+    # ---- serving-step plans ------------------------------------------
+    def plan_ragged(self, rows, pad_to_tokens=None, pad_to_rows=None,
+                    q_heads=None):
+        return self.paged.plan_ragged(rows, pad_to_tokens=pad_to_tokens,
+                                      pad_to_rows=pad_to_rows,
+                                      q_heads=q_heads)
+
+    def plan_step(self, rows, pad_to_tokens=None, pad_to_rows=None):
+        return self.recurrent.plan_step(rows,
+                                        pad_to_tokens=pad_to_tokens,
+                                        pad_to_rows=pad_to_rows)
+
+    # ---- telemetry ----------------------------------------------------
+    def pool_stats(self):
+        """Paged pool snapshot plus the slot/state gauges and the
+        hybrid strategy stamp — the schema's hybrid branch = paged
+        rules + state_bytes > 0."""
+        stats = self.paged.pool_stats()
+        rec = self.recurrent.pool_stats()
+        stats["cache_strategy"] = "hybrid"
+        stats["n_slots"] = rec["n_slots"]
+        stats["free_slots"] = rec["free_slots"]
+        stats["held_slots"] = rec["held_slots"]
+        stats["state_bytes"] = rec["state_bytes"]
+        stats["state_bytes_total"] = rec["state_bytes_total"]
+        return stats

@@ -378,6 +378,10 @@ class ServingRouter:
                 from_engine=pre.name, pages_moved=pages_moved,
                 chain_tokens=chain_tokens,
                 page_size=int(pre.cache.page_size),
+                # what the chain moved: page ids, one state blob, or
+                # both (inference/cache_strategy.py handle duck type)
+                cache_strategy=str(getattr(chain, "strategy", "paged")),
+                state_bytes=int(getattr(chain, "state_bytes", 0)),
                 request_id=getattr(seq.handle.trace, "request_id",
                                    None))
         return dispatch

@@ -18,16 +18,18 @@ a full queue raises `QueueFullError` immediately (callers shed load
 instead of timing out), per-request deadlines expire in-queue, and
 `drain()`/`shutdown()` finish in-flight work before stopping.
 
-**GenerationEngine** — autoregressive decode over `GPTForCausalLM` +
-`PagedKVCache`: continuous batching in the vLLM/Ragged-Paged-Attention
-sense (see PAPERS.md). New requests prefill into free page-table slots
-between decode steps, every decode step advances ALL in-flight
-sequences by one token in a single fixed-shape jitted program (the
-batch is padded to a power-of-two bucket with rows that write to the
-reserved pad page, so admit/evict never changes the compiled shape),
-finished sequences (eos / max_new_tokens) are evicted without stalling
-their neighbors, and tokens stream back per request as they are
-sampled.
+**GenerationEngine** — autoregressive decode over any model exposing
+the paged-decode surface (`GPTForCausalLM`, `SSMForCausalLM`) and any
+cache strategy behind `inference/cache_strategy.py` (`PagedKVCache` kv
+pages, `RecurrentStateCache` fixed-size state slots, `HybridCache`
+both): continuous batching in the vLLM/Ragged-Paged-Attention sense
+(see PAPERS.md). New requests prefill into free cache slots between
+decode steps, every decode step advances ALL in-flight sequences by
+one token in a single fixed-shape jitted program (the batch is padded
+to a power-of-two bucket with rows that target the reserved pad slot,
+so admit/evict never changes the compiled shape), finished sequences
+(eos / max_new_tokens) are evicted without stalling their neighbors,
+and tokens stream back per request as they are sampled.
 
 Both report into `profiler/monitor`:
 
@@ -112,6 +114,7 @@ from ..framework.core import Tensor
 from ..profiler import monitor as _monitor
 from ..profiler import serve_observatory as _obs
 from ..profiler import statistic as _stat
+from .cache_strategy import strategy_of
 from .speculative import accept_length
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceeded",
@@ -1072,8 +1075,14 @@ class _ActiveSeq:
 
 
 class GenerationEngine(_SchedulerLifecycle):
-    """Continuous-batching autoregressive serving over a shared
-    `PagedKVCache`.
+    """Continuous-batching autoregressive serving over a shared decode
+    cache — any strategy behind the `inference/cache_strategy.py`
+    interface: a `PagedKVCache` of kv pages (attention models), a
+    `RecurrentStateCache` of fixed-size state slots (SSM models,
+    models/ssm.py — O(1) admission cost per sequence), or a
+    `HybridCache` pairing both for interleaved SSM/attention stacks.
+    The engine never branches on the strategy: admission, planning,
+    telemetry, and handoff all go through the cache's own ledger.
 
         engine = GenerationEngine(model, n_pages=256, max_batch=8,
                                   eos_token_id=50256)
@@ -1082,7 +1091,7 @@ class GenerationEngine(_SchedulerLifecycle):
         full = h.result()               # np.int64 [n_generated]
 
     With `ragged=True` (the default whenever the model implements
-    `paged_ragged_step`, e.g. GPTForCausalLM) every scheduler iteration
+    `paged_ragged_step` — GPTForCausalLM, SSMForCausalLM) every scheduler iteration
     runs ONE jitted step over the Pallas ragged kernel
     (ops/pallas/paged_attention.py) carrying mixed rows: each active
     sequence's decode token AND up to `prefill_chunk` tokens of queued
@@ -1138,6 +1147,10 @@ class GenerationEngine(_SchedulerLifecycle):
         self.model = model
         self.cache = cache if cache is not None else \
             model.make_paged_cache(n_pages, page_size)
+        # "paged" | "recurrent" | "hybrid" — stamped on every serve /
+        # request / kvcache / journey record this engine emits, and the
+        # schema's strategy-conditional rules key on it
+        self.cache_strategy = strategy_of(self.cache)
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.default_max_new = int(max_new_tokens)
@@ -1167,6 +1180,14 @@ class GenerationEngine(_SchedulerLifecycle):
                     "speculative decoding needs the ragged engine "
                     "path — the verify row rides the mixed "
                     "prefill/decode step")
+            if self.cache_strategy != "paged":
+                # rejecting a mispredicted draft run rewinds the kv
+                # length cursor; a recurrent state blob has no past to
+                # rewind to (cache.rollback raises for the same reason)
+                raise ValueError(
+                    "speculative decoding requires the paged cache "
+                    f"strategy (engine cache is {self.cache_strategy!r})"
+                    " — recurrent decode state is not rewindable")
             if not hasattr(speculative.draft_model, "paged_ragged_step"):
                 raise TypeError(
                     "SpeculativeConfig.draft_model needs "
@@ -1305,6 +1326,7 @@ class GenerationEngine(_SchedulerLifecycle):
             deadline_s=None if deadline_ms is None
             else float(deadline_ms) / 1000.0)
         handle.request_id = handle.trace.request_id
+        handle.trace.cache_strategy = self.cache_strategy
         if slo_class is not None:
             handle.trace.slo_class = str(slo_class)
         if router is not None:
@@ -1606,6 +1628,7 @@ class GenerationEngine(_SchedulerLifecycle):
             new_trace.request_id = old_trace.request_id
             new_trace.t_submit = old_trace.t_submit
             new_trace.slo_class = old_trace.slo_class
+            new_trace.cache_strategy = self.cache_strategy
             new_trace.prefix_hit_tokens = old_trace.prefix_hit_tokens
             new_trace.generated_tokens = len(generated)
             # speculation counts survive the handoff split: the decode
@@ -1816,6 +1839,7 @@ class GenerationEngine(_SchedulerLifecycle):
         _monitor.export_step(
             {"engine": self.name, "requests": b, "batch_size": b,
              "bucket_batch": int(pad_to),
+             "cache_strategy": self.cache_strategy,
              "queue_depth": len(self._pending),  # lint-ok[unlocked-shared-state]: GIL-atomic len() in the loop thread's telemetry export; worst case one submit of staleness
              "pad_tokens": int(pad_to - b),
              "pad_token_fraction": max(0.0, 1.0 - useful / computed),
@@ -2133,13 +2157,20 @@ class GenerationEngine(_SchedulerLifecycle):
         # predicate), so the only waste is the intra-page remainder.
         # ragged_work_plan is the kernel's own work formula: the
         # metric and the in-kernel counter cannot diverge
-        from ..ops.pallas.paged_attention import ragged_work_plan
-        P = self.cache.page_size
-        bounds = np.concatenate(
-            [self.cache.length(sid) + np.arange(1, len(toks) + 1)
-             for sid, toks in rows])
-        computed = int(ragged_work_plan(bounds, P).sum()) * P
-        useful = int(bounds.sum())
+        if self.cache_strategy == "recurrent":
+            # no kv pages to walk: the scan kernel's time loop runs
+            # pad_t constant-cost state updates, of which t_real are
+            # real tokens — THAT is the strategy's pad overhead
+            computed = int(pad_t)
+            useful = int(t_real)
+        else:
+            from ..ops.pallas.paged_attention import ragged_work_plan
+            P = self.cache.page_size
+            bounds = np.concatenate(
+                [self.cache.length(sid) + np.arange(1, len(toks) + 1)
+                 for sid, toks in rows])
+            computed = int(ragged_work_plan(bounds, P).sum()) * P
+            useful = int(bounds.sum())
         self._attn_computed += computed  # lint-ok[unlocked-shared-state]: loop-thread-owned monotonic counter (ragged site), same contract as the bucketed decode site
         self._attn_useful += useful  # lint-ok[unlocked-shared-state]: paired with _attn_computed above — same single-writer telemetry counter
         # per-row sampling config, [pad_b]-shaped like the row axis so
@@ -2185,6 +2216,7 @@ class GenerationEngine(_SchedulerLifecycle):
         hits, self._step_prefix_hits = self._step_prefix_hits, 0
         rec = {"engine": self.name, "requests": b_real,
                "batch_size": b_real, "bucket_batch": int(pad_b),
+               "cache_strategy": self.cache_strategy,
                "queue_depth": len(self._pending),
                # pad SLOTS exist (pad_t - t_real) but carry bound 0: the
                # kernel computes zero attention blocks for them, so the
@@ -2327,11 +2359,17 @@ class GenerationEngine(_SchedulerLifecycle):
         admittable = max(free + evictable - outstanding, 0)
         ttft = _monitor.get_metric("serve.ttft_s")
         tpot = _monitor.get_metric("serve.tpot_s")
-        return {
+        rep = {
             "engine": self.name, "stopping": stopping,
             "queue_depth": pending, "max_queue": int(self.max_queue),
             "active": len(seqs), "max_batch": self.max_batch,
             "slots_free": max(self.max_batch - len(seqs), 0),
+            # strategy-appropriate capacity: for the recurrent strategy
+            # the cache's page surface counts fixed-size STATE SLOTS
+            # (pages_needed == 1 per sequence), so admittable_pages is
+            # admittable sequences — the router's ranking math holds
+            # unchanged
+            "cache_strategy": self.cache_strategy,
             "free_pages": free, "evictable_pages": evictable,
             "reserved_pages": outstanding,
             "admittable_pages": admittable,
@@ -2349,6 +2387,16 @@ class GenerationEngine(_SchedulerLifecycle):
             "accept_rate": (self._spec_accepted / self._spec_proposed)
             if self._spec_proposed else 0.0,
         }
+        if self.cache_strategy != "paged":
+            # state-slot capacity gauges (RecurrentStateCache /
+            # HybridCache pool_stats) — what "memory headroom" means
+            # when sequences cost one constant blob each
+            stats = self.cache.pool_stats()
+            rep["state_bytes"] = stats["state_bytes"]
+            rep["state_bytes_total"] = stats["state_bytes_total"]
+            rep["free_slots"] = stats["free_slots"]
+            rep["held_slots"] = stats["held_slots"]
+        return rep
 
     def observatory_snapshot(self):
         """What a debug bundle records for this engine: the admission
@@ -2384,9 +2432,15 @@ class GenerationEngine(_SchedulerLifecycle):
         max_new = self.default_max_new if max_new_tokens is None \
             else int(max_new_tokens)
         P = self.cache.page_size
-
-        def width(tokens):  # table width bucket once `tokens` are held
-            return self._pow2(-(-tokens // P))
+        if self.cache_strategy == "recurrent":
+            # fixed-size state slots: no page table, so the step's
+            # width coordinate is constant — length never changes the
+            # compiled signature (the strategy's whole point)
+            def width(tokens):
+                return 1
+        else:
+            def width(tokens):  # table width bucket once tokens held
+                return self._pow2(-(-tokens // P))
 
         # every token bucket floors at MIN_Q_TOKENS — the same rule
         # _ragged_step pads with, so short chunks, prefix-hit

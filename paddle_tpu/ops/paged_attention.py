@@ -132,6 +132,11 @@ class KVChainHandle:
     __slots__ = ("chain_id", "pages", "length", "drawn", "claim",
                  "consumed", "request_id", "t_export", "draft_chain")
 
+    # cache-strategy stamp (inference/cache_strategy.py duck type):
+    # journey/route records carry it, and the recurrent/hybrid handles
+    # override it
+    strategy = "paged"
+
     def __init__(self, pages, length, drawn, claim):
         self.chain_id = next(_CHAIN_IDS)
         self.pages = pages
@@ -164,6 +169,11 @@ class PagedKVCache:
     shares prompt pages across sequences (and retains them LRU past
     their sequence), copy-on-write materializes a private page before
     any write to a shared one."""
+
+    # strategy stamp consumed by inference/cache_strategy.strategy_of
+    # (the serving engine/schema key on it); the recurrent and hybrid
+    # caches override it
+    strategy = "paged"
 
     def __init__(self, n_layers, n_pages, page_size, n_heads, head_dim,
                  dtype=jnp.float32):
@@ -605,6 +615,7 @@ class PagedKVCache:
             refcounts[r] = refcounts.get(r, 0) + 1
         reg_pages = {info["page"] for info in chain}
         return {
+            "cache_strategy": "paged",
             "n_pages": int(self.n_pages),
             "page_size": int(self.page_size),
             "free_pages": len(self._free),

@@ -1,0 +1,158 @@
+"""Ragged selective-scan (Mamba SSM) Pallas kernel for TPU.
+
+The recurrent twin of paged_attention.py (PAPERS.md "Compiler-First
+State Space Duality and Portable O(1) Autoregressive Caching"): ONE
+kernel call advances a batch of tokens whose rows belong to DIFFERENT
+sequences — decode rows (one token) and prefill-chunk rows (a slice of
+a prompt) mix freely in the same fixed-shape [T] token budget the
+ragged attention step uses. Instead of walking kv pages, each token
+updates its row's FIXED-SIZE state matrix h in [R, D, N] carried
+through the scan:
+
+    h_t = exp(dt_t * A) * h_{t-1} + (dt_t * B_t) * x_t
+    y_t = sum_N(h_t * C_t)
+
+Ragged-batch mechanics:
+
+- `token_seq[t]` names the state row token t belongs to; consecutive
+  tokens of one row form its prefill chunk, scanned in order because
+  the time loop is sequential anyway — no per-row segmentation needed.
+- PAD tokens are neutralized by CONSTRUCTION, not masking: the caller
+  zeroes `dt` on pads, so exp(0*A) = 1 and (0*B)*x = 0 — an identity
+  state update. Pads may point at any row (slot 0 by convention)
+  without corrupting it, which keeps the kernel free of a validity
+  operand.
+- the row select/merge uses a one-hot compare over the R rows instead
+  of dynamic gather/scatter on the state: R is the serving batch width
+  (small), and the compare vectorizes where a dynamic index would
+  serialize through scalar memory.
+
+The grid tiles the channel dimension D; B/C/token_seq are broadcast to
+every tile and the [R, bd, N] state slab rides VMEM for the whole time
+loop. Shapes depend only on (T, R, D, N), so a serving executable
+keyed on the fixed-shape step signature stays one executable. On CPU
+(tier-1) the same kernel runs in Pallas interpret mode, so the serving
+engine exercises identical code on every backend.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .common import I0
+from . import attention_core as core
+
+__all__ = ["ssm_scan", "selective_scan_reference", "choose_d_block"]
+
+
+def choose_d_block(d_inner, cap=256):
+    """Channels per grid tile: largest divisor of `d_inner` at most
+    `cap`, by halving (the model rounds d_inner to powers of two, so
+    buckets land on `cap` exactly). One tile holds [R, bd, N] state +
+    [T, bd] activations in VMEM — bd=256 with N=16, R<=8 f32 is ~a few
+    hundred KB, far under budget."""
+    bd = max(int(d_inner), 1)
+    cap = max(int(cap), 1)
+    while bd > cap and bd % 2 == 0:
+        bd //= 2
+    return bd
+
+
+def _scan_kernel(x_ref, dt_ref, b_ref, c_ref, a_ref, seq_ref, h0_ref,
+                 y_ref, h_out_ref, *, n_tokens):
+    n_rows = h0_ref.shape[0]
+    a = a_ref[:].astype(jnp.float32)               # [bd, N]
+    h_init = h0_ref[:].astype(jnp.float32)         # [R, bd, N]
+
+    def step(t, h):
+        x_t = x_ref[pl.ds(t, 1), :].astype(jnp.float32)[0]    # [bd]
+        dt_t = dt_ref[pl.ds(t, 1), :].astype(jnp.float32)[0]  # [bd]
+        b_t = b_ref[pl.ds(t, 1), :].astype(jnp.float32)       # [1, N]
+        c_t = c_ref[pl.ds(t, 1), :].astype(jnp.float32)       # [1, N]
+        row = seq_ref[pl.ds(t, 1), :][0, 0]
+        sel = (jax.lax.broadcasted_iota(jnp.int32, (n_rows, 1, 1), 0)
+               == row)                                        # [R,1,1]
+        h_row = jnp.sum(jnp.where(sel, h, jnp.float32(0.0)), axis=0)
+        da = jnp.exp(dt_t[:, None] * a)                       # [bd, N]
+        dbx = (dt_t * x_t)[:, None] * b_t                     # [bd, N]
+        h_new = da * h_row + dbx
+        y_t = jnp.sum(h_new * c_t, axis=-1)                   # [bd]
+        y_ref[pl.ds(t, 1), :] = y_t[None, :].astype(y_ref.dtype)
+        return jnp.where(sel, h_new[None, :, :], h)
+
+    h_fin = jax.lax.fori_loop(0, n_tokens, step, h_init)
+    h_out_ref[:] = h_fin.astype(h_out_ref.dtype)
+
+
+def ssm_scan(x, dt, b, c, a, h0, token_seq, interpret=None):
+    """Ragged selective scan over a fixed-shape token batch.
+
+    Args:
+        x [T, D]        post-conv activations (f32)
+        dt [T, D]       softplus'd step sizes; MUST be zero on pad
+                        tokens (identity update — see module doc)
+        b [T, N]        input-projection coefficients B_t
+        c [T, N]        output-projection coefficients C_t
+        a [D, N]        state matrix A (negative; -exp(A_log))
+        h0 [R, D, N]    per-row initial states (row 0 = pad slot)
+        token_seq [T]   int32 owning row per token
+        interpret       None = interpret everywhere but real TPU
+
+    Returns (y [T, D], h_out [R, D, N]): per-token outputs
+    y_t = sum_N(h_t * C_t) and every row's final state.
+    """
+    interpret = core.default_interpret(interpret)
+    T, D = x.shape
+    R, _, N = h0.shape
+    bd = choose_d_block(D)
+    seq2d = token_seq.astype(jnp.int32).reshape(T, 1)
+    y, h_out = pl.pallas_call(
+        functools.partial(_scan_kernel, n_tokens=T),
+        grid=(D // bd,),
+        in_specs=[
+            pl.BlockSpec((T, bd), lambda j: (I0, j)),
+            pl.BlockSpec((T, bd), lambda j: (I0, j)),
+            pl.BlockSpec((T, N), lambda j: (I0, I0)),
+            pl.BlockSpec((T, N), lambda j: (I0, I0)),
+            pl.BlockSpec((bd, N), lambda j: (j, I0)),
+            # [T, 1]: 1D partial blocks trip XLA/Mosaic layout
+            # disagreements on TPU; a trailing unit dim satisfies tiling
+            pl.BlockSpec((T, 1), lambda j: (I0, I0)),
+            pl.BlockSpec((R, bd, N), lambda j: (I0, j, I0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((T, bd), lambda j: (I0, j)),
+            pl.BlockSpec((R, bd, N), lambda j: (I0, j, I0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, D), x.dtype),
+            jax.ShapeDtypeStruct((R, D, N), h0.dtype),
+        ],
+        interpret=interpret,
+    )(x, dt, b, c, a, seq2d, h0)
+    return y, h_out
+
+
+def selective_scan_reference(x, dt, b, c, a, h0, token_seq):
+    """Pure-jnp twin of `ssm_scan` (same ragged contract, same
+    pad-by-zero-dt convention) — the equality oracle the kernel tests
+    diff against, and nothing else imports it."""
+    T, D = x.shape
+    R = h0.shape[0]
+
+    def step(h, inputs):
+        x_t, dt_t, b_t, c_t, row = inputs
+        sel = (jnp.arange(R, dtype=jnp.int32) == row)[:, None, None]
+        h_row = jnp.sum(jnp.where(sel, h, jnp.float32(0.0)), axis=0)
+        h_new = (jnp.exp(dt_t[:, None] * a) * h_row
+                 + (dt_t * x_t)[:, None] * b_t[None, :])
+        y_t = jnp.sum(h_new * c_t[None, :], axis=-1)
+        return jnp.where(sel, h_new[None], h), y_t
+
+    h_fin, ys = jax.lax.scan(
+        step, h0.astype(jnp.float32),
+        (x.astype(jnp.float32), dt.astype(jnp.float32),
+         b.astype(jnp.float32), c.astype(jnp.float32),
+         token_seq.astype(jnp.int32)))
+    return ys.astype(x.dtype), h_fin.astype(h0.dtype)

@@ -87,7 +87,8 @@ class Journey:
 
     __slots__ = ("request_id", "router", "slo_class", "prefill_engine",
                  "decode_engine", "prompt_tokens", "pages_moved",
-                 "chain_tokens", "page_size", "deadline_s", "t_submit",
+                 "chain_tokens", "page_size", "cache_strategy",
+                 "state_bytes", "deadline_s", "t_submit",
                  "t_admit", "t_first", "t_export", "t_adopt", "done")
 
     def __init__(self, handle, prefill_trace, decode_engine, chain,
@@ -98,9 +99,14 @@ class Journey:
         self.prefill_engine = prefill_trace.engine
         self.decode_engine = str(decode_engine)
         self.prompt_tokens = int(prefill_trace.prompt_tokens)
+        # what the handoff MOVED, in the chain's own currency: kv page
+        # ids for a paged chain, one fixed-size state blob (pages == (),
+        # state_bytes > 0) for a recurrent one, both for hybrid
         self.pages_moved = len(chain.pages)
         self.chain_tokens = int(chain.length)
         self.page_size = int(page_size)
+        self.cache_strategy = str(getattr(chain, "strategy", "paged"))
+        self.state_bytes = int(getattr(chain, "state_bytes", 0))
         self.deadline_s = prefill_trace.deadline_s
         # measured boundary stamps (perf_counter), straight off the
         # prefill trace and the chain — the handoff gap is
@@ -163,6 +169,8 @@ class Journey:
             "pages_moved": self.pages_moved,
             "chain_tokens": self.chain_tokens,
             "page_size": self.page_size,
+            "cache_strategy": self.cache_strategy,
+            "state_bytes": self.state_bytes,
             "queue_s": round(adm - sub, 6),
             "prefill_s": round(exp - adm, 6),
             "handoff_gap_s": round(ado - exp, 6),

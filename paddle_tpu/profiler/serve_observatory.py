@@ -94,7 +94,8 @@ class RequestTrace:
                  "generated_tokens", "prefill_chunks", "peak_pages_held",
                  "proposed_tokens", "accepted_tokens",
                  "t_submit", "t_admit", "t_first", "done",
-                 "slo_class", "handoff_of", "journey")
+                 "slo_class", "handoff_of", "journey",
+                 "cache_strategy")
 
     def __init__(self, engine, rows=1, prompt_tokens=0,
                  max_new_tokens=None, deadline_s=None):
@@ -121,6 +122,7 @@ class RequestTrace:
         self.handoff_of = None  # the OTHER engine of a handed-off pair
         self.journey = None     # fleet_observatory.Journey (decode side
         #                         of a handoff; emits at terminal)
+        self.cache_strategy = "paged"  # engine-stamped at submit/adopt
 
     # -- lifecycle marks (engine loop; pure host arithmetic) -----------
     def admitted(self):
@@ -193,6 +195,7 @@ class RequestTrace:
             "kind": "request",
             "engine": self.engine,
             "request_id": self.request_id,
+            "cache_strategy": str(self.cache_strategy),
             "outcome": outcome,
             "rows": self.rows,
             "prompt_tokens": self.prompt_tokens,

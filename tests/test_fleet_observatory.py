@@ -295,10 +295,28 @@ class TestJourneySchema:
         (_journey_rec(request_id=""), "request_id"),
         (_journey_rec(deadline_met="yes"), "deadline_met"),
         (_journey_rec(generated_tokens=-1), "generated_tokens"),
+        # strategy-conditional payload rules (cache_strategy enum)
+        (_journey_rec(cache_strategy="magnetic"), "cache_strategy"),
+        # a recurrent chain is ONE state blob: pages never move
+        (_journey_rec(cache_strategy="recurrent", pages_moved=2,
+                      state_bytes=4096), "state blob"),
+        # ... and the blob must have size
+        (_journey_rec(cache_strategy="recurrent", pages_moved=0,
+                      state_bytes=0), "state_bytes"),
+        # hybrid moves pages AND a blob — zero blob bytes is a lie
+        (_journey_rec(cache_strategy="hybrid", state_bytes=0),
+         "state_bytes"),
+        # absent cache_strategy means paged: the ceil rule still bites
+        (_journey_rec(pages_moved=5), "reconcile"),
     ])
     def test_rejects_bad_records(self, bad, needle):
         errs = _validate(bad)
         assert errs and any(needle in e for e in errs), (errs, needle)
+
+    def test_recurrent_journey_passes(self):
+        rec = _journey_rec(cache_strategy="recurrent", pages_moved=0,
+                           state_bytes=4096)
+        assert _validate(rec) == []
 
 
 class TestFleetSchema:

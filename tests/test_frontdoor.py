@@ -669,6 +669,18 @@ class TestRouteSchema:
                        request_id="e1-r0"),
             _route_rec(prefix_affinity=True, prefix_match_pages=2,
                        deadline_ms=5000.0),
+            # a recurrent handoff moves ONE state blob, zero pages
+            _route_rec(outcome="handoff", engine="e2",
+                       from_engine="e1", pages_moved=0,
+                       chain_tokens=9, page_size=4,
+                       cache_strategy="recurrent", state_bytes=4096,
+                       request_id="e1-r1"),
+            # a hybrid handoff moves pages AND the SSM half's blob
+            _route_rec(outcome="handoff", engine="e2",
+                       from_engine="e1", pages_moved=3,
+                       chain_tokens=9, page_size=4,
+                       cache_strategy="hybrid", state_bytes=4096,
+                       request_id="e1-r2"),
         ]
         for rec in good:
             assert cms.validate_line(json.dumps(rec)) == []
@@ -688,6 +700,22 @@ class TestRouteSchema:
          "pages_moved"),
         (_route_rec(prefix_affinity="yes"), "prefix_affinity"),
         (_route_rec(deadline_ms=-5), "deadline_ms"),
+        (_route_rec(cache_strategy="magnetic"), "cache_strategy"),
+        # recurrent: pages crossing the wire means the strategy lied
+        (_route_rec(outcome="handoff", engine="e2", from_engine="e1",
+                    pages_moved=3, chain_tokens=9, page_size=4,
+                    cache_strategy="recurrent", state_bytes=4096),
+         "state blob"),
+        # recurrent: a zero-byte blob carried nothing
+        (_route_rec(outcome="handoff", engine="e2", from_engine="e1",
+                    pages_moved=0, chain_tokens=9, page_size=4,
+                    cache_strategy="recurrent", state_bytes=0),
+         "state_bytes"),
+        # hybrid still reconciles its page half
+        (_route_rec(outcome="handoff", engine="e2", from_engine="e1",
+                    pages_moved=5, chain_tokens=9, page_size=4,
+                    cache_strategy="hybrid", state_bytes=4096),
+         "reconcile"),
     ])
     def test_rejects_bad_records(self, bad, needle):
         errs = cms.validate_line(json.dumps(bad))

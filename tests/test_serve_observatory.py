@@ -527,6 +527,25 @@ def test_request_and_kvcache_schema_accept_and_reject():
     assert bad(ok_kv, shared_pages=3)            # > held_pages
     assert bad(ok_kv, evictable_pages=2)         # > registered_pages
     assert bad(ok_kv, refcounts={"1": -1})
+
+    # strategy-dispatched snapshots (inference/cache_strategy.py)
+    ok_rec = {"ts": 1.0, "rank": 0, "kind": "kvcache", "engine": "s",
+              "cache_strategy": "recurrent", "n_slots": 7,
+              "free_slots": 6, "held_slots": 1, "sequences": 1,
+              "slots_drawn": 2, "state_bytes": 4096,
+              "state_bytes_total": 28672}
+    assert cms.validate_line(json.dumps(ok_rec)) == []
+    assert bad(ok_rec, cache_strategy="magnetic")
+    assert bad(ok_rec, state_bytes=0)            # the blob IS the cache
+    assert bad(ok_rec, free_slots=7)             # free + held > n_slots
+    assert bad(ok_rec, held_pages=3)             # page gauge on recurrent
+    ok_hyb = dict(ok_kv, cache_strategy="hybrid", n_slots=7,
+                  free_slots=6, held_slots=1, state_bytes=4096,
+                  state_bytes_total=28672)
+    assert cms.validate_line(json.dumps(ok_hyb)) == []
+    assert bad(ok_hyb, state_bytes=0)
+    hyb_missing = {k: v for k, v in ok_hyb.items() if k != "n_slots"}
+    assert cms.validate_line(json.dumps(hyb_missing))
     # engine is REQUIRED on serve records now
     assert cms.validate_line(json.dumps(
         {"ts": 1, "rank": 0, "kind": "serve", "requests": 1,

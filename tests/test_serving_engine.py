@@ -710,14 +710,72 @@ class TestPredictorIO:
 
 # -- generation: continuous batching == single-sequence decode ----------
 
-def _tiny_lm(seed=0):
-    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
-    paddle.seed(seed)
-    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                    num_heads=4, max_position_embeddings=64, dropout=0.0)
-    m = GPTForCausalLM(cfg)
-    m.eval()
+# Compiled executables cache on the MODEL instance (the engine's jit
+# functions key off it), and the persistent disk compile cache is OFF
+# under tests (conftest) — so reusing one tiny model per (kind, seed)
+# across the battery turns ~4-10s of per-test recompiles into a
+# one-time cost. Tests that assert COLD-compile behavior (the retrace
+# counter) pass fresh=True.
+_MODEL_CACHE = {}
+
+
+def _cached_model(key, build, fresh):
+    if fresh:
+        return build()
+    m = _MODEL_CACHE.get(key)
+    if m is None:
+        m = _MODEL_CACHE[key] = build()
     return m
+
+
+def _tiny_lm(seed=0, fresh=False):
+    from paddle_tpu.models.gpt import GPTForCausalLM, GPTConfig
+
+    def build():
+        paddle.seed(seed)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position_embeddings=64,
+                        dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m
+
+    return _cached_model(("gpt", seed), build, fresh)
+
+
+def _tiny_ssm(seed=0, hybrid=False, fresh=False):
+    """SSM twin of _tiny_lm: same vocab/context budget so every engine
+    test (context-limit rejection included) runs unchanged."""
+    from paddle_tpu.models.ssm import SSMForCausalLM, SSMConfig
+
+    def build():
+        paddle.seed(seed)
+        cfg = SSMConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        d_state=8, d_conv=4, expand=2,
+                        max_position_embeddings=64,
+                        attn_every=2 if hybrid else 0,
+                        num_heads=4 if hybrid else 0)
+        m = SSMForCausalLM(cfg)
+        m.eval()
+        return m
+
+    return _cached_model(("ssm", hybrid, seed), build, fresh)
+
+
+@pytest.fixture(params=["paged", "recurrent", "hybrid"])
+def lm_factory(request):
+    """Model factory per cache strategy: the engine suite's semantics
+    (equality, streaming, admit/evict, cancel) are strategy-blind."""
+    strategy = request.param
+
+    def make(seed=0, fresh=False):
+        if strategy == "paged":
+            return _tiny_lm(seed, fresh=fresh)
+        return _tiny_ssm(seed, hybrid=(strategy == "hybrid"),
+                         fresh=fresh)
+
+    make.strategy = strategy
+    return make
 
 
 def _ref_greedy(m, prompt, max_new):
@@ -737,13 +795,14 @@ def _ref_greedy(m, prompt, max_new):
 
 @pytest.mark.heavy
 class TestGenerationEngine:
-    def test_continuous_batching_equals_single_sequence_decode(self):
-        m = _tiny_lm()
+    def test_continuous_batching_equals_single_sequence_decode(
+            self, lm_factory):
+        m = lm_factory()
         rng = np.random.RandomState(0)
         prompts = [rng.randint(0, 64, (n,)) for n in (5, 3, 7)]
         refs = [_ref_greedy(m, p, 6) for p in prompts]
 
-        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+        eng = GenerationEngine(lm_factory(), n_pages=64, page_size=4,
                                max_batch=4, max_new_tokens=6)
         try:
             handles = [eng.submit(p) for p in prompts]
@@ -754,15 +813,15 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_mid_stream_admit_and_evict(self):
-        m = _tiny_lm()
+    def test_mid_stream_admit_and_evict(self, lm_factory):
+        m = lm_factory()
         rng = np.random.RandomState(1)
         p1, p2, p3 = (rng.randint(0, 64, (n,)) for n in (4, 6, 3))
         r1 = _ref_greedy(m, p1, 2)    # finishes early -> evicted
         r2 = _ref_greedy(m, p2, 10)   # keeps decoding past the evict
         r3 = _ref_greedy(m, p3, 4)    # admitted mid-stream into the slot
 
-        eng = GenerationEngine(_tiny_lm(), n_pages=64, page_size=4,
+        eng = GenerationEngine(lm_factory(), n_pages=64, page_size=4,
                                max_batch=2, max_new_tokens=10)
         try:
             h1 = eng.submit(p1, max_new_tokens=2)
@@ -777,8 +836,8 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_streaming_matches_result(self):
-        m = _tiny_lm()
+    def test_streaming_matches_result(self, lm_factory):
+        m = lm_factory()
         prompt = np.random.RandomState(2).randint(0, 64, (5,))
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
                                max_new_tokens=4)
@@ -790,8 +849,8 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_generation_rejection_and_context_limit(self):
-        m = _tiny_lm()
+    def test_generation_rejection_and_context_limit(self, lm_factory):
+        m = lm_factory()
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
                                max_queue=0, max_new_tokens=4)
         try:
@@ -807,6 +866,9 @@ class TestGenerationEngine:
             eng.shutdown()
 
     def test_never_admittable_request_rejected_at_submit(self):
+        # paged-only: a recurrent cache admits any in-context request
+        # (one slot regardless of length), so page starvation cannot
+        # make a request permanently inadmissible there.
         # 3 usable pages = 12 tokens: a request needing 5 pages could
         # never admit — it must fail the caller, not spin the scheduler
         m = _tiny_lm()
@@ -821,8 +883,8 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_generation_drain_and_stop(self):
-        m = _tiny_lm()
+    def test_generation_drain_and_stop(self, lm_factory):
+        m = lm_factory()
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
                                max_new_tokens=3)
         try:
@@ -834,8 +896,9 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_cancelled_generation_is_evicted_mid_stream(self):
-        m = _tiny_lm()
+    def test_cancelled_generation_is_evicted_mid_stream(
+            self, lm_factory):
+        m = lm_factory()
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=1,
                                max_new_tokens=40)
         try:
@@ -850,10 +913,10 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_cancelled_while_queued_skips_prefill(self):
+    def test_cancelled_while_queued_skips_prefill(self, lm_factory):
         # a request cancelled before admission must not pay the prefill
         # (nor reserve pages, nor skew serve.ttft_s)
-        m = _tiny_lm()
+        m = lm_factory()
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
                                max_new_tokens=4)
         try:
@@ -869,11 +932,12 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_generation_retraces_counted_then_stable(self):
+    def test_generation_retraces_counted_then_stable(self, lm_factory):
         # the decode program compiles on first use (counted into
         # serve.retraces via the trace-time hook) and a same-shape
-        # follow-up request adds ZERO new compiles
-        m = _tiny_lm()
+        # follow-up request adds ZERO new compiles; fresh model — a
+        # battery-cached one is already traced and would count zero
+        m = lm_factory(fresh=True)
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
                                max_new_tokens=3)
         try:
@@ -886,8 +950,9 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
-    def test_no_wait_shutdown_aborts_active_generation(self):
-        m = _tiny_lm()
+    def test_no_wait_shutdown_aborts_active_generation(
+            self, lm_factory):
+        m = lm_factory()
         eng = GenerationEngine(m, n_pages=64, page_size=4, max_batch=2,
                                max_new_tokens=50)
         h = eng.submit(np.array([1, 2, 3]))
@@ -899,6 +964,8 @@ class TestGenerationEngine:
             h.result(timeout=30)
 
     def test_admission_reserves_pages_no_mid_decode_oom(self):
+        # paged-only: recurrent slots are whole-request reservations by
+        # construction, so mid-decode OOM cannot exist there.
         # pool sized so both requests can NEVER fit at once: 7 usable
         # pages, each request reserves ceil((3+9)/4)=3 pages -> the
         # engine serializes them instead of deadlocking mid-decode

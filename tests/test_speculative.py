@@ -38,12 +38,24 @@ from paddle_tpu.profiler import serve_observatory as sobs
 pytestmark = pytest.mark.heavy  # slow-compiling: tier-1 yes, quick gate no
 
 
+# compiled executables cache on the model instance and the disk
+# compile cache is off under tests (conftest), so one model per
+# (seed, layers) across this file's tests avoids repaying compiles;
+# every compile assertion here is a warm-vs-steady snapshot delta,
+# none requires a cold model
+_MODELS = {}
+
+
 def _tiny_lm(seed=0, layers=2):
+    key = (seed, layers)
+    if key in _MODELS:
+        return _MODELS[key]
     paddle.seed(seed)
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=layers,
                     num_heads=4, max_position_embeddings=64, dropout=0.0)
     m = GPTForCausalLM(cfg)
     m.eval()
+    _MODELS[key] = m
     return m
 
 

@@ -13,10 +13,13 @@ the ragged paged-attention serving step (serve.ragged_step: the
 Pallas mixed prefill+decode program behind GenerationEngine), a
 2-engine DISAGGREGATED ServingRouter (prefill/decode roles over one
 shared page pool — the router tier adds zero executables and lands
-real kind:"route" records in the tier-1-linted ledger), and a
+real kind:"route" records in the tier-1-linted ledger), a
 SPECULATIVE engine (1-layer draft, k=2 — the verify rows pad into the
 warmed decode signature, so speculation too must add zero target
-executables AND zero steady-state draft traces),
+executables AND zero steady-state draft traces), and an SSM engine
+(models/ssm.py over a RecurrentStateCache — the second model family's
+O(1) cache strategy: same ragged tag, its own exec signature, serve
+records stamped cache_strategy="recurrent"),
 compiled cold (persistent cache off) on the single-device CPU backend —
 same model, same shapes, same flags every run, so fusion counts and
 bytes-accessed are deterministic and compile seconds are comparable.
@@ -277,6 +280,22 @@ def emit_workload():
                             name="canonical_spec",
                             speculative=SpeculativeConfig(draft_model,
                                                           k=2))
+    # the SECOND MODEL FAMILY (models/ssm.py): an O(1)-cache SSM engine
+    # through the SAME serve.ragged_step tag — its RecurrentStateCache
+    # keys a distinct executable via cache.exec_signature(), warmed
+    # here like every other signature, and its serve/request/kvcache
+    # records stamp cache_strategy="recurrent" so tier-1 lints the
+    # strategy-conditional schema rules against real records
+    from paddle_tpu.models.ssm import SSMConfig, SSMForCausalLM
+    paddle.seed(2)
+    ssm_cfg = SSMConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        d_state=8, d_conv=4, expand=2,
+                        max_position_embeddings=16)
+    ssm_model = SSMForCausalLM(ssm_cfg)
+    ssm_model.eval()
+    ssm = GenerationEngine(ssm_model, n_pages=8, page_size=16,
+                           max_batch=2, max_new_tokens=3,
+                           name="canonical_ssm")
     handles = [
         step.warm(ids, ids),                       # train.step
         step.warm_run_steps(2, ids, ids),          # train.run_steps
@@ -284,14 +303,16 @@ def emit_workload():
     ] + eng.warm_async(x_serve) \
       + gen.warm_async(4, 3) \
       + router.warm_async(4, 3) \
-      + spec.warm_async(4, 3)                      # serve.ragged_step
+      + spec.warm_async(4, 3) \
+      + ssm.warm_async(4, 3)                       # serve.ragged_step
     summary = jwarm.join(handles)                  # kind:"warm" record
     warmed = cobs.ledger_signatures()
     # the draft shares the target's RAGGED_TAG, so the ledger-pair
     # check alone cannot see a steady-state DRAFT compile — the
     # per-model trace counters can, and must not move either
     traces0 = getattr(gen_model, "_ragged_traces", 0) \
-        + getattr(draft_model, "_ragged_traces", 0)
+        + getattr(draft_model, "_ragged_traces", 0) \
+        + getattr(ssm_model, "_ragged_traces", 0)
 
     # steady state over the warmed executables
     float(step(ids, ids).item())
@@ -303,6 +324,8 @@ def emit_workload():
     gen.shutdown()
     spec.submit(np.array([1, 2, 3, 4]), max_new_tokens=3).result(120)
     spec.shutdown()
+    ssm.submit(np.array([1, 2, 3, 4]), max_new_tokens=3).result(120)
+    ssm.shutdown()
     router.submit(np.array([1, 2, 3, 4]), max_new_tokens=3,
                   deadline_ms=120_000).result(120)
     router._fleet_mon.snapshot()  # force ONE kind:"fleet" record: the
@@ -314,7 +337,8 @@ def emit_workload():
             f"compiled {sorted(steady - warmed)} beyond the warmed set "
             f"(warm summary: {summary})")
     traces1 = getattr(gen_model, "_ragged_traces", 0) \
-        + getattr(draft_model, "_ragged_traces", 0)
+        + getattr(draft_model, "_ragged_traces", 0) \
+        + getattr(ssm_model, "_ragged_traces", 0)
     if traces1 != traces0:
         raise AssertionError(
             "speculative steady state retraced the ragged step "
@@ -351,7 +375,7 @@ def emit_workload():
     if sorted(by_engine) != ["canonical", "canonical_gen",
                              "canonical_router_decode",
                              "canonical_router_prefill",
-                             "canonical_spec"] or \
+                             "canonical_spec", "canonical_ssm"] or \
             any(len(v) != 1 for v in by_engine.values()):
         raise AssertionError(
             "expected exactly one request record per engine "
@@ -378,11 +402,11 @@ def emit_workload():
     # by the decode half (seeded at adoption)
     rec_total = sum(r["generated_tokens"] for r in reqs
                     if r["outcome"] == "completed")
-    if rec_total != gen_total or rec_total != 9:  # 3 x max_new_tokens=3
+    if rec_total != gen_total or rec_total != 12:  # 4 x max_new_tokens=3
         raise AssertionError(
             "request-record token counts do not reconcile with the "
             f"engine counters: records {rec_total}, "
-            f"serve.generated_tokens {gen_total}, expected 9")
+            f"serve.generated_tokens {gen_total}, expected 12")
     # the speculative contract: the canonical_spec request carries the
     # schema-valid proposed/accepted trio with real proposals, every
     # NON-speculative record stamps zeros, and >= 1 kind:"serve" step
@@ -409,6 +433,23 @@ def emit_workload():
         raise AssertionError(
             "expected >= 1 kind:'serve' record from canonical_spec "
             "with proposed_tokens >= 1 (did the draft propose at all?)")
+    # the cache-strategy contract: the SSM engine stamps every serve
+    # record with its strategy (and its request/kvcache records with
+    # the same — schema-validated above), so tier-1 exercises the
+    # strategy-conditional rules against REAL recurrent records
+    ssm_steps = [r for r in serves
+                 if r.get("engine") == "canonical_ssm"
+                 and r.get("cache_strategy") == "recurrent"]
+    if not ssm_steps:
+        raise AssertionError(
+            "expected >= 1 kind:'serve' record from canonical_ssm "
+            "stamped cache_strategy='recurrent', got "
+            f"{[(r.get('engine'), r.get('cache_strategy')) for r in serves][:8]}")
+    if by_engine["canonical_ssm"][0].get("cache_strategy") \
+            != "recurrent":
+        raise AssertionError(
+            "the canonical_ssm request record must stamp its strategy: "
+            f"{by_engine['canonical_ssm'][0]}")
     errs = [e for r in serves
             for e in _cms.validate_line(_json.dumps(r))]
     if errs:

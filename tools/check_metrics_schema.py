@@ -72,6 +72,16 @@ Schema (documented in docs/OBSERVABILITY.md):
                   accept_rate  number  in [0, 1]; must equal
                                        accepted/proposed (0.0 when
                                        nothing proposed)
+                  cache_strategy str   paged | recurrent | hybrid —
+                                       the engine's decode-cache
+                                       strategy (inference/
+                                       cache_strategy.py). Absent
+                                       means "paged" (pre-strategy
+                                       records stay valid). Stamped
+                                       on serve / request / kvcache /
+                                       route / journey records, where
+                                       it switches the strategy-
+                                       conditional rules below
   kind == "health" (one record per resolved health vector —
                   TrainStep/HybridTrainStep monitor_health=True)
                   additionally requires:
@@ -301,22 +311,46 @@ Schema (documented in docs/OBSERVABILITY.md):
                   from_engine  str     prefill engine (in fleet, and
                                        != engine — a self-handoff is
                                        a wiring bug)
-                  pages_moved  int     >= 1 pages in the moved chain
-                  chain_tokens int     >= 1 KV tokens moved
-                  page_size    int     >= 1; the counts must
+                  pages_moved  int     paged/hybrid: >= 1 pages in
+                                       the moved chain; recurrent:
+                                       MUST be 0 (the chain is one
+                                       fixed-size state blob, no
+                                       pages cross)
+                  chain_tokens int     >= 1 tokens the chain covers
+                  page_size    int     >= 1; paged/hybrid counts must
                                        RECONCILE: pages_moved ==
                                        ceil(chain_tokens / page_size)
                                        (the chain covers exactly its
                                        written tokens — a mismatch
                                        means pages leaked or doubled
                                        across the handoff)
+                  state_bytes  int     recurrent/hybrid: > 0 bytes of
+                                       recurrent state riding the
+                                       handoff (the whole payload for
+                                       recurrent, the SSM half for
+                                       hybrid)
                   and optionally:
                   prefix_affinity bool sticky prefix routing applied
                   prefix_match_pages int >= 0
                   deadline_ms  number  >= 0
                   router / request_id str non-empty
-  kind == "kvcache" (periodic KV page-pool snapshot —
-                  PagedKVCache.pool_stats via serve_observatory)
+  kind == "kvcache" (periodic cache-pool snapshot —
+                  pool_stats() via serve_observatory; the shape is
+                  strategy-dispatched on cache_strategy)
+                  cache_strategy == "recurrent" requires INSTEAD:
+                  engine       str     emitting engine (non-empty)
+                  n_slots      int     >= 1 state slots in the pool
+                  free_slots   int     >= 0; free + held <= n_slots
+                  held_slots   int     >= 0
+                  sequences    int     >= 0 live sequences
+                  slots_drawn  int     >= 0 cumulative slot draws
+                  state_bytes  int     >= 1 fixed blob bytes per slot
+                                       (the O(1) in O(1)-cache)
+                  state_bytes_total int >= 0 whole-pool state bytes
+                                       ... and every page gauge below
+                                       must be ABSENT or ZERO (a
+                                       recurrent pool has no pages)
+                  cache_strategy "paged" (default) or "hybrid"
                   additionally requires:
                   engine       str     emitting engine (non-empty)
                   n_pages      int     pool size (>= 1)
@@ -335,6 +369,10 @@ Schema (documented in docs/OBSERVABILITY.md):
                   refcounts    dict    {refcount: n_pages >= 0}
                   page_size / prefix_nodes / sequences / queue_depth /
                   active       int     >= 0 (page_size >= 1)
+                  hybrid additionally requires n_slots / free_slots /
+                  held_slots / state_bytes / state_bytes_total (same
+                  ranges as the recurrent snapshot; state_bytes > 0)
+                  — the page pool and the slot pool report together
   kind == "journey" (ONE record per handed-off request at its
                   decode-side terminal — the fleet observatory,
                   profiler/fleet_observatory.py, joins the prefill and
@@ -356,9 +394,12 @@ Schema (documented in docs/OBSERVABILITY.md):
                   generated_tokens int >= 0 (decode-side total,
                                        including the prefill engine's
                                        first streamed token)
-                  pages_moved  int     >= 1; == ceil(chain_tokens /
-                                       page_size) — same reconciliation
-                                       as the handoff route record
+                  pages_moved  int     same strategy-conditional rule
+                                       as the handoff route record:
+                                       paged/hybrid >= 1 and ==
+                                       ceil(chain_tokens / page_size);
+                                       recurrent == 0 (with
+                                       state_bytes > 0 — one blob)
                   chain_tokens int     >= 1
                   page_size    int     >= 1
                   queue_s      number  >= 0 submit -> prefill admit
@@ -512,6 +553,20 @@ JOURNEY_REQUIRED = {"request_id": str, "prefill_engine": str,
 # handoff and "handoff" itself is never terminal
 JOURNEY_OUTCOMES = {"completed", "expired", "error", "cancelled"}
 SLO_CLASSES = {"interactive", "standard", "batch"}
+# cache strategies (inference/cache_strategy.py): the optional
+# `cache_strategy` stamp on serve/request/route/journey/kvcache
+# records; absent means "paged" (pre-strategy records stay valid).
+# Strategy-conditional rules: a RECURRENT chain moves ONE fixed-size
+# state blob — pages_moved == 0 and state_bytes > 0 — while paged and
+# hybrid chains move >= 1 page reconciling with chain_tokens.
+CACHE_STRATEGIES = {"paged", "recurrent", "hybrid"}
+# a recurrent pool snapshot counts STATE SLOTS, not pages: page
+# gauges are absent (zero pages exist to count)
+KVCACHE_RECURRENT_REQUIRED = {"engine": str, "n_slots": int,
+                              "free_slots": int, "held_slots": int,
+                              "sequences": int, "slots_drawn": int,
+                              "state_bytes": int,
+                              "state_bytes_total": int}
 FLEET_REQUIRED = {"router": str, "fleet": list, "n_engines": int,
                   "n_pools": int, "queue_depth": int, "active": int,
                   "slots_free": int, "admittable_pages": int,
@@ -565,6 +620,63 @@ def _num_val(rec, key):
     v = rec.get(key)
     return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
         else None
+
+
+def _cache_strategy(rec, where, errors):
+    """Validate the optional cache_strategy enum; return its effective
+    value ("paged" when absent — pre-strategy records stay valid)."""
+    if "cache_strategy" not in rec:
+        return "paged"
+    v = rec["cache_strategy"]
+    if not isinstance(v, str) or v not in CACHE_STRATEGIES:
+        errors.append(
+            f"{where}: cache_strategy {v!r} not one of "
+            f"{sorted(CACHE_STRATEGIES)}")
+        return "paged"
+    return v
+
+
+def _check_chain_moved(rec, where, errors, strategy, what):
+    """Strategy-conditional handoff-payload rules shared by route
+    (outcome handoff) and journey records: what crossed engines must
+    reconcile with the strategy's currency."""
+    moved = _int_val(rec, "pages_moved")
+    toks = _int_val(rec, "chain_tokens")
+    psize = _int_val(rec, "page_size")
+    sbytes = _int_val(rec, "state_bytes") if "state_bytes" in rec \
+        else None
+    if "state_bytes" in rec and sbytes is None:
+        errors.append(
+            f"{where}: state_bytes must be an int, got "
+            f"{rec['state_bytes']!r}")
+    for key, v in (("chain_tokens", toks), ("page_size", psize)):
+        if v is not None and v < 1:
+            errors.append(f"{where}: {key} must be >= 1, got {v}")
+    if strategy == "recurrent":
+        if moved is not None and moved != 0:
+            errors.append(
+                f"{where}: recurrent {what} moved pages_moved {moved} "
+                "— a recurrent chain is ONE state blob, it moves no "
+                "pages")
+        if sbytes is not None and sbytes <= 0:
+            errors.append(
+                f"{where}: recurrent {what} with state_bytes "
+                f"{sbytes} — the state blob is the payload, its size "
+                "must be > 0")
+        return
+    if moved is not None and moved < 1:
+        errors.append(
+            f"{where}: pages_moved must be >= 1, got {moved}")
+    if None not in (moved, toks, psize) and psize >= 1 and \
+            moved != -(-toks // psize):
+        errors.append(
+            f"{where}: pages_moved {moved} != ceil(chain_tokens "
+            f"{toks} / page_size {psize}) — the {what}'s page count "
+            "does not reconcile with the tokens it claims to carry")
+    if strategy == "hybrid" and sbytes is not None and sbytes <= 0:
+        errors.append(
+            f"{where}: hybrid {what} with state_bytes {sbytes} — the "
+            "recurrent half's blob must ride the handoff too")
 
 
 def _check_types(rec, required, where, errors):
@@ -670,6 +782,7 @@ def validate_line(line, where="<line>"):
                     f"[0, 1], got {v!r}")
     elif rec.get("kind") == "serve":
         _check_types(rec, SERVE_REQUIRED, where, errors)
+        _cache_strategy(rec, where, errors)
         # engine is REQUIRED and non-empty: it is the only key that
         # keeps multi-engine JSONL attributable (bench.py --serve runs
         # both engine paths in one process)
@@ -813,6 +926,7 @@ def validate_line(line, where="<line>"):
                               f"non-empty strings, got {tags!r}")
     elif rec.get("kind") == "request":
         _check_types(rec, REQUEST_REQUIRED, where, errors)
+        _cache_strategy(rec, where, errors)
 
         def _rint(key):
             return _int_val(rec, key)
@@ -926,6 +1040,7 @@ def validate_line(line, where="<line>"):
         if qd is not None and qd < 0:
             errors.append(
                 f"{where}: queue_depth must be >= 0, got {qd}")
+        strategy = _cache_strategy(rec, where, errors)
         if outcome == "handoff":
             _check_types(rec, ROUTE_HANDOFF_REQUIRED, where, errors)
             fe = rec.get("from_engine")
@@ -942,22 +1057,7 @@ def validate_line(line, where="<line>"):
                     errors.append(
                         f"{where}: handoff from {fe!r} to itself — "
                         "a self-handoff is a role-wiring bug")
-            moved = _int_val(rec, "pages_moved")
-            toks = _int_val(rec, "chain_tokens")
-            psize = _int_val(rec, "page_size")
-            for key, v in (("pages_moved", moved),
-                           ("chain_tokens", toks),
-                           ("page_size", psize)):
-                if v is not None and v < 1:
-                    errors.append(
-                        f"{where}: {key} must be >= 1, got {v}")
-            if None not in (moved, toks, psize) and psize >= 1 and \
-                    moved != -(-toks // psize):
-                errors.append(
-                    f"{where}: pages_moved {moved} != "
-                    f"ceil(chain_tokens {toks} / page_size {psize}) "
-                    "— the handoff page count does not reconcile "
-                    "with the tokens it claims to carry")
+            _check_chain_moved(rec, where, errors, strategy, "handoff")
         if "prefix_affinity" in rec and \
                 not isinstance(rec["prefix_affinity"], bool):
             errors.append(
@@ -1006,19 +1106,8 @@ def validate_line(line, where="<line>"):
             v = _int_val(rec, key)
             if v is not None and v < 0:
                 errors.append(f"{where}: {key} must be >= 0, got {v}")
-        moved = _int_val(rec, "pages_moved")
-        toks = _int_val(rec, "chain_tokens")
-        psize = _int_val(rec, "page_size")
-        for key, v in (("pages_moved", moved), ("chain_tokens", toks),
-                       ("page_size", psize)):
-            if v is not None and v < 1:
-                errors.append(f"{where}: {key} must be >= 1, got {v}")
-        if None not in (moved, toks, psize) and psize >= 1 and \
-                moved != -(-toks // psize):
-            errors.append(
-                f"{where}: pages_moved {moved} != ceil(chain_tokens "
-                f"{toks} / page_size {psize}) — the journey's page "
-                "count does not reconcile with the tokens it moved")
+        strategy = _cache_strategy(rec, where, errors)
+        _check_chain_moved(rec, where, errors, strategy, "journey")
         for key in ("queue_s", "prefill_s", "handoff_gap_s", "decode_s",
                     "latency_s", "ttft_s", "deadline_s"):
             v = _num_val(rec, key) if key in rec else None
@@ -1161,13 +1250,71 @@ def validate_line(line, where="<line>"):
                             f"{where}: attainment_by_class[{cls!r}] "
                             f"must be in [0, 1], got {v!r}")
     elif rec.get("kind") == "kvcache":
-        _check_types(rec, KVCACHE_REQUIRED, where, errors)
+        strategy = _cache_strategy(rec, where, errors)
 
         def _kint(key):
             return _int_val(rec, key)
 
         if isinstance(rec.get("engine"), str) and not rec["engine"]:
             errors.append(f"{where}: engine must be non-empty")
+        if strategy == "recurrent":
+            _check_types(rec, KVCACHE_RECURRENT_REQUIRED, where,
+                         errors)
+            if _kint("n_slots") is not None and rec["n_slots"] < 1:
+                errors.append(
+                    f"{where}: n_slots must be >= 1, got "
+                    f"{rec['n_slots']}")
+            for key in ("free_slots", "held_slots", "sequences",
+                        "slots_drawn", "state_bytes_total"):
+                v = _kint(key) if key in rec else None
+                if v is not None and v < 0:
+                    errors.append(
+                        f"{where}: {key} must be >= 0, got {v}")
+            sb = _kint("state_bytes")
+            if sb is not None and sb < 1:
+                errors.append(
+                    f"{where}: state_bytes must be >= 1, got {sb} — "
+                    "a recurrent slot's fixed blob size is the pool's "
+                    "whole capacity story")
+            ns, fs, hs = _kint("n_slots"), _kint("free_slots"), \
+                _kint("held_slots")
+            if None not in (ns, fs, hs) and fs + hs > ns:
+                errors.append(
+                    f"{where}: free_slots {fs} + held_slots {hs} > "
+                    f"n_slots {ns} — slots are being double-counted")
+            for key in ("n_pages", "free_pages", "held_pages",
+                        "shared_pages", "registered_pages",
+                        "pages_drawn", "cow_copies", "lru_reclaims"):
+                v = _kint(key) if key in rec else None
+                if v is not None and v != 0:
+                    errors.append(
+                        f"{where}: recurrent snapshot reports {key} "
+                        f"{v} — a recurrent pool has no pages; page "
+                        "gauges must be absent or zero")
+            return errors
+        _check_types(rec, KVCACHE_REQUIRED, where, errors)
+        if strategy == "hybrid":
+            for key in ("n_slots", "free_slots", "held_slots",
+                        "state_bytes", "state_bytes_total"):
+                if key not in rec:
+                    errors.append(
+                        f"{where}: hybrid snapshot missing {key} — "
+                        "the recurrent half's slots must be reported "
+                        "alongside the page pool")
+                else:
+                    v = _kint(key)
+                    if v is None:
+                        errors.append(
+                            f"{where}: {key} must be an int, got "
+                            f"{rec[key]!r}")
+                    elif v < 0:
+                        errors.append(
+                            f"{where}: {key} must be >= 0, got {v}")
+            sb = _kint("state_bytes")
+            if sb is not None and sb == 0:
+                errors.append(
+                    f"{where}: hybrid snapshot with state_bytes 0 — "
+                    "the recurrent half holds real state per slot")
         if _kint("n_pages") is not None and rec["n_pages"] < 1:
             errors.append(
                 f"{where}: n_pages must be >= 1, got {rec['n_pages']}")

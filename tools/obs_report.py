@@ -130,6 +130,19 @@ def section_serve(recs, out):
     out.append(f"== serving ==  ({len(reqs)} requests)")
     out.append("  outcomes: " + "  ".join(
         f"{k}={v}" for k, v in sorted(outcomes.items())))
+    # cache strategy split: legacy records predate the field and are
+    # paged by construction, so an all-paged ledger stays as before
+    by_strat = {}
+    for r in reqs:
+        s = r.get("cache_strategy", "paged")
+        t = by_strat.setdefault(s, {"n": 0, "engines": set()})
+        t["n"] += 1
+        t["engines"].add(r.get("engine", "?"))
+    if set(by_strat) != {"paged"}:
+        out.append("  cache strategies: " + "  ".join(
+            f"{s}={t['n']} ({len(t['engines'])} engine"
+            f"{'s' if len(t['engines']) != 1 else ''})"
+            for s, t in sorted(by_strat.items())))
     out.append(f"  latency p50 {_fmt_s(_pct(lats, 50))}  "
                f"p99 {_fmt_s(_pct(lats, 99))}")
     waste = gen - good
@@ -173,13 +186,18 @@ def section_routing(recs, out):
         pairs = {}
         for r in hoffs:
             key = (r.get("from_engine", "?"), r.get("engine", "?"))
-            p = pairs.setdefault(key, {"n": 0, "pages": 0, "toks": 0})
+            p = pairs.setdefault(key, {"n": 0, "pages": 0, "toks": 0,
+                                       "sbytes": 0})
             p["n"] += 1
             p["pages"] += int(r.get("pages_moved", 0))
             p["toks"] += int(r.get("chain_tokens", 0))
+            p["sbytes"] += int(r.get("state_bytes", 0))
         for (src, dst), p in sorted(pairs.items()):
+            # a recurrent handoff moves zero pages — its payload is the
+            # fixed-size state blob, so show the bytes when they exist
+            sb = f"  {p['sbytes']} state bytes" if p["sbytes"] else ""
             out.append(f"  handoff {src} -> {dst}: x{p['n']}  "
-                       f"{p['pages']} pages  {p['toks']} kv tokens")
+                       f"{p['pages']} pages  {p['toks']} kv tokens{sb}")
     # fleet SLO rollup: join the request ledger per placed engine
     reqs = [r for r in recs if r.get("kind") == "request"
             and "deadline_met" in r]
