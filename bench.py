@@ -452,6 +452,11 @@ def _run():
     from paddle_tpu.profiler import dist_observatory as _pdobs
     device_probe = _pdobs.device_time_summary()
 
+    # memory-observatory report while the train step (params/opt_state
+    # tags) is still alive — the headline's measured memory baseline
+    from paddle_tpu.profiler import mem_observatory as _mobs
+    _mem_rep = _mobs.mem_report()
+
     # training-health tail + unified Perfetto trace (ring snapshot —
     # milliseconds; both before the headline print so they ride in it)
     health = step.flush_health() or {}
@@ -515,6 +520,12 @@ def _run():
         "retraces": step.retraces,
         "donated": step._donate,
         "peak_mem_bytes": int(paddle.device.max_memory_allocated()),
+        # memory-observatory peak (profiler/mem_observatory): the
+        # device-wide high-water mark, bounded below by the tagged
+        # ledger so CPU hosts (memory_stats() == {}) still report the
+        # attributed footprint instead of 0
+        "hbm_peak_bytes": int(_mem_rep["device_peak_bytes"]),
+        "mem_attributed_bytes": int(_mem_rep["attributed_bytes"]),
         # XLA cost analysis (per-executable FLOPs) — the measured-work
         # MFU companion to the 6ND estimate above
         "flops_per_step": flops_per_step,
@@ -721,6 +732,7 @@ def _serve_gen_workload():
     from paddle_tpu.inference import GenerationEngine
     from paddle_tpu.profiler import monitor as _pmon
     from paddle_tpu.profiler import serve_observatory as _sobs
+    from paddle_tpu.profiler import mem_observatory as _mobs
 
     n_long = int(os.environ.get("BENCH_SERVE_GEN_LONG", "2"))
     n_short = int(os.environ.get("BENCH_SERVE_GEN_SHORT", "6"))
@@ -790,6 +802,12 @@ def _serve_gen_workload():
         wall = time.perf_counter() - t0
         frac = eng.pad_token_fraction()
         kv_peak = eng.kv_peak_occupancy()
+        # measured memory gauges BEFORE shutdown frees the pool: the
+        # pool's resident bytes, its free-list fragmentation, and the
+        # device peak — the baseline the next capacity PR has to beat
+        hbm = _mobs.pool_hbm(eng.cache)
+        frag_kv = _mobs.fragmentation(eng.cache)
+        mem_rep = _mobs.mem_report()
         eng.shutdown()
         delta = {k: (int(m2.value) if (m2 := _pmon.get_metric(
             f"serve.{k}")) else 0) - v for k, v in base.items()}
@@ -823,6 +841,13 @@ def _serve_gen_workload():
             "wasted_token_fraction": round(
                 wasted / max(goodput + wasted, 1), 4),
             "kv_peak_occupancy": round(kv_peak, 4),
+            # memory observatory gauges (profiler/mem_observatory):
+            # pool footprint, free-list fragmentation at run end, and
+            # the device-wide peak (ledger-attributed on CPU hosts)
+            "kv_pool_bytes": int(hbm.get("hbm_total_bytes", 0)),
+            "fragmentation": round(frag_kv["fragmentation"], 4)
+            if frag_kv is not None else 0.0,
+            "hbm_peak_bytes": int(mem_rep["device_peak_bytes"]),
             "ttft_p50_ms": round(
                 ttfts_ms[len(ttfts_ms) // 2], 1) if ttfts_ms else 0.0,
             "ttft_p99_ms": round(
@@ -851,6 +876,9 @@ def _serve_gen_workload():
         "goodput_tokens_per_s": ragged["goodput_tokens_per_s"],
         "wasted_token_fraction": ragged["wasted_token_fraction"],
         "kv_peak_occupancy": ragged["kv_peak_occupancy"],
+        "kv_pool_bytes": ragged["kv_pool_bytes"],
+        "fragmentation": ragged["fragmentation"],
+        "hbm_peak_bytes": ragged["hbm_peak_bytes"],
     }
 
 
@@ -1472,6 +1500,10 @@ def _run_serve():
                 headline[k] = router[k]
     if gen is not None:
         headline["generate"] = gen
+        # the memory-observatory baseline rides in the headline too
+        for k in ("hbm_peak_bytes", "kv_pool_bytes", "fragmentation"):
+            if k in gen:
+                headline[k] = gen[k]
     if load is not None:
         headline["load"] = load
         for k in ("goodput_tokens_per_s", "rejected_fraction",
@@ -1510,7 +1542,8 @@ def _run_serve():
                   "ttft_p50_ms", "ttft_p99_ms",
                   "ragged_equals_bucketed", "slo_attainment",
                   "goodput_tokens_per_s", "wasted_token_fraction",
-                  "kv_peak_occupancy"):
+                  "kv_peak_occupancy", "kv_pool_bytes",
+                  "fragmentation", "hbm_peak_bytes"):
             if gen is not None and k in gen:
                 entry[k] = gen[k]
         for k in ("router_speedup_vs_single", "router_slo_attainment",

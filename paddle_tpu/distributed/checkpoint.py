@@ -62,6 +62,7 @@ from ..framework import fault_injection as _fault
 from ..profiler import monitor as _monitor
 from ..profiler import statistic as _stat
 from ..profiler import flight_recorder as _flight
+from ..profiler import mem_observatory as _mobs
 
 __all__ = ["CheckpointManager", "AsyncSaveHandle",
            "CorruptCheckpointError",
@@ -209,9 +210,24 @@ class CheckpointManager:
         _fault.fire("ckpt.snapshot")
         _stat.begin_span("ckpt.snapshot")
         try:
-            tree = self._snapshot(step_obj)
+            try:
+                tree = self._snapshot(step_obj)
+            except RuntimeError as e:
+                if _mobs.is_oom(e):
+                    # the snapshot's HBM copies are the classic
+                    # tip-over allocation: dump the attribution ledger
+                    # before surfacing who already held the bytes
+                    raise _mobs.oom_error(e, site="ckpt.snapshot") \
+                        from e
+                raise
         finally:
             snapshot_s = _stat.end_span()
+        # memory-observatory attribution: per-array weakrefs — the tag
+        # empties itself when the writer drops the snapshot
+        _mobs.register_arrays(
+            "ckpt_snapshot",
+            [x for x in jax.tree.leaves(tree)
+             if getattr(x, "nbytes", None) is not None])
         _monitor.histogram("ckpt.snapshot_s").observe(snapshot_s)
         handle = AsyncSaveHandle(step)
         with self._writer_gate:

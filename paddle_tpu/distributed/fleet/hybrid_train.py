@@ -37,6 +37,7 @@ from ...profiler import statistic as _stat
 from ...profiler import monitor as _monitor
 from ...profiler import cost as _cost
 from ...profiler import flight_recorder as _flight
+from ...profiler import mem_observatory as _mobs
 
 __all__ = ["HybridTrainStep", "default_param_rules"]
 
@@ -167,6 +168,12 @@ class HybridTrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
             return jax.tree.map(lambda s: jax.device_put(s, sh), st)
         self.opt_state = {k: init_state(k, v)
                           for k, v in self.params.items()}
+        # memory-observatory attribution: donated stores are REPLACED
+        # each step — getters read the current trees at report time
+        _mobs.register("params",
+                       self, lambda s: jax.tree.leaves(s.params))
+        _mobs.register("opt_state",
+                       self, lambda s: jax.tree.leaves(s.opt_state))
 
         # batch dim over dp; with a sequence-parallel mesh (sp>1), the
         # sequence dim is sharded over 'sp' too — ring attention inside
@@ -540,8 +547,20 @@ class HybridTrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
             compiled, info = entry
             count_train_use(self, info)
             try:
+                if getattr(self, "_oom_fault", False):
+                    # oom@train.step soft fault: raise the synthetic
+                    # exhaustion inside the real dispatch try (same
+                    # contract as TrainStep._dispatch)
+                    self._oom_fault = False
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: injected OOM "
+                        "(oom@train.step fault): failed to allocate "
+                        "request for 8.00GiB on device")
                 out = compiled(*args)
             except (FloatingPointError, RuntimeError) as e:
+                if _mobs.is_oom(e):
+                    raise _mobs.oom_error(
+                        e, site="fleet.hybrid_step") from e
                 # jax_debug_nans found a non-finite value: flight-record
                 # and write a debug bundle before re-raising (same
                 # contract as TrainStep._dispatch, incl. the donated-

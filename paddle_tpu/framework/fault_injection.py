@@ -27,6 +27,11 @@ Spec grammar (semicolon- or comma-separated entries):
              nan       soft action: the SITE OWNER implements it (the
                        train steps poison a float batch leaf so the
                        whole gradient goes non-finite)
+             oom       soft action: the site owner raises a synthetic
+                       XLA-shaped RESOURCE_EXHAUSTED from inside its
+                       real dispatch try-block, so the memory
+                       observatory's forensics path is exercised
+                       end-to-end (catch, bundle dump, DeviceOOMError)
     site     dotted name the instrumented code fires, e.g.
              ckpt.write / ckpt.commit / ckpt.serialize / train.step
     #<n>     fire only on the n-th hit of the site (1-based, per
@@ -42,6 +47,7 @@ Examples:
     corrupt@ckpt.commit          damage the manifest before commit
     kill@train.step#50           preemption at optimizer step 50
     nan@train.step#3             gradients of step 3 are NaN
+    oom@train.step#5             device OOM raised at step 5's dispatch
 
 Sites currently instrumented: `train.step` (TrainStep /
 HybridTrainStep dispatch), `ckpt.snapshot`, `ckpt.serialize`,
@@ -60,10 +66,11 @@ __all__ = ["Fault", "parse_spec", "configure", "fire", "active",
            "hit_counts", "SOFT_ACTIONS"]
 
 _ENV = "PADDLE_TPU_FAULT_SPEC"
-ACTIONS = ("kill", "exit", "eio", "delay", "truncate", "corrupt", "nan")
+ACTIONS = ("kill", "exit", "eio", "delay", "truncate", "corrupt", "nan",
+           "oom")
 # actions fire() only REPORTS back to the caller (the site owner
 # implements the effect) — everything else executes right here
-SOFT_ACTIONS = ("nan",)
+SOFT_ACTIONS = ("nan", "oom")
 
 _lock = threading.Lock()
 _state = {"faults": (), "counts": {}, "env_seen": None}

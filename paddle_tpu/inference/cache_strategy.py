@@ -137,6 +137,11 @@ class RecurrentStateCache:
         self._chains = {}  # chain_id -> in-flight RecurrentChainHandle
         self._stats = {"slots_drawn": 0}
 
+    def device_arrays(self):
+        """The pool's live device arrays (per-layer conv and ssm state
+        pools) — the memory observatory's attribution surface."""
+        return list(self.conv) + list(self.ssm)
+
     # ---- geometry ----------------------------------------------------
     def state_bytes_per_slot(self):
         """Bytes of ONE sequence's decode state — the O(1) constant
@@ -534,6 +539,12 @@ class HybridCache:
                                         pad_to_rows=pad_to_rows)
 
     # ---- telemetry ----------------------------------------------------
+    def device_arrays(self):
+        """Both halves' live device arrays — the memory observatory's
+        attribution surface (the halves also register under their own
+        tags; mem_report() dedups shared buffers by identity)."""
+        return self.paged.device_arrays() + self.recurrent.device_arrays()
+
     def pool_stats(self):
         """Paged pool snapshot plus the slot/state gauges and the
         hybrid strategy stamp — the schema's hybrid branch = paged

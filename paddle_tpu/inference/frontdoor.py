@@ -420,6 +420,7 @@ class ServingRouter:
         the router's own routing counters."""
         reports = {e.name: self._safe_report(e) for e in self.engines}
         pools, admittable, free_pages = {}, 0, 0
+        hbm_total = hbm_free = hbm_headroom = 0
         for eng in self.engines:
             if id(eng.cache) in pools:
                 continue
@@ -427,6 +428,11 @@ class ServingRouter:
             rep = reports[eng.name]
             admittable += int(rep.get("admittable_pages", 0))
             free_pages += int(rep.get("free_pages", 0))
+            # measured-bytes feed, same unique-pool dedup as the page
+            # math (a shared pool's bytes counted once)
+            hbm_total += int(rep.get("hbm_total_bytes", 0))
+            hbm_free += int(rep.get("hbm_free_bytes", 0))
+            hbm_headroom += int(rep.get("hbm_headroom_bytes", 0))
         saturated = [
             e.name for e in self.engines
             if "unavailable" in reports[e.name]
@@ -449,6 +455,9 @@ class ServingRouter:
                               for r in reports.values()),
                 "admittable_pages": admittable,
                 "free_pages": free_pages,
+                "hbm_total_bytes": hbm_total,
+                "hbm_free_bytes": hbm_free,
+                "hbm_headroom_bytes": hbm_headroom,
                 "saturated": saturated,
                 # fleet-wide speculation quality: accepted/proposed
                 # summed over engines (a rate-of-rates would weight an

@@ -113,6 +113,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..profiler import monitor as _monitor
 from ..profiler import serve_observatory as _obs
+from ..profiler import mem_observatory as _mobs
 from ..profiler import statistic as _stat
 from .cache_strategy import strategy_of
 from .speculative import accept_length
@@ -1224,6 +1225,19 @@ class GenerationEngine(_SchedulerLifecycle):
         self._step_i = 0
         self._kv_peak_held = 0   # peak pages held at any step
         _obs.register_engine(self)
+        # memory-observatory attribution: the pool arrays live for the
+        # engine's lifetime — register by strategy-stable tags (a
+        # disaggregated pair sharing one pool registers it under two
+        # engine tags; mem_report() dedups by buffer identity)
+        if self.cache_strategy == "hybrid":
+            _mobs.register(f"kv_pool.{self.name}", self.cache.paged)
+            _mobs.register("ssm_state", self.cache.recurrent)
+        elif self.cache_strategy == "recurrent":
+            _mobs.register("ssm_state", self.cache)
+        else:
+            _mobs.register(f"kv_pool.{self.name}", self.cache)
+        if self._draft_cache is not None:
+            _mobs.register("draft_pool", self._draft_cache)
         self._thread = threading.Thread(
             target=_run_scheduler, args=(weakref.ref(self),),
             name="serve-decode", daemon=True)
@@ -2189,21 +2203,30 @@ class GenerationEngine(_SchedulerLifecycle):
                 top_ks[i] = sp.top_k or 0
                 top_ps[i] = 1.0 if sp.top_p is None else sp.top_p
                 keys[i] = s.key
-        if spec_on:
-            # same executable — the jitted step always computes the
-            # per-token sample lane; return_per_token only changes
-            # which Python-level outputs we keep
-            _, nxt, nxt_tok = self.model.paged_ragged_step(
-                self.cache, rows, pad_to_tokens=pad_t,
-                pad_to_rows=pad_b,
-                sampling=(temps, top_ks, top_ps, keys),
-                return_per_token=True)
-            nxt_tok.copy_to_host_async()  # overlap with bookkeeping below
-        else:
-            _, nxt = self.model.paged_ragged_step(
-                self.cache, rows, pad_to_tokens=pad_t, pad_to_rows=pad_b,
-                sampling=(temps, top_ks, top_ps, keys))
-            nxt.copy_to_host_async()  # overlap with the bookkeeping below
+        try:
+            if spec_on:
+                # same executable — the jitted step always computes the
+                # per-token sample lane; return_per_token only changes
+                # which Python-level outputs we keep
+                _, nxt, nxt_tok = self.model.paged_ragged_step(
+                    self.cache, rows, pad_to_tokens=pad_t,
+                    pad_to_rows=pad_b,
+                    sampling=(temps, top_ks, top_ps, keys),
+                    return_per_token=True)
+                nxt_tok.copy_to_host_async()  # overlap with bookkeeping
+            else:
+                _, nxt = self.model.paged_ragged_step(
+                    self.cache, rows, pad_to_tokens=pad_t,
+                    pad_to_rows=pad_b,
+                    sampling=(temps, top_ks, top_ps, keys))
+                nxt.copy_to_host_async()  # overlap with the bookkeeping
+        except RuntimeError as e:
+            if _mobs.is_oom(e):
+                # allocator exhaustion mid-decode: dump mem_state.json
+                # forensics (the kv pool is usually the top holder)
+                # before the scheduler's crash path sees it
+                raise _mobs.oom_error(e, site="serve.ragged_step") from e
+            raise
         self._sync_retraces()
         now = time.perf_counter()
         prefill_toks = sum(n for k, _, n in metas if k == "prefill")
@@ -2324,6 +2347,12 @@ class GenerationEngine(_SchedulerLifecycle):
                 extra={"queue_depth": len(self._pending),
                        "active": len(self._active)
                        + len(self._prefilling)})
+            # co-located kind:"memory" record: the attribution split
+            # plus this pool's occupancy, measured hbm byte gauges, and
+            # the free-list fragmentation metric — same cadence as the
+            # kvcache snapshot, so the two reconcile row-for-row
+            _mobs.record_memory(source="serve", step=self._step_i,
+                                engine=self.name, cache=self.cache)
 
     def kv_peak_occupancy(self):
         """Peak LIVE fraction of the usable page pool (pad page and
@@ -2387,6 +2416,15 @@ class GenerationEngine(_SchedulerLifecycle):
             "accept_rate": (self._spec_accepted / self._spec_proposed)
             if self._spec_proposed else 0.0,
         }
+        # measured-bytes admission feed next to the page math: the
+        # pool's device arrays priced in bytes (free + evictable pages
+        # x measured per-page bytes; headroom subtracts outstanding
+        # claims). The router's fleet rollup sums these over UNIQUE
+        # pools so a disaggregated pair is not double-counted.
+        hbm = _mobs.pool_hbm(self.cache)
+        rep["hbm_total_bytes"] = int(hbm.get("hbm_total_bytes", 0))
+        rep["hbm_free_bytes"] = int(hbm.get("hbm_free_bytes", 0))
+        rep["hbm_headroom_bytes"] = int(hbm.get("hbm_headroom_bytes", 0))
         if self.cache_strategy != "paged":
             # state-slot capacity gauges (RecurrentStateCache /
             # HybridCache pool_stats) — what "memory headroom" means

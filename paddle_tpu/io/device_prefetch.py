@@ -33,6 +33,7 @@ import jax
 from ..framework.core import Tensor
 from ..profiler import statistic as _stat
 from ..profiler import monitor as _monitor
+from ..profiler import mem_observatory as _mobs
 
 __all__ = ["DevicePrefetchRing", "device_prefetch_iterator"]
 
@@ -112,6 +113,15 @@ class DevicePrefetchRing:
                 staged = _stage(batch, self._sharding_fn)
                 _stat.record_span("prefetch.h2d",
                                   time.perf_counter() - t0)
+                # memory-observatory attribution: per-array weakrefs to
+                # the staged leaves — when the consumer drops the batch
+                # the tag's bytes fall to zero by themselves
+                _mobs.register_arrays(
+                    "prefetch",
+                    [x.value if isinstance(x, Tensor) else x
+                     for x in jax.tree.leaves(staged)
+                     if hasattr(x, "nbytes")
+                     or isinstance(x, Tensor)])
                 if not self._offer(staged):
                     return
                 _monitor.gauge("prefetch.depth").set(self._q.qsize())

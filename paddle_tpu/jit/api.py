@@ -30,6 +30,7 @@ from ..profiler import cost as _cost
 from ..profiler import flight_recorder as _flight
 from ..profiler import compile_observatory as _observatory
 from ..profiler import dist_observatory as _dobs
+from ..profiler import mem_observatory as _mobs
 from .deferred import DeferredLoss
 from . import warm as _warm
 
@@ -290,6 +291,9 @@ def export_step_metrics(step, dispatch_s, info, compiled_now):
     # modulo off-cadence; emission + the rank-0 peer gather run only at
     # the cadence boundary, never per step
     _dobs.maybe_rankstat(int(step._step_i))
+    # periodic device-memory attribution (kind:"memory") — same cadence
+    # shape: first step always, then every PADDLE_TPU_MEMORY_EVERY-th
+    _mobs.maybe_memory(int(step._step_i), source="train")
 
 
 def state_arrays(layer):
@@ -713,10 +717,16 @@ def fire_step_faults(step_obj, batch):
     (kill-at-step-k, delay) execute inside fire(); the soft `nan`
     action is implemented here by NaN-filling the first floating batch
     leaf, so the whole gradient goes non-finite (the GradScaler /
-    health path must catch it). Returns the (possibly poisoned)
-    batch."""
+    health path must catch it); the soft `oom` action arms a flag the
+    dispatch raises as a synthetic RESOURCE_EXHAUSTED from inside its
+    real try-block, so the memory observatory's forensics path runs
+    end-to-end. Returns the (possibly poisoned) batch."""
     acts = _fault.fire("train.step")
-    if not acts or "nan" not in acts:
+    if not acts:
+        return batch
+    if "oom" in acts:
+        step_obj._oom_fault = True
+    if "nan" not in acts:
         return batch
     out = list(batch)
     for i, b in enumerate(out):
@@ -816,6 +826,14 @@ class TrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
         # scaler rides along, keeping one step_fn signature
         self.scaler_state = scaler.init_jit_state() if scaler is not None \
             else {}
+        # memory-observatory attribution: the stores are donated and
+        # REPLACED every step, so register getters (weakref to self)
+        # that read the current trees at report time
+        _mobs.register("params",
+                       self, lambda s: jax.tree.leaves(s._params_store))
+        _mobs.register("opt_state",
+                       self, lambda s: jax.tree.leaves(s._opt_store))
+        self._oom_fault = False
         self._step_i = 0
         self._mesh = mesh
         self.retraces = 0
@@ -1054,8 +1072,21 @@ class TrainStep(HealthMonitorMixin, CheckpointSnapshotMixin):
             compiled, info = entry
             count_train_use(self, info)
             try:
+                if getattr(self, "_oom_fault", False):
+                    # oom@train.step soft fault: raise the synthetic
+                    # exhaustion from INSIDE the real dispatch try so
+                    # the forensics below is the tested path
+                    self._oom_fault = False
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: injected OOM "
+                        "(oom@train.step fault): failed to allocate "
+                        "request for 8.00GiB on device")
                 out = compiled(*args)
             except (FloatingPointError, RuntimeError) as e:
+                if _mobs.is_oom(e):
+                    # allocator exhaustion: dump mem_state.json forensics
+                    # and re-raise naming the top holders
+                    raise _mobs.oom_error(e, site=span) from e
                 # jax_debug_nans (framework.debug.enable_jit_nan_checks)
                 # found a non-finite value: flight-record it and write a
                 # debug bundle (ring tail + this executable's HLO +

@@ -238,6 +238,12 @@ class PagedKVCache:
     def n_free_pages(self):
         return len(self._free)
 
+    def device_arrays(self):
+        """The pool's live device arrays (per-layer K and V tables) —
+        the memory observatory's attribution surface. List copy:
+        callers iterate while the engine swaps layers functionally."""
+        return list(self.k) + list(self.v)
+
     def n_evictable_pages(self):
         """Registered pages held ONLY by the registry — reclaimable on
         demand (prefix cache retention is best-effort memory). The

@@ -29,6 +29,7 @@ from . import health
 from . import compile_observatory
 from . import serve_observatory
 from . import dist_observatory
+from . import mem_observatory
 from .statistic import SortedKeys
 from .health import AnomalyDetector
 
@@ -42,7 +43,8 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "load_profiler_result", "ProfilerResult", "SortedKeys",
            "statistic", "monitor", "cost", "flight_recorder",
            "trace_export", "health", "compile_observatory",
-           "serve_observatory", "dist_observatory", "AnomalyDetector"]
+           "serve_observatory", "dist_observatory", "mem_observatory",
+           "AnomalyDetector"]
 
 
 class ProfilerTarget:
@@ -159,6 +161,7 @@ class Profiler:
                    "compiles": compile_observatory.ledger(),
                    "collectives": dist_observatory.collectives_tail(),
                    "rankstats": dist_observatory.rankstats_tail(),
+                   "memories": mem_observatory.records_tail(),
                    "clock_offset_s": dist_observatory.clock_offset_s()}
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -274,11 +277,12 @@ class ProfilerResult:
     rolls them up per executable tag), and the distributed
     observatory's records (`collectives` — sampled `kind:"collective"`
     timing records; `rankstats` — per-rank `kind:"rankstat"` skew
-    records)."""
+    records), and the memory observatory's periodic device-memory
+    ledger records (`memories` — `kind:"memory"`)."""
 
     def __init__(self, spans=None, metrics=None, steps=None,
                  step_times_s=None, source=None, compiles=None,
-                 collectives=None, rankstats=None):
+                 collectives=None, rankstats=None, memories=None):
         self.span_tree = spans or []
         self.spans = statistic.flatten(self.span_tree)
         self.metrics = metrics or {}
@@ -287,6 +291,7 @@ class ProfilerResult:
         self.compiles = compiles or []
         self.collectives = collectives or []
         self.rankstats = rankstats or []
+        self.memories = memories or []
         self.source = source
 
     def get(self, name):
@@ -312,6 +317,7 @@ class ProfilerResult:
                 f"{len(self.compiles)} compile records, "
                 f"{len(self.collectives)} collective records, "
                 f"{len(self.rankstats)} rankstat records, "
+                f"{len(self.memories)} memory records, "
                 f"{len(self.metrics)} metrics")
 
     def __repr__(self):
@@ -326,7 +332,7 @@ def load_profiler_result(filename):
     PADDLE_TPU_METRICS_FILE (one JSON object per line; `kind == "step"`
     records land in `.steps`, `kind == "compile"` in `.compiles`,
     `kind == "collective"` in `.collectives`, `kind == "rankstat"` in
-    `.rankstats`)."""
+    `.rankstats`, `kind == "memory"` in `.memories`)."""
     path = filename
     if os.path.isdir(path):
         path = os.path.join(path, "host_stats.json")
@@ -343,10 +349,11 @@ def load_profiler_result(filename):
                               compiles=payload.get("compiles"),
                               collectives=payload.get("collectives"),
                               rankstats=payload.get("rankstats"),
+                              memories=payload.get("memories"),
                               source=path)
     # JSONL metrics export: one object per line
     by_kind = {"step": [], "compile": [], "collective": [],
-               "rankstat": []}
+               "rankstat": [], "memory": []}
     other = []
     for lineno, line in enumerate(text.splitlines(), 1):
         line = line.strip()
@@ -362,7 +369,9 @@ def load_profiler_result(filename):
     result = ProfilerResult(steps=by_kind["step"],
                             compiles=by_kind["compile"],
                             collectives=by_kind["collective"],
-                            rankstats=by_kind["rankstat"], source=path)
+                            rankstats=by_kind["rankstat"],
+                            memories=by_kind["memory"], source=path)
     result.records = (by_kind["step"] + by_kind["compile"] +
-                      by_kind["collective"] + by_kind["rankstat"] + other)
+                      by_kind["collective"] + by_kind["rankstat"] +
+                      by_kind["memory"] + other)
     return result

@@ -209,7 +209,8 @@ class FleetPressure:
     def __init__(self, router, saturation_snapshots=3,
                  gap_spike_factor=4.0, gap_min_history=5,
                  gap_floor_s=0.005, rejection_burst=5,
-                 rejection_window_s=2.0):
+                 rejection_window_s=2.0, memory_snapshots=3,
+                 memory_watermark=None):
         self.router = str(router)
         self.saturation_snapshots = int(saturation_snapshots)
         self.gap_spike_factor = gap_spike_factor
@@ -217,11 +218,21 @@ class FleetPressure:
         self.gap_floor_s = gap_floor_s
         self.rejection_burst = int(rejection_burst)
         self.rejection_window_s = rejection_window_s
+        self.memory_snapshots = int(memory_snapshots)
+        if memory_watermark is None:
+            try:
+                memory_watermark = float(os.environ.get(  # hot-sync-ok: env-string parse at construction, not a device read
+                    "PADDLE_TPU_MEM_WATERMARK", 0.1))
+            except (TypeError, ValueError):
+                memory_watermark = 0.1
+        self.memory_watermark = float(memory_watermark)  # hot-sync-ok: host scalar coercion at construction
         self._gaps = collections.deque(maxlen=self.GAP_WINDOW)
         self._rejects = collections.deque(
             maxlen=max(self.rejection_burst * 4, 16))
         self._sat_run = 0
         self._saturating = False
+        self._mem_run = 0
+        self._mem_pressuring = False
         self._gap_spiking = False
         self._reject_storming = False
         self.events = collections.deque(maxlen=64)
@@ -238,7 +249,11 @@ class FleetPressure:
 
     def observe_snapshot(self, rec):
         """Fold one `kind:"fleet"` snapshot: sustained saturation is K
-        consecutive snapshots with a non-empty `saturated` list."""
+        consecutive snapshots with a non-empty `saturated` list;
+        sustained memory pressure is K consecutive snapshots with the
+        MEASURED hbm headroom under the watermark fraction of pool
+        total (bytes from the memory observatory's pool gauges — a
+        snapshot with no byte feed never counts)."""
         sat = rec.get("saturated") or []
         if sat:
             self._sat_run += 1
@@ -250,6 +265,21 @@ class FleetPressure:
         else:
             self._sat_run = 0
             self._saturating = False  # re-arm
+        total = int(rec.get("hbm_total_bytes", 0))
+        headroom = int(rec.get("hbm_headroom_bytes", 0))
+        if total > 0 and headroom < self.memory_watermark * total:
+            self._mem_run += 1
+            if self._mem_run >= self.memory_snapshots \
+                    and not self._mem_pressuring:
+                self._mem_pressuring = True
+                self._emit("memory_pressure",
+                           hbm_headroom_bytes=headroom,
+                           hbm_total_bytes=total,
+                           watermark=self.memory_watermark,
+                           snapshots=self._mem_run)
+        else:
+            self._mem_run = 0
+            self._mem_pressuring = False  # re-arm
 
     def note_handoff_gap(self, gap_s):
         """Fold one journey's export→adopt gap; spike = beyond
@@ -432,6 +462,11 @@ class FleetMonitor:
             "admittable_pages": int(
                 fleet_roll.get("admittable_pages", 0)),
             "free_pages": int(fleet_roll.get("free_pages", 0)),
+            "hbm_total_bytes": int(
+                fleet_roll.get("hbm_total_bytes", 0)),
+            "hbm_free_bytes": int(fleet_roll.get("hbm_free_bytes", 0)),
+            "hbm_headroom_bytes": int(
+                fleet_roll.get("hbm_headroom_bytes", 0)),
             "outstanding_claims": outstanding,
             "saturated": list(fleet_roll.get("saturated", [])),
             "engines": engines,

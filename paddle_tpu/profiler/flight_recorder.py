@@ -355,6 +355,21 @@ def dump(reason="manual", exc=None, base_dir=None):
         except Exception:
             pass
 
+        # the memory observatory: the full tag ledger, attribution
+        # split, per-pool pool_stats, per-executable memory_analysis
+        # peaks, and — after an OOM routed through oom_error — the
+        # parsed request context. Written unconditionally when anything
+        # is registered: an OOM post-mortem's first question is WHO
+        # held the bytes (docs/OBSERVABILITY.md)
+        try:
+            from . import mem_observatory as _mem
+            if _mem.registered_tags() or _mem.records_tail():
+                _write_json(os.path.join(d, "mem_state.json"),
+                            _mem.mem_state())
+                manifest["mem_state"] = True
+        except Exception:
+            pass
+
         # registered state providers (ckpt_state.json, ...): subsystem
         # snapshots a post-mortem needs that no ring carries — e.g.
         # which checkpoints are committed vs in-flight when a wedged

@@ -1,0 +1,665 @@
+"""The memory observatory: tagged device-memory ledger, pool
+fragmentation telemetry, and OOM forensics.
+
+Fifth observatory sibling (compile / serve / dist / fleet), built
+because every other sibling measures TIME and none measures BYTES: the
+capacity claims the repo makes — SSM concurrent-sequence ratios,
+projected-admittable-pages admission, the quantized-KV headroom the
+ROADMAP queues next — are analytic page/slot math, never reconciled
+against what HBM actually holds, and an allocator OOM dies as a bare
+XLA ``RESOURCE_EXHAUSTED`` with no attribution. Four pieces:
+
+- **Tagged allocation ledger** — long-lived device-array holders
+  register under stable tags (``params`` / ``opt_state`` from the train
+  steps' flat stores, ``kv_pool.<engine>`` / ``draft_pool`` /
+  ``ssm_state`` from the serving cache pools, ``ckpt_snapshot`` from
+  the checkpoint writer's detached copies, ``prefetch`` from the device
+  prefetch ring) via a BOUNDED weakref registry: `register(tag, owner,
+  getter)` holds the owner weakly and asks the getter for the CURRENT
+  arrays at report time (so donated/replaced buffers stay attributed),
+  `register_arrays(tag, arrays)` holds transient buffers as per-array
+  weakrefs (a dead snapshot drops to zero bytes by itself). Nothing in
+  the ledger extends any buffer's lifetime. `mem_report()` splits live
+  `jax.Device.memory_stats()` bytes into attributed (deduplicated over
+  shared pools — a disaggregated pair registering one pool twice counts
+  it once) vs unattributed, the latter bounded by the compile ledger's
+  per-executable `memory_analysis` peaks (temp/scratch is the only
+  legitimate unattributed resident). On backends with no allocator
+  stats (CPU) the report degrades to ledger arithmetic, stamped
+  ``measured: false`` — the attribution bound still holds.
+
+- **Periodic ``kind:"memory"`` records** — cadence-gated like
+  rankstat/kvcache (first emission per source always, then every
+  PADDLE_TPU_MEMORY_EVERY-th train step — default 16, 0 disables — and
+  every kv_snapshot_every-th serving step, co-located with the kvcache
+  snapshot): per-tag bytes, device total/peak, pool occupancy, and for
+  page pools a MEASURED fragmentation metric — the free-list's
+  contiguous-run histogram and largest-contiguous-claimable run vs
+  total free (`fragmentation = 1 - largest_run/free`), computed from
+  the pool's actual free page ids, not claimed from geometry.
+
+- **OOM forensics** — the dispatch choke points (jit/api dispatch,
+  serving `_ragged_step`, checkpoint snapshot) catch
+  ``RESOURCE_EXHAUSTED`` and route it through `oom_error(exc, site)`:
+  flight-record a ``device_oom`` event, dump a debug bundle whose
+  ``mem_state.json`` carries the full tag ledger, per-pool pool_stats,
+  per-executable memory_analysis peaks, and the requested size parsed
+  from the XLA message — then return a framework `DeviceOOMError`
+  naming the top-3 holders, so the failure says WHO held the memory,
+  not just that it ran out.
+
+- **Measured-bytes admission feed** — `pool_hbm(cache)` turns a cache
+  pool's device arrays into measured byte gauges (total / free /
+  headroom, page-granular for paged pools, slot-granular for
+  recurrent) that `GenerationEngine.load_report()` and the router's
+  fleet rollup export as ``hbm_free_bytes`` / ``hbm_headroom_bytes``
+  next to the analytic page math; `FleetPressure` edge-triggers a
+  ``memory_pressure`` event when the fleet's measured headroom sits
+  below the PADDLE_TPU_MEM_WATERMARK fraction (default 0.1) for K
+  consecutive snapshots.
+
+Every emit helper never raises — memory telemetry must never take down
+the engine. Pure host arithmetic throughout (array `.nbytes` is
+metadata, `memory_stats()` is an allocator query — no device syncs);
+the module is fenced whole by tools/check_no_hot_sync.py. See
+docs/OBSERVABILITY.md "The memory observatory".
+"""
+import collections
+import json
+import os
+import re
+import threading
+import weakref
+
+from . import flight_recorder as _fr
+from . import monitor as _monitor
+
+__all__ = ["DeviceOOMError", "register", "register_arrays", "deregister",
+           "registered_tags", "tag_bytes", "ledger", "mem_report",
+           "fragmentation", "pool_hbm", "maybe_memory", "record_memory",
+           "records_tail", "is_oom", "parse_requested_bytes",
+           "oom_error", "mem_state", "reset", "MAX_TAGS", "MEMORY_RING"]
+
+MAX_TAGS = 64     # registry bound: oldest tag evicted beyond this
+MEMORY_RING = 256  # emitted memory records kept for bundle/host_stats
+
+_lock = threading.RLock()
+# tag -> _TagEntry; OrderedDict so eviction drops the oldest registration
+_tags = collections.OrderedDict()
+_records = collections.deque(maxlen=MEMORY_RING)
+_state = {
+    "emitted": set(),   # cadence sources that have emitted once
+    "peaks": {},        # tag -> peak bytes observed at any report
+    "last_oom": None,   # context of the most recent OOM (mem_state.json)
+}
+_state_registered = [False]
+
+
+class DeviceOOMError(RuntimeError):
+    """A device allocation failed (XLA ``RESOURCE_EXHAUSTED``) — raised
+    by the instrumented dispatch choke points AFTER the memory
+    observatory dumped a debug bundle. Carries the forensics inline:
+    `site` (which choke point), `requested_bytes` (parsed from the XLA
+    message, 0 when unparseable), `top_holders` ([(tag, bytes)] — the
+    ledger's three largest), and `bundle_dir` (the dumped bundle's
+    path, None when dumping was off/failed)."""
+
+    def __init__(self, message, site=None, requested_bytes=0,
+                 top_holders=None, bundle_dir=None):
+        super().__init__(message)
+        self.site = site
+        self.requested_bytes = int(requested_bytes)
+        self.top_holders = list(top_holders or [])
+        self.bundle_dir = bundle_dir
+
+
+class _TagEntry:
+    __slots__ = ("owner", "getter", "refs")
+
+    def __init__(self, owner=None, getter=None, refs=None):
+        self.owner = owner    # weakref to the holder (getter mode)
+        self.getter = getter  # owner -> iterable of device arrays
+        self.refs = refs      # [weakref(array)] (transient mode)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _default_getter(owner):
+    """Registration without an explicit getter asks the owner for its
+    arrays: `device_arrays()` (the cache pools' surface) or the owner
+    itself as an iterable."""
+    fn = getattr(owner, "device_arrays", None)
+    if callable(fn):
+        return fn()
+    return owner
+
+
+def register(tag, owner, getter=None):
+    """Attribute `owner`'s device arrays to `tag`. The owner is held by
+    WEAKREF and `getter(owner)` is called at report time for the
+    CURRENT arrays — so functionally-replaced buffers (donated train
+    stores) stay attributed without re-registration, and a collected
+    owner silently leaves the ledger. `getter=None` uses the owner's
+    `device_arrays()` method (or iterates the owner). Re-registering a
+    tag replaces it; the registry is bounded at MAX_TAGS (oldest
+    evicted). Never raises."""
+    try:
+        entry = _TagEntry(owner=weakref.ref(owner),
+                          getter=getter or _default_getter)
+        with _lock:
+            _tags.pop(tag, None)
+            _tags[tag] = entry
+            while len(_tags) > MAX_TAGS:
+                _tags.popitem(last=False)
+    except Exception:
+        pass  # telemetry must never take down the registrant
+
+
+def register_arrays(tag, arrays):
+    """Attribute a TRANSIENT buffer set (a checkpoint snapshot, the
+    prefetch ring's staged batch) to `tag` via per-array weakrefs: when
+    the holder drops the buffers, the tag's bytes fall to zero on their
+    own — the ledger never extends a snapshot's lifetime. Re-registering
+    replaces the previous set (the prefetch ring re-registers each
+    staged batch). Never raises."""
+    try:
+        refs = []
+        for a in arrays:
+            try:
+                refs.append(weakref.ref(a))
+            except TypeError:
+                continue  # non-weakrefable leaf (python scalar): skip
+        entry = _TagEntry(refs=refs)
+        with _lock:
+            _tags.pop(tag, None)
+            _tags[tag] = entry
+            while len(_tags) > MAX_TAGS:
+                _tags.popitem(last=False)
+    except Exception:
+        pass
+
+
+def deregister(tag):
+    """Drop one tag from the ledger (tests / explicit teardown)."""
+    with _lock:
+        _tags.pop(tag, None)
+
+
+def registered_tags():
+    """Registered tag names, registration order (diagnostics/tests)."""
+    with _lock:
+        return list(_tags)
+
+
+def _live_arrays(entry):
+    """The entry's CURRENT live device arrays ([] when the owner died
+    or the getter refused)."""
+    try:
+        if entry.refs is not None:
+            return [a for a in (r() for r in entry.refs) if a is not None]
+        owner = entry.owner()
+        if owner is None:
+            return []
+        return [a for a in entry.getter(owner)
+                if getattr(a, "nbytes", None) is not None]
+    except Exception:
+        return []
+
+
+def _snapshot_entries():
+    with _lock:
+        return list(_tags.items())
+
+
+def ledger():
+    """{tag: {"bytes", "arrays", "alive"}} — each tag's own view of its
+    registered arrays (NO cross-tag dedup: two tags sharing one pool
+    both report it; `mem_report()` dedups for the attribution total).
+    Dead tags (owner collected, every transient ref dead) report
+    alive=False with zero bytes."""
+    out = {}
+    for tag, entry in _snapshot_entries():
+        arrays = _live_arrays(entry)
+        alive = bool(arrays) or (entry.owner is not None
+                                 and entry.owner() is not None)
+        out[tag] = {"bytes": sum(int(a.nbytes) for a in arrays),
+                    "arrays": len(arrays), "alive": alive}
+    return out
+
+
+def tag_bytes():
+    """{tag: bytes} over the live ledger (each tag's own view)."""
+    return {t: v["bytes"] for t, v in ledger().items()}
+
+
+def _executable_peak_bytes():
+    """Sum over distinct executable tags of the compile ledger's max
+    `memory_analysis` peak — the bound on legitimate UNATTRIBUTED
+    resident bytes (temp/scratch an executable may hold)."""
+    try:
+        from . import compile_observatory as _cobs
+        peaks = {}
+        for r in _cobs.ledger():
+            p = float(r.get("peak_memory_bytes", 0.0) or 0.0)  # hot-sync-ok: host dict field from the compile ledger, not a device read
+            t = r.get("tag", "?")
+            if p > peaks.get(t, 0.0):
+                peaks[t] = p
+        return int(sum(peaks.values()))
+    except Exception:
+        return 0
+
+
+def mem_report(device=None):
+    """The attribution split: per-tag ledger bytes, the attributed
+    total DEDUPLICATED over shared buffers (id-keyed — a pool
+    registered under two tags counts once), and the device totals from
+    `jax.Device.memory_stats()`. `measured` is True when the allocator
+    answered; on statless backends (CPU) the device totals fall back to
+    the ledger sum so the `attributed <= device total` bound the schema
+    enforces holds in both modes. `unattributed_bytes` is what the
+    ledger cannot name, bounded by `executable_peak_bytes` (the compile
+    ledger's temp/scratch peaks). Never raises; pure host reads."""
+    tags = {}
+    seen = set()
+    attributed = 0
+    for tag, entry in _snapshot_entries():
+        b = 0
+        for a in _live_arrays(entry):
+            nb = int(a.nbytes)
+            b += nb
+            key = id(a)
+            if key not in seen:
+                seen.add(key)
+                attributed += nb
+        tags[tag] = b
+        peaks = _state["peaks"]
+        if b > peaks.get(tag, 0):
+            peaks[tag] = b
+    try:
+        from .. import device as _device
+        stats = _device._memory_stats(device)
+    except Exception:
+        stats = {}
+    measured = bool(stats)
+    in_use = int(stats.get("bytes_in_use", 0))
+    peak = int(stats.get("peak_bytes_in_use", 0))
+    limit = int(stats.get("bytes_limit", 0))
+    if not measured:
+        # no allocator stats: the ledger IS the best device-total
+        # estimate — attribution trivially sums to total
+        in_use = attributed
+        peak = max(attributed, max(_state["peaks"].values(), default=0))
+    return {
+        "measured": measured,
+        "tags": tags,
+        "attributed_bytes": int(attributed),
+        "device_bytes_in_use": int(max(in_use, attributed)),
+        "device_peak_bytes": int(max(peak, attributed)),
+        "device_bytes_limit": int(limit),
+        "unattributed_bytes": int(max(in_use - attributed, 0)),
+        "executable_peak_bytes": _executable_peak_bytes(),
+    }
+
+
+# -- pool fragmentation (measured, not claimed) ---------------------------
+
+def _free_page_ids(cache):
+    """Sorted snapshot of a paged pool's free page ids (C-level list()
+    copy under the pool lock when available — safe from any thread)."""
+    free = getattr(cache, "_free", None)
+    if free is None:
+        return None
+    lock = getattr(cache, "lock", None)
+    if lock is not None:
+        with lock:
+            free = list(free)
+    else:
+        free = list(free)
+    return sorted(int(p) for p in free)
+
+
+def fragmentation(cache):
+    """MEASURED fragmentation of a page pool's free list: walk the
+    sorted free page ids into contiguous runs, histogram the run
+    lengths (power-of-two buckets), and relate the largest contiguous
+    claimable run to the total free count —
+    ``fragmentation = 1 - largest_run / free_pages`` (0.0 for an empty
+    free list or one unbroken run). Hybrid caches report their paged
+    half; recurrent pools have no adjacency (every slot is
+    interchangeable) and report None. Never raises."""
+    try:
+        paged = getattr(cache, "paged", None)
+        if paged is not None:        # HybridCache -> its paged half
+            cache = paged
+        if getattr(cache, "strategy", "paged") != "paged":
+            return None
+        free = _free_page_ids(cache)
+        if free is None:
+            return None
+        runs = []
+        for p in free:
+            if runs and p == runs[-1][0] + runs[-1][1]:
+                runs[-1][1] += 1
+            else:
+                runs.append([p, 1])
+        lengths = [n for _, n in runs]
+        hist = {}
+        for n in lengths:
+            b = 1 << (n - 1).bit_length()  # pow2 bucket the run fits in
+            key = str(b)
+            hist[key] = hist.get(key, 0) + 1
+        largest = max(lengths, default=0)
+        n_free = len(free)
+        frag = 0.0 if n_free == 0 else 1.0 - largest / n_free
+        return {"free_pages": n_free, "free_runs": len(lengths),
+                "largest_free_run": int(largest),
+                "free_run_histogram": hist,
+                "fragmentation": round(max(min(frag, 1.0), 0.0), 6)}
+    except Exception:
+        return None
+
+
+def _paged_hbm(cache):
+    """(total, free, headroom) bytes of one PagedKVCache, page-granular
+    and MEASURED: per-page bytes come from the pool's actual device
+    arrays (`sum(nbytes) / n_pages`), not dtype arithmetic; free counts
+    free + evictable pages; headroom additionally subtracts outstanding
+    admission claims — the same quantities admission reasons in, in
+    bytes instead of pages."""
+    arrays = cache.device_arrays() if hasattr(cache, "device_arrays") \
+        else list(getattr(cache, "k", [])) + list(getattr(cache, "v", []))
+    total = sum(int(a.nbytes) for a in arrays)
+    n_pages = max(int(getattr(cache, "n_pages", 1)), 1)
+    page_bytes = total // n_pages
+    free = int(cache.n_free_pages()) + int(cache.n_evictable_pages())
+    claims = int(cache.outstanding_claims()) \
+        if hasattr(cache, "outstanding_claims") else 0
+    headroom = max(free - claims, 0)
+    return total, free * page_bytes, headroom * page_bytes, page_bytes
+
+
+def pool_hbm(cache):
+    """Measured byte gauges of one cache pool: {"hbm_total_bytes",
+    "hbm_free_bytes", "hbm_headroom_bytes"} (+ "page_bytes" for pools
+    with a page surface). Paged pools are page-granular (free +
+    evictable pages x measured per-page bytes; headroom subtracts
+    outstanding claims), recurrent pools slot-granular (free slots x
+    measured per-slot bytes), hybrid pools sum both halves. Never
+    raises; returns zeros-shaped dict on refusal."""
+    try:
+        strategy = getattr(cache, "strategy", "paged")
+        if strategy == "hybrid":
+            pt, pf, ph, pb = _paged_hbm(cache.paged)
+            rt, rf, rh = _recurrent_hbm(cache.recurrent)
+            return {"hbm_total_bytes": pt + rt,
+                    "hbm_free_bytes": pf + rf,
+                    "hbm_headroom_bytes": ph + rh,
+                    "page_bytes": pb}
+        if strategy == "recurrent":
+            rt, rf, rh = _recurrent_hbm(cache)
+            return {"hbm_total_bytes": rt, "hbm_free_bytes": rf,
+                    "hbm_headroom_bytes": rh}
+        pt, pf, ph, pb = _paged_hbm(cache)
+        return {"hbm_total_bytes": pt, "hbm_free_bytes": pf,
+                "hbm_headroom_bytes": ph, "page_bytes": pb}
+    except Exception:
+        return {"hbm_total_bytes": 0, "hbm_free_bytes": 0,
+                "hbm_headroom_bytes": 0}
+
+
+def _recurrent_hbm(cache):
+    """(total, free, headroom) bytes of one RecurrentStateCache —
+    slot-granular, measured from the state pools' device arrays."""
+    arrays = cache.device_arrays() if hasattr(cache, "device_arrays") \
+        else list(getattr(cache, "conv", [])) \
+        + list(getattr(cache, "ssm", []))
+    total = sum(int(a.nbytes) for a in arrays)
+    slots = max(int(getattr(cache, "n_pages", 1)), 1)
+    slot_bytes = total // slots
+    with cache.lock:
+        free = len(list(cache._free))
+        claims = sum(dict(cache._claims).values()) \
+            if hasattr(cache, "_claims") else 0
+    return total, free * slot_bytes, max(free - claims, 0) * slot_bytes
+
+
+# -- periodic kind:"memory" records ---------------------------------------
+
+def maybe_memory(step_i, source="train", engine=None, cache=None):
+    """Cadence gate for the per-step call sites (`export_step_metrics`):
+    emit a memory record on the FIRST step seen for this source and
+    then every PADDLE_TPU_MEMORY_EVERY-th (default 16; 0 disables).
+    The off-cadence cost is one int modulo + a set lookup."""
+    every = _env_int("PADDLE_TPU_MEMORY_EVERY", 16)
+    if every <= 0:
+        return None
+    key = f"{source}.{engine or ''}"
+    if key in _state["emitted"] and step_i % every != 0:
+        return None
+    return record_memory(source=source, step=step_i, engine=engine,
+                         cache=cache)
+
+
+def record_memory(source, step=None, engine=None, cache=None):
+    """Build + export ONE `kind:"memory"` record: the full attribution
+    split (`mem_report`), and — when a cache pool rides along — its
+    occupancy plus the measured fragmentation metric and hbm byte
+    gauges. Ringed in the flight recorder always, JSONL when
+    PADDLE_TPU_METRICS_FILE is set. Never raises; returns the record
+    (None on failure)."""
+    try:
+        rep = mem_report()
+        rec = {
+            "source": str(source),
+            "step": int(step or 0),
+            "measured": bool(rep["measured"]),
+            "tags": {t: int(b) for t, b in rep["tags"].items()},
+            "attributed_bytes": rep["attributed_bytes"],
+            "unattributed_bytes": rep["unattributed_bytes"],
+            "device_bytes_in_use": rep["device_bytes_in_use"],
+            "device_peak_bytes": rep["device_peak_bytes"],
+            "device_bytes_limit": rep["device_bytes_limit"],
+            "executable_peak_bytes": rep["executable_peak_bytes"],
+        }
+        if engine is not None:
+            rec["engine"] = str(engine)
+        if cache is not None:
+            stats = cache.pool_stats()
+            rec["cache_strategy"] = str(
+                stats.get("cache_strategy", "paged"))
+            hbm = pool_hbm(cache)
+            rec.update(hbm)
+            if rec["cache_strategy"] != "recurrent":
+                rec["n_pages"] = int(getattr(cache, "n_pages", 0))
+                rec["free_pages"] = int(stats.get("free_pages", 0))
+                rec["held_pages"] = int(stats.get("held_pages", 0))
+                frag = fragmentation(cache)
+                if frag is not None:
+                    rec.update(frag)
+            if rec["cache_strategy"] != "paged":
+                rec["free_slots"] = int(stats.get("free_slots", 0))
+                rec["held_slots"] = int(stats.get("held_slots", 0))
+                rec["state_bytes_total"] = int(
+                    stats.get("state_bytes_total", 0))
+        _monitor.gauge("mem.attributed_bytes").set(
+            rep["attributed_bytes"])
+        _monitor.gauge("mem.unattributed_bytes").set(
+            rep["unattributed_bytes"])
+        if "fragmentation" in rec:
+            _monitor.gauge("mem.kv_fragmentation").set(
+                rec["fragmentation"])
+        _state["emitted"].add(f"{source}.{engine or ''}")
+        _ensure_state_provider()
+        _monitor.export_step(rec, kind="memory")
+        with _lock:
+            _records.append(dict(rec))
+        return rec
+    except Exception:
+        return None
+
+
+def records_tail():
+    """The ring of recent `kind:"memory"` records (oldest first) —
+    what `Profiler.export_host_stats` embeds and a debug bundle's
+    mem_state.json carries as the trend tail."""
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+# -- OOM forensics ---------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "resource_exhausted",
+                "Out of memory", "out of memory", "OutOfMemory")
+
+# XLA phrasings: "while trying to allocate 8589934592 bytes",
+# "Failed to allocate request for 8.00GiB", "allocating 2.5G ..."
+_SIZE_RE = re.compile(
+    r"alloca\w*\s+(?:request\s+)?(?:for\s+|of\s+)?"
+    r"([\d.]+)\s*([KMGT]i?B?|bytes?|B)\b", re.IGNORECASE)
+_UNITS = {"b": 1, "byte": 1, "bytes": 1,
+          "k": 1000, "kb": 1000, "kib": 1024,
+          "m": 1000**2, "mb": 1000**2, "mib": 1024**2,
+          "g": 1000**3, "gb": 1000**3, "gib": 1024**3,
+          "t": 1000**4, "tb": 1000**4, "tib": 1024**4}
+
+
+def is_oom(exc):
+    """True when `exc` is a device allocator exhaustion (XLA
+    ``RESOURCE_EXHAUSTED`` / out-of-memory phrasing) — the dispatch
+    choke points' routing predicate. A DeviceOOMError is already
+    forensics-wrapped and answers False (no double wrapping)."""
+    if isinstance(exc, DeviceOOMError):
+        return False
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def parse_requested_bytes(msg):
+    """The allocation size the XLA message names, in bytes (0 when the
+    message carries none) — every XLA OOM phrasing spells the request
+    near an 'allocat*' verb with a unit suffix."""
+    m = _SIZE_RE.search(str(msg) or "")
+    if not m:
+        return 0
+    try:
+        scale = _UNITS.get(m.group(2).lower().rstrip("s") + (
+            "s" if m.group(2).lower() in ("bytes",) else ""), None)
+        if scale is None:
+            scale = _UNITS.get(m.group(2).lower(), 1)
+        return int(float(m.group(1)) * scale)  # hot-sync-ok: parsing the XLA error string, not a device read
+    except (TypeError, ValueError):
+        return 0
+
+
+def mem_state():
+    """The debug-bundle payload (`mem_state.json`): the attribution
+    report, the full per-tag ledger, per-pool pool_stats for every
+    registered pool owner that exposes one, the compile ledger's
+    per-executable memory_analysis peaks, per-tag peak bytes, the
+    recent memory-record tail, and — when an OOM routed through
+    `oom_error` — the parsed request context. Never raises."""
+    pools = {}
+    for tag, entry in _snapshot_entries():
+        if entry.owner is None:
+            continue
+        owner = entry.owner()
+        if owner is None or not hasattr(owner, "pool_stats"):
+            continue
+        try:
+            pools[tag] = owner.pool_stats()
+        except Exception:
+            pools[tag] = {"error": "pool_stats refused"}
+    exec_peaks = {}
+    try:
+        from . import compile_observatory as _cobs
+        for r in _cobs.ledger():
+            p = float(r.get("peak_memory_bytes", 0.0) or 0.0)  # hot-sync-ok: host dict field from the compile ledger, not a device read
+            t = r.get("tag", "?")
+            if p > exec_peaks.get(t, 0.0):
+                exec_peaks[t] = p
+    except Exception:
+        pass
+    return {
+        "report": mem_report(),
+        "ledger": ledger(),
+        "pools": pools,
+        "executable_peaks": exec_peaks,
+        "tag_peaks": dict(_state["peaks"]),
+        "records_tail": records_tail(),
+        "last_oom": _state["last_oom"],
+    }
+
+
+def oom_error(exc, site):
+    """Forensics for one allocator exhaustion: stamp the OOM context
+    (site + requested bytes parsed from the XLA message), flight-record
+    a ``device_oom`` event, dump a debug bundle (whose
+    ``mem_state.json`` carries the full ledger), and return a
+    `DeviceOOMError` naming the top-3 holders — the caller raises it
+    `from` the original. Never raises on its own forensics."""
+    requested = parse_requested_bytes(exc)
+    top = []
+    try:
+        led = ledger()
+        top = sorted(((t, v["bytes"]) for t, v in led.items()),
+                     key=lambda kv: -kv[1])[:3]
+    except Exception:
+        pass
+    _state["last_oom"] = {
+        "site": str(site),
+        "requested_bytes": int(requested),
+        "error": f"{type(exc).__name__}: {exc}"[:500],
+        "top_holders": [[t, int(b)] for t, b in top],
+    }
+    _ensure_state_provider()
+    try:
+        _fr.record_event(
+            "device_oom", site=str(site),
+            requested_bytes=int(requested),
+            top_holders=[f"{t}={b}" for t, b in top],
+            error=str(exc)[:300])
+    except Exception:
+        pass
+    bundle = None
+    try:
+        bundle = _fr.dump("oom", exc=exc)
+    except Exception:
+        pass
+    holders = ", ".join(f"{t}={b / 2**20:.1f}MiB" for t, b in top) \
+        or "ledger empty"
+    req = f" (requested {requested} bytes)" if requested else ""
+    msg = (f"device out of memory at {site}{req}; top holders: "
+           f"{holders}"
+           + (f"; debug bundle: {bundle}" if bundle else ""))
+    return DeviceOOMError(msg, site=site, requested_bytes=requested,
+                          top_holders=top, bundle_dir=bundle)
+
+
+def _ensure_state_provider():
+    """Register `mem_state` with the flight recorder exactly once
+    (module-level function: the recorder holds it strongly, which is
+    correct — the module outlives every registrant)."""
+    with _lock:
+        if _state_registered[0]:
+            return
+        _state_registered[0] = True
+    try:
+        _fr.register_state_provider("mem_state", mem_state)
+    except Exception:
+        pass
+
+
+def reset():
+    """Drop the tag registry, record ring, peaks, cadence marks, and
+    OOM context (tests)."""
+    with _lock:
+        _tags.clear()
+        _records.clear()
+        _state["emitted"] = set()
+        _state["peaks"] = {}
+        _state["last_oom"] = None

@@ -85,6 +85,10 @@ tools/check_metrics_schema.py):
                 rollup, shared-pool claims, rates, SLO attainment
                 (fleet observatory)
     harness     ONE summary per tools/load_harness.py open-loop run
+    memory      periodic device-memory attribution: per-tag ledger
+                bytes, attributed/unattributed split, pool occupancy
+                + fragmentation (mem observatory; train and serve
+                cadences both emit it)
 """
 import collections
 import json

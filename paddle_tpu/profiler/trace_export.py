@@ -35,8 +35,9 @@ Track layout (what you see in Perfetto):
   the disaggregated handoff rendered as the arrow it is;
 - a counter track per metric (queue depth, prefetch depth, device
   memory, host.blocked_s, ...) plus `kv.<engine>.*` page-pool tracks
-  from `kind:"kvcache"` snapshots and `fleet.<router>.*` tracks from
-  `kind:"fleet"` snapshots;
+  from `kind:"kvcache"` snapshots, `fleet.<router>.*` tracks from
+  `kind:"fleet"` snapshots, and `mem.<tag>` per-tag byte tracks from
+  `kind:"memory"` attribution records;
 - instant markers for `kind:"event"` anomalies (NaN, loss spike,
   watchdog, ...).
 
@@ -236,6 +237,29 @@ def chrome_trace_events(snap=None, rank=None):
                     events.append({
                         "name": f"kv.{eng}.{key}", "ph": "C",
                         "cat": "kvcache", "ts": ts * 1e6, "pid": pid,
+                        "tid": 0, "args": {"value": _sanitize(v)}})
+        elif kind == "memory":
+            # per-tag memory counter tracks (mem.params, mem.kv_pool.*,
+            # ...): each attribution tag becomes its own byte series,
+            # plus the attributed/unattributed split — the Perfetto
+            # view of WHO holds HBM over time
+            tags = rec.get("tags")
+            if isinstance(tags, dict):
+                for tag, v in tags.items():
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        events.append({
+                            "name": f"mem.{tag}", "ph": "C",
+                            "cat": "memory", "ts": ts * 1e6, "pid": pid,
+                            "tid": 0, "args": {"value": _sanitize(v)}})
+            for key in ("attributed_bytes", "unattributed_bytes",
+                        "device_bytes_in_use", "fragmentation"):
+                v = rec.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    events.append({
+                        "name": f"mem.{key}", "ph": "C",
+                        "cat": "memory", "ts": ts * 1e6, "pid": pid,
                         "tid": 0, "args": {"value": _sanitize(v)}})
         elif kind == "ckpt":
             # the checkpoint track: one slice per save (reconstructed

@@ -592,6 +592,49 @@ def emit_workload():
     finally:
         _shutil.rmtree(ck_dir, ignore_errors=True)
 
+    # the memory-observatory contract: the canonical workload lands
+    # schema-valid kind:"memory" records from BOTH the train step
+    # cadence (source "train", first step always) and a serving
+    # engine's kvcache cadence (source "serve", carrying the pool's
+    # occupancy + measured hbm gauges), and the kv-pool TAG's ledger
+    # bytes reconcile with pool_stats() page counts x measured
+    # per-page bytes to within page granularity — measured
+    # attribution, not analytic claims
+    mems = _load_kind(mfile, "memory")
+    errs = [e for r in mems for e in _cms.validate_line(_json.dumps(r))]
+    if errs:
+        raise AssertionError(
+            f"memory records violate the schema: {errs[:5]}")
+    train_mems = [r for r in mems if r.get("source") == "train"]
+    serve_mems = [r for r in mems if r.get("source") == "serve"]
+    if not train_mems or not serve_mems:
+        raise AssertionError(
+            "expected >= 1 kind:'memory' record from BOTH the train "
+            f"step path and a serving engine, got "
+            f"{len(train_mems)} train / {len(serve_mems)} serve")
+    if not any("params" in r.get("tags", {}) and
+               r["tags"]["params"] > 0 for r in train_mems):
+        raise AssertionError(
+            "train memory records must attribute the params store "
+            f"(tags of the first: {train_mems[0].get('tags')})")
+    kv_serve = [r for r in serve_mems
+                if "n_pages" in r and "page_bytes" in r
+                and f"kv_pool.{r.get('engine')}" in r.get("tags", {})]
+    if not kv_serve:
+        raise AssertionError(
+            "expected >= 1 serve memory record carrying its kv pool's "
+            "n_pages/page_bytes next to the kv_pool tag, got "
+            f"{[(r.get('engine'), sorted(r.get('tags', {}))) for r in serve_mems][:4]}")
+    for r in kv_serve:
+        tag_b = r["tags"][f"kv_pool.{r['engine']}"]
+        pool_b = r["n_pages"] * r["page_bytes"]
+        if abs(tag_b - pool_b) > r["page_bytes"]:
+            raise AssertionError(
+                "kv-pool ledger bytes do not reconcile with "
+                f"pool_stats page math on {r['engine']}: tag "
+                f"{tag_b} vs n_pages {r['n_pages']} x page_bytes "
+                f"{r['page_bytes']} = {pool_b}")
+
     # the static-analysis contract: the canonical workload runs
     # paddlelint (tools/paddlelint.py — docs/STATIC_ANALYSIS.md) over
     # the repo and lands its findings as `kind:"lint"` records in the

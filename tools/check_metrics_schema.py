@@ -465,6 +465,44 @@ Schema (documented in docs/OBSERVABILITY.md):
                   attainment_by_class dict {class: fraction in [0, 1]}
                   phases       dict    per-phase (before/burst/after)
                                        sub-summaries
+  kind == "memory" (periodic device-memory attribution —
+                  profiler/mem_observatory.py; emitted from the train
+                  step cadence AND each serving engine's kvcache
+                  cadence) additionally requires:
+                  source       str     non-empty ("train" / "serve")
+                  step         int     >= 0 emitting step counter
+                  measured     bool    allocator stats answered (false
+                                       = ledger-arithmetic fallback on
+                                       statless backends)
+                  tags         dict    {tag: bytes int >= 0} — the
+                                       attribution ledger's per-tag
+                                       view
+                  attributed_bytes int >= 0, deduplicated over shared
+                                       buffers; MUST be <=
+                                       device_bytes_in_use (attribution
+                                       cannot exceed what the device
+                                       holds)
+                  unattributed_bytes int >= 0 (in_use - attributed)
+                  device_bytes_in_use int >= 0
+                  device_peak_bytes int >= device_bytes_in_use is NOT
+                                       required (peak is all-time) but
+                                       must be >= 0
+                  device_bytes_limit int >= 0 (0 = unknown)
+                  executable_peak_bytes int >= 0 (compile ledger's
+                                       temp/scratch bound)
+                  and when a pool rides along (serve records),
+                  strategy-conditional on cache_strategy (the PR 19
+                  enum; absent = train-path record, no pool fields):
+                  paged/hybrid require n_pages int >= 1, free_pages /
+                  held_pages int >= 0, hbm_total_bytes /
+                  hbm_free_bytes / hbm_headroom_bytes int >= 0
+                  (headroom <= free <= total), page_bytes int >= 1;
+                  optional fragmentation fields: fragmentation number
+                  in [0, 1], free_runs / largest_free_run int >= 0
+                  with largest_free_run <= free_pages,
+                  free_run_histogram dict {bucket: count >= 1};
+                  recurrent/hybrid require free_slots / held_slots /
+                  state_bytes_total int >= 0
 
 Extra keys are allowed (the schema is open for forward compat); missing
 or mistyped required keys are violations.
@@ -595,6 +633,20 @@ KVCACHE_REQUIRED = {"engine": str, "n_pages": int, "free_pages": int,
                     "cow_copies": int, "lru_reclaims": int}
 COLLECTIVE_REQUIRED = {"op": str, "group": str, "bytes": int,
                        "wall_s": (int, float), "bw_gbps": (int, float)}
+MEMORY_REQUIRED = {"source": str, "step": int, "measured": bool,
+                   "tags": dict, "attributed_bytes": int,
+                   "unattributed_bytes": int,
+                   "device_bytes_in_use": int,
+                   "device_peak_bytes": int, "device_bytes_limit": int,
+                   "executable_peak_bytes": int}
+# pool fields a serve-path memory record carries, by strategy (the
+# train path carries none — no cache rides its cadence)
+MEMORY_PAGED_REQUIRED = {"n_pages": int, "free_pages": int,
+                         "held_pages": int, "hbm_total_bytes": int,
+                         "hbm_free_bytes": int,
+                         "hbm_headroom_bytes": int, "page_bytes": int}
+MEMORY_RECURRENT_REQUIRED = {"free_slots": int, "held_slots": int,
+                             "state_bytes_total": int}
 RANKSTAT_REQUIRED = {"step": int, "world_size": int,
                      "step_time_p50_s": (int, float),
                      "step_time_p99_s": (int, float),
@@ -1164,10 +1216,24 @@ def validate_line(line, where="<line>"):
         for key in ("queue_depth", "active", "slots_free",
                     "admittable_pages", "free_pages",
                     "outstanding_claims", "requests", "dispatched",
-                    "rejected", "handoffs"):
+                    "rejected", "handoffs", "hbm_total_bytes",
+                    "hbm_free_bytes", "hbm_headroom_bytes"):
             v = _int_val(rec, key)
             if v is not None and v < 0:
                 errors.append(f"{where}: {key} must be >= 0, got {v}")
+        # measured-bytes rollup ordering: headroom subtracts claims
+        # from free, free is a subset of total — inverted gauges mean
+        # the per-pool dedup or the pool arithmetic broke
+        ht = _int_val(rec, "hbm_total_bytes")
+        hf = _int_val(rec, "hbm_free_bytes")
+        hh = _int_val(rec, "hbm_headroom_bytes")
+        if None not in (hf, ht) and hf > ht:
+            errors.append(
+                f"{where}: hbm_free_bytes {hf} > hbm_total_bytes {ht}")
+        if None not in (hh, hf) and hh > hf:
+            errors.append(
+                f"{where}: hbm_headroom_bytes {hh} > hbm_free_bytes "
+                f"{hf}")
         for key in ("window_s", "arrival_rate", "completion_rate",
                     "handoff_rate", "rejection_rate"):
             v = _num_val(rec, key)
@@ -1519,6 +1585,112 @@ def validate_line(line, where="<line>"):
             v = rec.get(key)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 errors.append(f"{where}: {key} must be >= 0, got {v}")
+    elif rec.get("kind") == "memory":
+        _check_types(rec, MEMORY_REQUIRED, where, errors)
+        if isinstance(rec.get("source"), str) and not rec["source"]:
+            errors.append(f"{where}: source must be non-empty")
+        tags = rec.get("tags")
+        if isinstance(tags, dict):
+            for tag, v in tags.items():
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    errors.append(
+                        f"{where}: tags[{tag!r}] must be an int >= 0, "
+                        f"got {v!r}")
+        for key in ("step", "attributed_bytes", "unattributed_bytes",
+                    "device_bytes_in_use", "device_peak_bytes",
+                    "device_bytes_limit", "executable_peak_bytes"):
+            v = _int_val(rec, key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        # THE attribution bound: the deduplicated ledger total can
+        # never exceed what the device reports in use (on statless
+        # backends the fallback pins in_use to the ledger, so the
+        # bound holds in both modes)
+        att = _int_val(rec, "attributed_bytes")
+        use = _int_val(rec, "device_bytes_in_use")
+        if None not in (att, use) and att > use:
+            errors.append(
+                f"{where}: attributed_bytes {att} > "
+                f"device_bytes_in_use {use} — attribution cannot "
+                "exceed what the device holds")
+        # pool fields ride only on serve-path records (cache_strategy
+        # present); strategy-conditional like the kvcache branch
+        if "cache_strategy" in rec:
+            strategy = _cache_strategy(rec, where, errors)
+            if isinstance(rec.get("engine"), str) and not rec["engine"]:
+                errors.append(f"{where}: engine must be non-empty")
+            if strategy in ("paged", "hybrid"):
+                _check_types(rec, MEMORY_PAGED_REQUIRED, where, errors)
+                np_ = _int_val(rec, "n_pages")
+                if np_ is not None and np_ < 1:
+                    errors.append(
+                        f"{where}: n_pages must be >= 1, got {np_}")
+                pb = _int_val(rec, "page_bytes")
+                if pb is not None and pb < 1:
+                    errors.append(
+                        f"{where}: page_bytes must be >= 1, got {pb}")
+                for key in ("free_pages", "held_pages"):
+                    v = _int_val(rec, key)
+                    if v is not None and v < 0:
+                        errors.append(
+                            f"{where}: {key} must be >= 0, got {v}")
+                ht = _int_val(rec, "hbm_total_bytes")
+                hf = _int_val(rec, "hbm_free_bytes")
+                hh = _int_val(rec, "hbm_headroom_bytes")
+                for key, v in (("hbm_total_bytes", ht),
+                               ("hbm_free_bytes", hf),
+                               ("hbm_headroom_bytes", hh)):
+                    if v is not None and v < 0:
+                        errors.append(
+                            f"{where}: {key} must be >= 0, got {v}")
+                if None not in (hf, ht) and hf > ht:
+                    errors.append(
+                        f"{where}: hbm_free_bytes {hf} > "
+                        f"hbm_total_bytes {ht}")
+                if None not in (hh, hf) and hh > hf:
+                    errors.append(
+                        f"{where}: hbm_headroom_bytes {hh} > "
+                        f"hbm_free_bytes {hf}")
+            if strategy in ("recurrent", "hybrid"):
+                _check_types(rec, MEMORY_RECURRENT_REQUIRED, where,
+                             errors)
+                for key in ("free_slots", "held_slots",
+                            "state_bytes_total"):
+                    v = _int_val(rec, key)
+                    if v is not None and v < 0:
+                        errors.append(
+                            f"{where}: {key} must be >= 0, got {v}")
+        # fragmentation is MEASURED from the free list: the metric is
+        # a fraction, the largest run can never exceed the free count
+        frag = _num_val(rec, "fragmentation")
+        if frag is not None and not 0 <= frag <= 1:
+            errors.append(
+                f"{where}: fragmentation must be in [0, 1], got "
+                f"{frag}")
+        for key in ("free_runs", "largest_free_run"):
+            v = _int_val(rec, key)
+            if v is not None and v < 0:
+                errors.append(f"{where}: {key} must be >= 0, got {v}")
+        lr = _int_val(rec, "largest_free_run")
+        fp = _int_val(rec, "free_pages")
+        if None not in (lr, fp) and lr > fp:
+            errors.append(
+                f"{where}: largest_free_run {lr} > free_pages {fp} — "
+                "a contiguous run is a subset of the free list")
+        hist = rec.get("free_run_histogram")
+        if hist is not None:
+            if not isinstance(hist, dict):
+                errors.append(
+                    f"{where}: free_run_histogram must be a dict, got "
+                    f"{type(hist).__name__}")
+            else:
+                for bucket, n in hist.items():
+                    if not isinstance(n, int) or isinstance(n, bool) \
+                            or n < 1:
+                        errors.append(
+                            f"{where}: free_run_histogram[{bucket!r}] "
+                            f"must be an int >= 1, got {n!r}")
     return errors
 
 

@@ -122,6 +122,12 @@ HOT_REGIONS = {
     # scheduler's emit path and fleet snapshots run on submit — the
     # whole module must stay pure host arithmetic (no device reads)
     "paddle_tpu/profiler/fleet_observatory.py": ["*"],
+    # the memory observatory: the tag ledger is read on the train-step
+    # and decode-scheduler cadences and the OOM forensics run inside
+    # dispatch except-blocks — the whole module must stay pure host
+    # arithmetic (array .nbytes is metadata, memory_stats() is an
+    # allocator query; neither blocks on the device)
+    "paddle_tpu/profiler/mem_observatory.py": ["*"],
     # eager collectives are host-visible waits by design, but the
     # instrumentation AROUND them must never add a sync of its own
     "paddle_tpu/distributed/collective.py": [

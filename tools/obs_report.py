@@ -8,7 +8,8 @@ ledger per executable, the serving SLO/goodput rollup, the front-door
 routing section (per-engine placements, handoffs, fleet SLO), the
 cross-engine journey section (kind:"journey" phase splits + the
 journey-vs-request-pair token reconciliation), the fleet snapshot /
-load-harness section, the
+load-harness section, the device-memory ledger section (kind:"memory"
+per-tag peaks + attribution MISMATCH lines), the
 distributed
 observatory's collective top-k by wall time and per-rank skew table,
 every anomaly event (stragglers, spikes, retraces, NaNs) in order, and
@@ -381,6 +382,67 @@ def section_ranks(recs, out):
     out.append("")
 
 
+def _fmt_bytes(v):
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024.0
+
+
+def section_memory(recs, out):
+    """Device-memory ledger rollup (kind:"memory" —
+    profiler/mem_observatory.py): per-tag peak bytes across the run's
+    records, the last record's attribution split, and a MISMATCH line
+    whenever a measured record's unattributed bytes exceed what the
+    compile ledger's executable peaks can explain — the leak signature
+    the memory observatory exists to surface."""
+    mems = [r for r in recs if r.get("kind") == "memory"]
+    if not mems:
+        return
+    sources = {}
+    for r in mems:
+        sources[r.get("source", "?")] = sources.get(
+            r.get("source", "?"), 0) + 1
+    out.append(f"== memory ==  ({len(mems)} records: " + "  ".join(
+        f"{k}={v}" for k, v in sorted(sources.items())) + ")")
+    peaks = {}
+    for r in mems:
+        for tag, b in (r.get("tags") or {}).items():
+            if isinstance(b, (int, float)) and not isinstance(b, bool):
+                peaks[tag] = max(peaks.get(tag, 0), int(b))
+    for tag, b in sorted(peaks.items(), key=lambda kv: -kv[1]):
+        out.append(f"  {tag:<28} peak {_fmt_bytes(b):>10}")
+    last = mems[-1]
+    out.append(
+        f"  last: attributed {_fmt_bytes(last.get('attributed_bytes', 0))}"
+        f"  unattributed {_fmt_bytes(last.get('unattributed_bytes', 0))}"
+        f"  in_use {_fmt_bytes(last.get('device_bytes_in_use', 0))}"
+        f"  measured={bool(last.get('measured'))}")
+    frags = [float(r.get("fragmentation", 0.0)) for r in mems
+             if "fragmentation" in r]
+    if frags:
+        out.append(f"  kv fragmentation: last {frags[-1]:.3f}  "
+                   f"max {max(frags):.3f}")
+    # a measured record whose unattributed bytes exceed the compile
+    # ledger's executable peaks (plus 10%-of-device or 1 MiB slack)
+    # points at memory NO tag or executable explains
+    for r in mems:
+        if not r.get("measured"):
+            continue
+        unattr = int(r.get("unattributed_bytes", 0))
+        bound = int(r.get("executable_peak_bytes", 0))
+        tol = max(int(0.10 * int(r.get("device_bytes_in_use", 0))),
+                  1 << 20)
+        if unattr > bound + tol:
+            out.append(
+                f"  MISMATCH at {r.get('source', '?')} step "
+                f"{r.get('step', '?')}: unattributed "
+                f"{_fmt_bytes(unattr)} exceeds executable peaks "
+                f"{_fmt_bytes(bound)} (+{_fmt_bytes(tol)} tolerance)")
+    out.append("")
+
+
 def section_events(recs, out, top):
     evs = [r for r in recs if r.get("kind") == "event"]
     if not evs:
@@ -450,6 +512,7 @@ def render(recs, top=5):
     section_routing(recs, out)
     section_journeys(recs, out)
     section_fleet(recs, out)
+    section_memory(recs, out)
     section_collectives(recs, out, top)
     section_ranks(recs, out)
     section_events(recs, out, top)
